@@ -1,0 +1,13 @@
+"""WordCount — the canonical example workload.
+
+Mirrors reference mapreduce/examples/WordCount (SURVEY.md §2.3) in both
+packaging styles:
+
+- one-module-per-function: taskfn.py, mapfn.py, partitionfn.py, reducefn.py
+  (flagged), reducefn2.py (unflagged general reducer), finalfn.py
+- single-module: single.py carries all six functions plus flags
+  (analog examples/WordCount/init.lua:51-64)
+
+``naive.py`` is the single-process golden-output generator
+(analog misc/naive.lua) used by the golden-diff test harness (test.sh:11-15).
+"""

@@ -1,0 +1,22 @@
+"""WordCount finalfn — collect results; keep them for inspection.
+
+Analog of reference examples/WordCount/finalfn.lua:1-9 (prints pairs and
+returns True → engine deletes results). Here the default returns None so
+tests can read the results afterwards; set ``delete_results=True`` via init
+args for reference behavior.
+"""
+
+_delete = False
+counts = {}
+
+
+def init(args):
+    global _delete
+    _delete = bool(args.get("delete_results", False))
+    counts.clear()
+
+
+def finalfn(pairs):
+    for key, values in pairs:
+        counts[key] = values[0]
+    return True if _delete else None

@@ -1,0 +1,14 @@
+"""WordCount mapfn — tokenize a file and emit (word, 1).
+
+Analog of reference examples/WordCount/mapfn.lua:3-8: the map job's value is
+a path; the mapper reads its own input (streamed line-by-line) and emits one
+count per token. Tokens are whitespace-separated runs, as in the reference's
+``%s`` split.
+"""
+
+
+def mapfn(key, value, emit):
+    with open(value) as f:
+        for line in f:
+            for word in line.split():
+                emit(word, 1)

@@ -1,0 +1,18 @@
+"""Naive single-process word count — the golden-output generator.
+
+Analog of reference misc/naive.lua:1-7: a trivial in-memory count used by
+the golden-diff harness (test.sh:11-15) to verify that the framework's
+output is exactly what a straight-line program produces.
+"""
+
+from typing import Dict, Iterable
+
+
+def naive_wordcount(files: Iterable[str]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for path in files:
+        with open(path) as f:
+            for line in f:
+                for word in line.split():
+                    counts[word] = counts.get(word, 0) + 1
+    return counts

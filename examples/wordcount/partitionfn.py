@@ -1,0 +1,23 @@
+"""WordCount partitionfn — FNV-1a hash of the word mod NUM_REDUCERS.
+
+Analog of reference examples/WordCount/partitionfn.lua:1-16 (same FNV-1a
+constants, same NUM_REDUCERS=15; empty partitions are tolerated by the
+engine, BASELINE.md note).
+"""
+
+NUM_REDUCERS = 15
+
+_FNV_OFFSET = 0x811C9DC5
+_FNV_PRIME = 0x01000193
+_MASK = 0xFFFFFFFF
+
+
+def fnv1a(s: str) -> int:
+    h = _FNV_OFFSET
+    for byte in s.encode("utf-8", errors="surrogateescape"):
+        h = ((h ^ byte) * _FNV_PRIME) & _MASK
+    return h
+
+
+def partitionfn(key):
+    return fnv1a(str(key)) % NUM_REDUCERS

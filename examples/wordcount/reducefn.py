@@ -1,0 +1,19 @@
+"""WordCount reducefn — sum counts; flagged ACI reducer.
+
+Analog of reference examples/WordCount/reducefn.lua:1-14: the three property
+flags let the engine use the merge fast path (skip reducefn for singleton
+groups) and make a combiner legal (job.lua:104-106, 264-284).
+"""
+
+associative_reducer = True
+commutative_reducer = True
+idempotent_reducer = True
+
+
+def reducefn(key, values):
+    return sum(values)
+
+
+# the combiner is the same fold (reference uses reducefn as combinerfn in
+# the combiner config of test.sh)
+combinerfn = reducefn

@@ -1,0 +1,10 @@
+"""WordCount general reducer — same fold, no property flags.
+
+Analog of reference examples/WordCount/reducefn2.lua:1-10: exercises the
+general-reducer path (reducefn called on every group, no fast path, no
+combiner legality).
+"""
+
+
+def reducefn(key, values):
+    return sum(values)

@@ -1,0 +1,60 @@
+"""WordCount, single-module packaging style.
+
+All six user functions plus reducer flags in one module — analog of
+reference examples/WordCount/init.lua (both packaging styles must be
+supported, SURVEY.md §2.3). Pass this module's path for every function slot.
+"""
+
+import glob
+import os
+
+associative_reducer = True
+commutative_reducer = True
+idempotent_reducer = True
+
+NUM_REDUCERS = 15
+
+_files = None
+counts = {}
+_init_calls = 0
+
+
+def init(args):
+    global _files, _init_calls
+    _files = args.get("files")
+    _init_calls += 1  # the engine must dedup init across the six slots
+    counts.clear()
+
+
+def taskfn(emit):
+    files = _files
+    if not files:
+        here = os.path.dirname(os.path.abspath(__file__))
+        files = sorted(glob.glob(os.path.join(here, "*.py")))
+    for i, path in enumerate(files, start=1):
+        emit(i, path)
+
+
+def mapfn(key, value, emit):
+    with open(value) as f:
+        for line in f:
+            for word in line.split():
+                emit(word, 1)
+
+
+def partitionfn(key):
+    from examples.wordcount.partitionfn import fnv1a
+    return fnv1a(str(key)) % NUM_REDUCERS
+
+
+def reducefn(key, values):
+    return sum(values)
+
+
+combinerfn = reducefn
+
+
+def finalfn(pairs):
+    for key, values in pairs:
+        counts[key] = values[0]
+    return None

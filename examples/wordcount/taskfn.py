@@ -1,0 +1,26 @@
+"""WordCount taskfn — emit input files as map jobs.
+
+Analog of reference examples/WordCount/taskfn.lua:7-12, which emits 4 source
+files as splits keyed by index. Input files come from ``init(args)``
+(``args["files"]``); defaults to this example's own source files, matching
+the reference's trick of word-counting its own code (test.sh:11).
+"""
+
+import glob
+import os
+
+_files = None
+
+
+def init(args):
+    global _files
+    _files = args.get("files")
+
+
+def taskfn(emit):
+    files = _files
+    if not files:
+        here = os.path.dirname(os.path.abspath(__file__))
+        files = sorted(glob.glob(os.path.join(here, "*.py")))
+    for i, path in enumerate(files, start=1):
+        emit(i, path)

@@ -1,0 +1,54 @@
+"""lua_mapreduce_tpu — a TPU-native MapReduce framework.
+
+A brand-new framework with the capabilities of pakozm/lua-mapreduce
+(reference: /root/reference, see SURVEY.md): a fault-tolerant, iterative
+MapReduce engine with six pluggable user functions, an elastic worker pool,
+pluggable intermediate storage, and a data-parallel training harness —
+re-designed TPU-first:
+
+- map phases compile to pjit-sharded computations over a ``jax.sharding.Mesh``
+- combiners/reducers lower to ``psum`` / ``reduce_scatter`` / ``all_to_all``
+  collectives over ICI instead of a shuffle through a database
+- intermediate data lives in host DRAM with shared-dir / object-store spill
+- a single-controller coordinator owns job state, fault tolerance and
+  checkpoint/resume, entirely off the jitted hot path
+
+Public API (parity with reference mapreduce/init.lua:25-38):
+    server, worker, utils, tuples (interned tuples), persistent_table, utest
+"""
+
+from lua_mapreduce_tpu.core import tuples
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.local import LocalExecutor
+
+__version__ = "0.1.0"
+
+# distributed-engine exports appear here as their modules land
+_LAZY: dict = {}
+
+
+def __getattr__(name):
+    """Lazy exports — the distributed engine pulls in the coordinator; the
+    contract/local layers stay importable on their own."""
+    try:
+        modname, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+    return getattr(importlib.import_module(modname), attr)
+
+__all__ = [
+    "TaskSpec",
+    "LocalExecutor",
+    "tuples",
+    "utest",
+]
+
+
+def utest():
+    """Run every module's self-test (reference mapreduce/test.lua:30-39)."""
+    from lua_mapreduce_tpu.core import heap, merge, serialize
+
+    for mod in (tuples, heap, serialize, merge):
+        if hasattr(mod, "utest"):
+            mod.utest()

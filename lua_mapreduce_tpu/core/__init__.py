@@ -1,0 +1,5 @@
+"""Core data model: interned tuples, heap, serialization, k-way merge.
+
+Analog of the reference's L0 layer (SURVEY.md §1): mapreduce/utils.lua,
+mapreduce/heap.lua, mapreduce/tuple.lua.
+"""

@@ -1,0 +1,51 @@
+"""Engine tuning constants and status enums.
+
+Parity with reference mapreduce/utils.lua:27-55 (constants) and
+utils.lua:33-46 (STATUS / TASK_STATUS enums). Values keep the reference's
+semantics; a few are retuned for a single-controller Python/JAX runtime
+(polling a local job store is far cheaper than polling MongoDB).
+"""
+
+import enum
+
+# --- tuning constants (reference utils.lua:27-55) -------------------------
+
+DEFAULT_RW_TIMEOUT = 300          # utils.lua:28 (seconds)
+DEFAULT_SLEEP = 0.1               # utils.lua:29 is 1s; local store polls cheaper
+DEFAULT_MICRO_SLEEP = 0.01        # utils.lua:30
+DEFAULT_HOSTNAME = ""             # utils.lua:31
+DEFAULT_IP = "127.0.0.1"          # utils.lua:32
+DEFAULT_DATE = 0                  # utils.lua:33
+
+MAX_PENDING_INSERTS = 50_000      # utils.lua:50 — batched control-plane writes
+MAX_JOB_RETRIES = 3               # utils.lua:51 — BROKEN→FAILED threshold
+MAX_WORKER_RETRIES = 3            # utils.lua:52 — worker gives up after 3 errors
+MAX_MAP_RESULT = 5_000            # utils.lua:53 — in-map combiner threshold
+MAX_TASKFN_VALUE_SIZE = 16 * 1024 # utils.lua:54 — serialized task-value cap
+MAX_IDLE_COUNT = 5                # utils.lua:55 — map-affinity steal threshold
+
+GRP_TMP_DIR = "/tmp/grp_tmp_dir"  # utils.lua:47 — scratch dir for shared/sshfs
+
+
+class Status(enum.IntEnum):
+    """Per-job status machine (reference utils.lua:33-40).
+
+    WAITING → RUNNING → FINISHED → WRITTEN, with BROKEN (re-claimable) and
+    FAILED (given up after MAX_JOB_RETRIES) side states.
+    """
+
+    WAITING = 0
+    RUNNING = 1
+    BROKEN = 2
+    FINISHED = 3
+    WRITTEN = 4
+    FAILED = 5
+
+
+class TaskStatus(str, enum.Enum):
+    """Global task phase (reference utils.lua:42-46)."""
+
+    WAIT = "WAIT"
+    MAP = "MAP"
+    REDUCE = "REDUCE"
+    FINISHED = "FINISHED"

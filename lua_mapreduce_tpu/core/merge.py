@@ -1,0 +1,78 @@
+"""Streaming k-way merge of sorted run files.
+
+Analog of reference mapreduce/utils.lua:206-271 ``merge_iterator``: given a
+storage backend and a list of sorted run files (one per mapper, all for the
+same partition), heap-merge them and yield ``(key, values)`` with the value
+lists of equal keys concatenated across files — without materializing more
+than one record per file in memory (the reference streams GridFS chunks the
+same way, utils.lua:133-200).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Sequence, Tuple
+
+from lua_mapreduce_tpu.core.heap import Heap
+from lua_mapreduce_tpu.core.serialize import key_lt, load_record
+
+
+def merge_iterator(store, filenames: Sequence[str]) -> Iterator[Tuple[Any, List[Any]]]:
+    """Yield merged (key, values) pairs across sorted run files.
+
+    ``store`` is any object with ``lines(name) -> Iterator[str]`` (the fs
+    layer, SURVEY.md §1 L1). Mirrors utils.lua:206-271: ``take_next`` parses
+    one record per file (218-230); ``merge_min_keys`` concatenates the value
+    lists sharing the minimum key (232-247).
+    """
+    heap: Heap = Heap(lt=lambda a, b: key_lt(a[0], b[0]))
+    iters = []
+    for idx, name in enumerate(filenames):
+        it = store.lines(name)
+        iters.append(it)
+        rec = _take_next(it)
+        if rec is not None:
+            heap.push((rec[0], rec[1], idx))
+
+    while not heap.empty():
+        key, values, idx = heap.pop()
+        values = list(values)
+        # drain every file whose head shares this key
+        while not heap.empty() and not key_lt(key, heap.top()[0]):
+            _, more, jdx = heap.pop()
+            values.extend(more)
+            nxt = _take_next(iters[jdx])
+            if nxt is not None:
+                heap.push((nxt[0], nxt[1], jdx))
+        nxt = _take_next(iters[idx])
+        if nxt is not None:
+            heap.push((nxt[0], nxt[1], idx))
+        yield key, values
+
+
+def _take_next(it) -> Tuple[Any, List[Any]] | None:
+    """Parse the next record line from a file iterator (utils.lua:218-230)."""
+    for line in it:
+        line = line.strip()
+        if line:
+            return load_record(line)
+    return None
+
+
+def utest() -> None:
+    """Self-test: merge three sorted runs with overlapping keys."""
+    from lua_mapreduce_tpu.core.serialize import dump_record
+
+    class _MemStore:
+        def __init__(self, files):
+            self.files = files
+
+        def lines(self, name):
+            return iter(self.files[name])
+
+    store = _MemStore({
+        "a": [dump_record("apple", [1]), dump_record("cat", [1, 1])],
+        "b": [dump_record("apple", [2]), dump_record("bee", [5])],
+        "c": [dump_record("cat", [3])],
+    })
+    out = list(merge_iterator(store, ["a", "b", "c"]))
+    assert out == [("apple", [1, 2]), ("bee", [5]), ("cat", [1, 1, 3])], out

@@ -1,0 +1,130 @@
+"""Record serialization and canonical key ordering.
+
+Analog of reference mapreduce/utils.lua:100-128: the reference writes
+Lua-loadable lines ``return key,{v1,v2,...}\\n`` (utils.lua:107-120) and reads
+them back with ``load(line)()`` (utils.lua:222-224). Executing data as code is
+a Lua idiom, not a Python one — records here are single-line JSON arrays
+``[key, [values...]]``, which are safe to load, language-neutral, and
+streamable line-by-line through any storage backend.
+
+Also provides the canonical sort order for heterogeneous keys
+(utils.lua:123-128 sorts mixed-type keys by type then value) used by the map
+output sort and the k-way merge.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+from typing import Any, Iterable, List, Tuple as PyTuple
+
+from lua_mapreduce_tpu.core import tuples
+
+
+def dump_record(key: Any, values: Iterable[Any]) -> str:
+    """One record as a single JSON line (no trailing newline)."""
+    return json.dumps([_plain(key), [_plain(v) for v in values]],
+                      separators=(",", ":"), ensure_ascii=False)
+
+
+def load_record(line: str) -> PyTuple[Any, List[Any]]:
+    """Inverse of :func:`dump_record`. List-shaped keys come back interned."""
+    key, values = json.loads(line)
+    if isinstance(key, list):
+        key = tuples.intern(key)
+    return key, values
+
+
+def _plain(v: Any) -> Any:
+    """Strip Tuple subclass so json serializes it as an array."""
+    if isinstance(v, tuple):
+        return [_plain(x) for x in v]
+    return v
+
+
+def serialized_size(value: Any) -> int:
+    """Byte size of a value's serialized form — used for the taskfn value cap
+    (reference server.lua:263-267, MAX_TASKFN_VALUE_SIZE)."""
+    return len(json.dumps(_plain(value), separators=(",", ":")).encode())
+
+
+# --- canonical ordering for heterogeneous keys -----------------------------
+
+_TYPE_RANK = {bool: 0, int: 1, float: 1, str: 2, tuple: 3, type(None): 4}
+
+
+def type_rank(v: Any) -> int:
+    for t, r in _TYPE_RANK.items():
+        if isinstance(v, t):
+            return r
+    return 5
+
+
+def key_lt(a: Any, b: Any) -> bool:
+    """Total order over mixed-type keys: by type rank, then value.
+
+    Mirrors the reference's mixed-type key sort (utils.lua:123-128) which
+    compares ``tostring`` forms across types; here types are ranked and
+    values compared natively within a rank (tuples: elementwise recursive,
+    matching tuple.lua:183-201 lexicographic __lt).
+    """
+    ra, rb = type_rank(a), type_rank(b)
+    if ra != rb:
+        return ra < rb
+    if isinstance(a, tuple):
+        for x, y in zip(a, b):
+            if key_lt(x, y):
+                return True
+            if key_lt(y, x):
+                return False
+        return len(a) < len(b)
+    if a is None:
+        return False
+    return a < b
+
+
+def sorted_keys(keys: Iterable[Any]) -> List[Any]:
+    """Sort heterogeneous keys canonically (reference utils.lua:123-128)."""
+    return sorted(keys, key=functools.cmp_to_key(
+        lambda a, b: -1 if key_lt(a, b) else (1 if key_lt(b, a) else 0)))
+
+
+def assert_serializable(value: Any, path: str = "value") -> None:
+    """Validate a value is record-serializable (reference utils.lua:313-333
+    ``assert_check`` enforces JSON-compatible emit values)."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return
+    if isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            assert_serializable(v, f"{path}[{i}]")
+        return
+    if isinstance(value, dict):
+        for k, v in value.items():
+            if not isinstance(k, str):
+                raise TypeError(f"{path}: dict keys must be str, got {type(k)}")
+            assert_serializable(v, f"{path}.{k}")
+        return
+    raise TypeError(f"{path}: unserializable type {type(value).__name__}")
+
+
+def utest() -> None:
+    """Self-test (reference utils.lua:340-406 exercises serialization)."""
+    line = dump_record("word", [1, 2, 3])
+    assert load_record(line) == ("word", [1, 2, 3])
+
+    k, vs = load_record(dump_record(tuples.intern((1, "a")), [[2, 3]]))
+    assert k is tuples.intern((1, "a"))
+    assert vs == [[2, 3]]
+
+    assert key_lt(1, "a") and not key_lt("a", 1)
+    assert key_lt("a", "b")
+    assert key_lt((1, 2), (1, 3)) and key_lt((1,), (1, 2))
+    assert sorted_keys(["b", 2, "a", 1]) == [1, 2, "a", "b"]
+
+    assert serialized_size("xx") == 4  # '"xx"'
+    try:
+        assert_serializable({1: "bad"})  # type: ignore[dict-item]
+    except TypeError:
+        pass
+    else:  # pragma: no cover
+        raise AssertionError("non-str dict key must be rejected")

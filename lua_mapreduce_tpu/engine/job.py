@@ -1,0 +1,145 @@
+"""Map/reduce job execution — the host-side hot data path.
+
+Analog of reference mapreduce/job.lua (L3, SURVEY.md §3.3-3.4). Both the
+single-process LocalExecutor and the elastic workers execute jobs through
+these two functions, so the golden-diff semantics are identical everywhere.
+The TPU engine (parallel/) replaces this path with a jitted SPMD program when
+the user functions are JAX-traceable; this module remains the capability
+fallback for arbitrary Python functions (SURVEY.md §7 step 5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from lua_mapreduce_tpu.core import tuples
+from lua_mapreduce_tpu.core.constants import MAX_MAP_RESULT
+from lua_mapreduce_tpu.core.merge import merge_iterator
+from lua_mapreduce_tpu.core.serialize import (assert_serializable, dump_record,
+                                              sorted_keys)
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.store.base import Store
+
+
+@dataclasses.dataclass
+class JobTimes:
+    """Per-job timing for the stats subsystem (reference job.lua:117-152:
+    finished_time / written_time / cpu_time / real_time)."""
+    started: float
+    finished: float = 0.0
+    written: float = 0.0
+    cpu: float = 0.0
+
+    @property
+    def real(self) -> float:
+        return self.written - self.started
+
+
+def _intern_if_seq(v: Any) -> Any:
+    return tuples.intern(v) if isinstance(v, (list, tuple)) else v
+
+
+def make_map_emit(result: Dict[Any, List[Any]], combiner):
+    """Build the map-side ``emit`` closure (reference job.lua:66-97).
+
+    Groups values per interned key in memory; when a key accumulates more
+    than MAX_MAP_RESULT values and a combiner exists, combine in place
+    (job.lua:92-96) to bound memory.
+    """
+    def emit(key: Any, value: Any) -> None:
+        key = _intern_if_seq(key)
+        value = _intern_if_seq(value)
+        bucket = result.get(key)
+        if bucket is None:
+            bucket = result[key] = []
+        bucket.append(value)
+        if combiner is not None and len(bucket) > MAX_MAP_RESULT:
+            result[key] = [combiner(key, bucket)]
+    return emit
+
+
+def map_output_name(result_ns: str, part: int, map_key: str) -> str:
+    """Intermediate run-file name ``<ns>.P<part>.M<mapkey>``
+    (reference job.lua:208-214)."""
+    return f"{result_ns}.P{part}.M{map_key}"
+
+
+def run_map_job(spec: TaskSpec, store: Store, job_id: str,
+                map_key: Any, map_value: Any) -> JobTimes:
+    """Execute one map job and write per-partition sorted run files.
+
+    Mirrors job.lua:154-228: run user mapfn with the grouping emit; sort
+    keys; apply combiner per key; route keys through partitionfn; write one
+    atomic file per non-empty partition; remove any stale file first (the
+    re-run / iteration case, job.lua:217-221).
+    """
+    times = JobTimes(started=time.time())
+    cpu0 = time.process_time()
+
+    result: Dict[Any, List[Any]] = {}
+    combiner = spec.combiner_for_map
+    emit = make_map_emit(result, combiner)
+    spec.mapfn(map_key, map_value, emit)
+    times.finished = time.time()
+
+    builders: Dict[int, Any] = {}
+    for key in sorted_keys(result.keys()):
+        values = result[key]
+        if combiner is not None and len(values) > 1:
+            values = [combiner(key, values)]
+        for v in values:
+            assert_serializable(v, f"map value for key {key!r}")
+        part = int(spec.partitionfn(key))
+        if part < 0:
+            raise ValueError(f"partitionfn({key!r}) returned negative {part}")
+        b = builders.get(part)
+        if b is None:
+            b = builders[part] = store.builder()
+        b.write(dump_record(key, values) + "\n")
+
+    for part, b in builders.items():
+        name = map_output_name(spec.result_ns, part, job_id)
+        store.remove(name)
+        b.build(name)
+
+    times.cpu = time.process_time() - cpu0
+    times.written = time.time()
+    return times
+
+
+def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
+                   part_key: str, run_files: List[str],
+                   result_file: str) -> JobTimes:
+    """Execute one reduce job: k-way merge all mappers' runs for a
+    partition, fold with reducefn, publish the partition result.
+
+    Mirrors job.lua:230-296: the fast path for flagged reducers skips
+    reducefn on singleton groups (264-275); results always land in the
+    *result* store regardless of the intermediate backend (249-251, 287);
+    consumed run files are deleted after success (293).
+    """
+    times = JobTimes(started=time.time())
+    cpu0 = time.process_time()
+
+    builder = result_store.builder()
+    fast = spec.fast_path
+    reducefn = spec.reducefn
+    for key, values in merge_iterator(store, run_files):
+        if fast and len(values) == 1:
+            reduced = values[0]
+        else:
+            reduced = reducefn(key, values)
+        assert_serializable(reduced, f"reduce value for key {key!r}")
+        builder.write(dump_record(key, [reduced]) + "\n")
+    times.finished = time.time()
+
+    result_store.remove(result_file)
+    builder.build(result_file)
+    times.cpu = time.process_time() - cpu0
+    times.written = time.time()
+
+    for name in run_files:
+        store.remove(name)
+    return times

@@ -1,0 +1,184 @@
+"""Single-process MapReduce executor.
+
+The minimum end-to-end engine (SURVEY.md §7 step 2): runs the full
+taskfn → map → shuffle → reduce → finalfn cycle, including the ``"loop"``
+iteration protocol, in one process with no coordinator. Semantics are
+identical to the distributed engine because both drive engine/job.py; this
+is the golden-diff reference implementation (analog of running the
+reference with one worker).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from lua_mapreduce_tpu.core.constants import MAX_TASKFN_VALUE_SIZE
+from lua_mapreduce_tpu.core.serialize import load_record, serialized_size
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.job import (JobTimes, run_map_job,
+                                          run_reduce_job)
+from lua_mapreduce_tpu.store.router import get_storage_from
+from lua_mapreduce_tpu.utils.stats import IterationStats, TaskStats
+
+
+def collect_task_jobs(spec: TaskSpec) -> List[Tuple[Any, Any]]:
+    """Run taskfn and validate its emissions.
+
+    Mirrors server_prepare_map (server.lua:249-276): duplicate job keys are
+    an error (259-261); serialized job values are capped at
+    MAX_TASKFN_VALUE_SIZE (263-267).
+    """
+    jobs: List[Tuple[Any, Any]] = []
+    seen = set()
+
+    def emit(key: Any, value: Any) -> None:
+        if key in seen:
+            raise ValueError(f"taskfn emitted duplicate job key {key!r} "
+                             "(reference server.lua:259-261)")
+        seen.add(key)
+        size = serialized_size(value)
+        if size > MAX_TASKFN_VALUE_SIZE:
+            raise ValueError(
+                f"taskfn value for key {key!r} is {size} bytes; max is "
+                f"{MAX_TASKFN_VALUE_SIZE} (reference server.lua:263-267)")
+        jobs.append((key, value))
+
+    spec.taskfn(emit)
+    return jobs
+
+
+_PART_RE_TMPL = r"^{ns}\.P(\d+)\.M(.+)$"
+
+
+def discover_partitions(store, result_ns: str) -> Dict[int, List[str]]:
+    """List map-output run files and group them by partition
+    (server_prepare_reduce, server.lua:291-312). Empty partitions simply
+    produce no reduce job (BASELINE.md note)."""
+    pat = re.compile(_PART_RE_TMPL.format(ns=re.escape(result_ns)))
+    parts: Dict[int, List[str]] = {}
+    for name in store.list(f"{result_ns}.P*.M*"):
+        m = pat.match(name)
+        if m:
+            parts.setdefault(int(m.group(1)), []).append(name)
+    return parts
+
+
+def result_file_name(result_ns: str, part: int) -> str:
+    return f"{result_ns}.P{part}"
+
+
+def iter_results(result_store, result_ns: str) -> Iterator[Tuple[Any, List[Any]]]:
+    """Yield (key, values) over all partition result files in sorted file
+    order — the finalfn pair iterator (server.lua:353-385)."""
+    pat = re.compile(rf"^{re.escape(result_ns)}\.P(\d+)$")
+    names = [n for n in result_store.list(f"{result_ns}.P*") if pat.match(n)]
+    names.sort(key=lambda n: int(pat.match(n).group(1)))
+    for name in names:
+        for line in result_store.lines(name):
+            line = line.strip()
+            if line:
+                yield load_record(line)
+
+
+def delete_results(result_store, result_ns: str) -> None:
+    """Drop all partition result files (server.lua:406-412 gc)."""
+    pat = re.compile(rf"^{re.escape(result_ns)}\.P(\d+)$")
+    for name in result_store.list(f"{result_ns}.P*"):
+        if pat.match(name):
+            result_store.remove(name)
+
+
+class LocalExecutor:
+    """Run a TaskSpec to completion in-process.
+
+    ``map_parallelism`` > 1 runs map/reduce jobs on a thread pool — the
+    in-process analog of N workers (useful for IO-bound user functions; the
+    distributed engine is the real scale path).
+    """
+
+    def __init__(self, spec: TaskSpec, map_parallelism: int = 1,
+                 max_iterations: int = 1000):
+        self.spec = spec
+        self.map_parallelism = max(1, map_parallelism)
+        self.max_iterations = max_iterations
+        self.store = get_storage_from(spec.storage)
+        self.result_store = (get_storage_from(spec.result_storage)
+                             if spec.result_storage else self.store)
+        self.stats = TaskStats()
+        self.finished_value: Any = None
+
+    def _run_jobs(self, fns) -> List[JobTimes]:
+        if self.map_parallelism == 1 or len(fns) <= 1:
+            return [fn() for fn in fns]
+        with ThreadPoolExecutor(max_workers=self.map_parallelism) as pool:
+            return list(pool.map(lambda fn: fn(), fns))
+
+    def run_one_iteration(self, iteration: int) -> Any:
+        """One map→shuffle→reduce→final cycle; returns finalfn's verdict."""
+        spec = self.spec
+        it_stats = IterationStats(iteration=iteration)
+        t0 = time.time()
+
+        # fresh result namespace per iteration — partitions that receive no
+        # data this iteration must not leak last iteration's results
+        # (reference drops collections per iteration, server.lua:331-345)
+        delete_results(self.result_store, spec.result_ns)
+
+        jobs = collect_task_jobs(spec)
+        map_times = self._run_jobs([
+            (lambda k=k, v=v, i=i: run_map_job(spec, self.store, str(i), k, v))
+            for i, (k, v) in enumerate(jobs)])
+        it_stats.map.fold(map_times)
+
+        parts = discover_partitions(self.store, spec.result_ns)
+        reduce_times = self._run_jobs([
+            (lambda p=p, files=files: run_reduce_job(
+                spec, self.store, self.result_store, str(p), files,
+                result_file_name(spec.result_ns, p)))
+            for p, files in sorted(parts.items())])
+        it_stats.reduce.fold(reduce_times)
+
+        # no finalfn → finish and keep results (True would gc them)
+        verdict: Any = None
+        if spec.finalfn is not None:
+            verdict = spec.finalfn(iter_results(self.result_store,
+                                                spec.result_ns))
+        it_stats.wall_time = time.time() - t0
+        self.stats.iterations.append(it_stats)
+        return verdict
+
+    def clean_namespace(self) -> None:
+        """Drop every file under this task's result namespace in both
+        stores (analog of server_drop_collections + remove_pending_tasks,
+        server.lua:331-345, 237-245)."""
+        for store in {id(self.store): self.store,
+                      id(self.result_store): self.result_store}.values():
+            for name in store.list(f"{self.spec.result_ns}.P*"):
+                store.remove(name)
+
+    def run(self) -> TaskStats:
+        """Run iterations until finalfn stops looping (server.lua:466-611,
+        387-403: "loop" → repeat; True → drop results; else keep)."""
+        self.clean_namespace()
+        t0 = time.time()
+        iteration = 1
+        while iteration <= self.max_iterations:
+            verdict = self.run_one_iteration(iteration)
+            if verdict == "loop":
+                iteration += 1
+                continue
+            self.finished_value = verdict
+            if verdict is True:
+                delete_results(self.result_store, self.spec.result_ns)
+            break
+        else:
+            raise RuntimeError(f"exceeded max_iterations={self.max_iterations}")
+        self.stats.wall_time = time.time() - t0
+        return self.stats
+
+    def results(self) -> Iterator[Tuple[Any, List[Any]]]:
+        """Iterate final results (valid when finalfn did not return True)."""
+        return iter_results(self.result_store, self.spec.result_ns)

@@ -1,0 +1,19 @@
+"""Intermediate storage ("fs") layer.
+
+Analog of reference L1 (SURVEY.md §1): mapreduce/fs.lua's three pluggable
+backends for intermediate map outputs and reduce results. The TPU-native
+mapping (SURVEY.md §5 "Distributed communication backend"):
+
+- ``mem``    — host-DRAM store (GridFS analog; the default fast path)
+- ``shared`` — shared POSIX directory (sharedfs analog: NFS/samba)
+- ``object`` — object-store layout with local emulation (GCS spill; plays
+               the role of sshfs's pull-from-producer pattern across hosts)
+
+Reference backend names (``gridfs``/``shared``/``sshfs``) are accepted as
+aliases by the router.
+"""
+
+from lua_mapreduce_tpu.store.base import Store, FileBuilder
+from lua_mapreduce_tpu.store.router import get_storage_from, router
+
+__all__ = ["Store", "FileBuilder", "get_storage_from", "router"]

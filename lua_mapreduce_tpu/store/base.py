@@ -1,0 +1,63 @@
+"""Storage backend interface.
+
+The reference's fs layer hands out three things per backend — an fs object,
+a file-builder factory, and a lines-iterator factory (fs.lua:185-208,
+255-257). Here a single :class:`Store` object carries all three roles:
+``builder()`` (atomic writer), ``lines()`` (streaming reader), plus
+list/remove/exists.
+"""
+
+from __future__ import annotations
+
+import abc
+import fnmatch
+from typing import Iterator, List
+
+
+class FileBuilder(abc.ABC):
+    """Accumulate lines, then atomically publish as a named file.
+
+    Mirrors reference fs.lua:80-115 (tmpfile + atomic rename) and GridFS's
+    GridFileBuilder (cnn.lua:51-56): readers never observe partial files.
+    """
+
+    @abc.abstractmethod
+    def write(self, data: str) -> None:
+        """Append ``data`` (caller supplies newlines)."""
+
+    @abc.abstractmethod
+    def build(self, name: str) -> None:
+        """Atomically publish the accumulated content as ``name``."""
+
+
+class Store(abc.ABC):
+    """A named-file store with streaming line reads and glob listing."""
+
+    @abc.abstractmethod
+    def builder(self) -> FileBuilder:
+        ...
+
+    @abc.abstractmethod
+    def lines(self, name: str) -> Iterator[str]:
+        """Stream the lines of ``name`` (analog utils.lua:133-200
+        gridfs_lines_iterator — never loads the whole file)."""
+
+    @abc.abstractmethod
+    def list(self, pattern: str) -> List[str]:
+        """Names matching a shell glob, sorted (analog fs.lua:119-137's
+        ``ls -d`` listing and cnn gridfs ``$regex`` listing; the glob ↔ regex
+        conversion lives in fs.lua:35-38)."""
+
+    @abc.abstractmethod
+    def exists(self, name: str) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def remove(self, name: str) -> None:
+        """Delete ``name`` if present (idempotent)."""
+
+    # -- shared helpers ----------------------------------------------------
+
+    @staticmethod
+    def _match(names, pattern: str) -> List[str]:
+        return sorted(n for n in names if fnmatch.fnmatchcase(n, pattern))

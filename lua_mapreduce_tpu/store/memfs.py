@@ -1,0 +1,57 @@
+"""In-memory (host DRAM) storage backend.
+
+The GridFS analog (SURVEY.md §7 step 3): on TPU VMs intermediate shuffle data
+stays in host DRAM; this is the default backend and the fastest. Thread-safe
+so an in-process elastic worker pool can share it.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+from typing import Dict, Iterator, List
+
+from lua_mapreduce_tpu.store.base import FileBuilder, Store
+
+
+class _MemBuilder(FileBuilder):
+    def __init__(self, store: "MemStore"):
+        self._store = store
+        self._buf = io.StringIO()
+
+    def write(self, data: str) -> None:
+        self._buf.write(data)
+
+    def build(self, name: str) -> None:
+        data = self._buf.getvalue()
+        with self._store._lock:
+            self._store._files[name] = data
+
+
+class MemStore(Store):
+    """Dict-of-files store; ``build`` swaps content in atomically."""
+
+    def __init__(self):
+        self._files: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def builder(self) -> FileBuilder:
+        return _MemBuilder(self)
+
+    def lines(self, name: str) -> Iterator[str]:
+        with self._lock:
+            data = self._files[name]
+        return iter(io.StringIO(data))
+
+    def list(self, pattern: str) -> List[str]:
+        with self._lock:
+            names = list(self._files)
+        return self._match(names, pattern)
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._files
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._files.pop(name, None)

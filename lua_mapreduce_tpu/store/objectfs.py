@@ -1,0 +1,115 @@
+"""Object-store storage backend (GCS spill), with local emulation.
+
+The reference's third backend is ``sshfs``: mappers write locally and the
+reducer *pulls* the runs from each producer host via ``scp``
+(fs.lua:143-160, 196-199). The TPU-native equivalent of "spill that survives
+the producer and is pulled by the consumer" is an object store (GCS). Real
+GCS is gated behind ``google.cloud.storage`` being importable (not baked into
+this image — zero egress); otherwise a bucket is emulated as a local
+directory with strict object semantics: whole-object PUT (no append, no
+rename visible to readers) and GET, which is exactly GCS's contract.
+
+URI forms accepted: ``object:/abs/dir``, ``object:relative/dir``,
+``object:gs://bucket/prefix`` (real GCS only).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Iterator, List
+
+from lua_mapreduce_tpu.store.base import FileBuilder, Store
+from lua_mapreduce_tpu.store.sharedfs import _decode, _encode
+
+
+class _ObjectBuilder(FileBuilder):
+    """Buffer locally, publish with a single whole-object PUT."""
+
+    def __init__(self, store: "ObjectStore"):
+        self._store = store
+        fd, self._tmp = tempfile.mkstemp(prefix="objfs.")
+        self._f = os.fdopen(fd, "w")
+
+    def write(self, data: str) -> None:
+        self._f.write(data)
+
+    def build(self, name: str) -> None:
+        self._f.close()
+        with open(self._tmp, "rb") as f:
+            self._store._put(name, f.read())
+        os.remove(self._tmp)
+
+
+class ObjectStore(Store):
+    def __init__(self, uri: str):
+        if uri.startswith("gs://"):
+            try:
+                from google.cloud import storage as gcs  # type: ignore
+            except ImportError as e:  # pragma: no cover - gated capability
+                raise RuntimeError(
+                    "object:gs:// storage needs google-cloud-storage; use a "
+                    "local path (object:/dir) on machines without it") from e
+            bucket, _, prefix = uri[5:].partition("/")
+            self._gcs = gcs.Client().bucket(bucket)  # pragma: no cover
+            self._prefix = prefix
+            self._dir = None
+        else:
+            self._gcs = None
+            self._dir = uri
+            os.makedirs(uri, exist_ok=True)
+
+    # -- object primitives (PUT/GET/LIST/DELETE only — no rename/append) ---
+
+    def _put(self, name: str, data: bytes) -> None:
+        if self._gcs is not None:  # pragma: no cover - needs real GCS
+            self._gcs.blob(self._key(name)).upload_from_string(data)
+            return
+        # local emulation still publishes atomically so concurrent readers
+        # in the same emulated "bucket" never see a partial object
+        fd, tmp = tempfile.mkstemp(dir=self._dir, prefix=".put.")
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, os.path.join(self._dir, _encode(name)))
+
+    def _get(self, name: str) -> bytes:
+        if self._gcs is not None:  # pragma: no cover
+            return self._gcs.blob(self._key(name)).download_as_bytes()
+        with open(os.path.join(self._dir, _encode(name)), "rb") as f:
+            return f.read()
+
+    def _key(self, name: str) -> str:  # pragma: no cover - GCS path
+        return f"{self._prefix}/{name}" if self._prefix else name
+
+    # -- Store API ---------------------------------------------------------
+
+    def builder(self) -> FileBuilder:
+        return _ObjectBuilder(self)
+
+    def lines(self, name: str) -> Iterator[str]:
+        data = self._get(name).decode()
+        for line in data.splitlines(keepends=True):
+            yield line
+
+    def list(self, pattern: str) -> List[str]:
+        if self._gcs is not None:  # pragma: no cover
+            names = [b.name[len(self._prefix) + 1 if self._prefix else 0:]
+                     for b in self._gcs.list_blobs(prefix=self._prefix)]
+        else:
+            names = [_decode(f) for f in os.listdir(self._dir)
+                     if not f.startswith(".put.")]
+        return self._match(names, pattern)
+
+    def exists(self, name: str) -> bool:
+        if self._gcs is not None:  # pragma: no cover
+            return self._gcs.blob(self._key(name)).exists()
+        return os.path.exists(os.path.join(self._dir, _encode(name)))
+
+    def remove(self, name: str) -> None:
+        if self._gcs is not None:  # pragma: no cover
+            self._gcs.blob(self._key(name)).delete()
+            return
+        try:
+            os.remove(os.path.join(self._dir, _encode(name)))
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,66 @@
+"""Storage spec parsing and backend routing.
+
+Analog of reference utils.lua:273-285 (``get_storage_from`` parses
+"backend[:path]") and fs.lua:185-208 (``router`` returns the backend).
+Reference names are aliased to their TPU-native replacements (see
+store/__init__.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from lua_mapreduce_tpu.store.base import Store
+from lua_mapreduce_tpu.store.memfs import MemStore
+from lua_mapreduce_tpu.store.objectfs import ObjectStore
+from lua_mapreduce_tpu.store.sharedfs import SharedStore
+
+_ALIASES = {
+    "gridfs": "mem",       # GridFS → host DRAM
+    "shared": "shared",
+    "sharedfs": "shared",
+    "sshfs": "object",     # pull-from-producer → object-store spill
+    "mem": "mem",
+    "object": "object",
+    "gcs": "object",
+}
+
+# process-wide mem stores by tag so server + in-process workers share one
+_mem_stores: dict = {}
+
+
+def parse_storage(spec: str) -> Tuple[str, Optional[str]]:
+    """Parse "backend[:path]" → (backend, path) (utils.lua:273-285)."""
+    backend, sep, path = spec.partition(":")
+    backend = _ALIASES.get(backend)
+    if backend is None:
+        raise ValueError(f"unknown storage backend in spec {spec!r}; "
+                         f"use one of {sorted(set(_ALIASES))}")
+    if backend != "mem" and not sep:
+        raise ValueError(f"storage {spec!r} needs a path: 'backend:path'")
+    return backend, (path if sep else None)
+
+
+def get_storage_from(spec: str) -> Store:
+    """Build the Store for a "backend[:path]" spec string.
+
+    Bare ``mem`` returns a *fresh private* store (two unrelated tasks must
+    not clobber each other's namespaces); ``mem:tag`` returns the
+    process-wide shared store for that tag (how a server and in-process
+    workers share intermediate data).
+    """
+    backend, path = parse_storage(spec)
+    if backend == "mem":
+        if path is None:
+            return MemStore()
+        if path not in _mem_stores:
+            _mem_stores[path] = MemStore()
+        return _mem_stores[path]
+    if backend == "shared":
+        return SharedStore(path)
+    return ObjectStore(path)
+
+
+def router(spec: str) -> Store:
+    """Reference-named alias of :func:`get_storage_from` (fs.lua:185-208)."""
+    return get_storage_from(spec)

@@ -1,0 +1,75 @@
+"""Shared POSIX-directory storage backend.
+
+Analog of reference fs.lua's ``shared`` backend (fs.lua:42-77, 119-137): a
+directory on a filesystem visible to every worker (NFS/samba on the
+reference's clusters; a bind-mounted path across TPU-VM hosts here).
+Builders write to a tempfile and atomically ``os.replace`` into place, the
+same tmp+rename discipline as fs.lua:80-115.
+
+File names may contain ``/`` — they are flattened with an escape so one task
+namespace maps onto one flat directory (keeps glob listing trivial and safe).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import tempfile
+from typing import Iterator, List
+
+from lua_mapreduce_tpu.store.base import FileBuilder, Store
+
+
+def _encode(name: str) -> str:
+    return name.replace("%", "%25").replace("/", "%2F")
+
+
+def _decode(fname: str) -> str:
+    return fname.replace("%2F", "/").replace("%25", "%")
+
+
+class _DirBuilder(FileBuilder):
+    def __init__(self, store: "SharedStore"):
+        self._store = store
+        fd, self._tmp = tempfile.mkstemp(dir=store.path, prefix=".tmp.")
+        self._f = os.fdopen(fd, "w")
+
+    def write(self, data: str) -> None:
+        self._f.write(data)
+
+    def build(self, name: str) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        os.replace(self._tmp, os.path.join(self._store.path, _encode(name)))
+
+
+class SharedStore(Store):
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)  # fs.lua sharedfs mkdir -p
+
+    def builder(self) -> FileBuilder:
+        return _DirBuilder(self)
+
+    def lines(self, name: str) -> Iterator[str]:
+        with open(os.path.join(self.path, _encode(name))) as f:
+            yield from f
+
+    def list(self, pattern: str) -> List[str]:
+        names = []
+        for p in _glob.glob(os.path.join(self.path, "*")):
+            base = os.path.basename(p)
+            if base.startswith(".tmp."):
+                continue
+            names.append(_decode(base))
+        return self._match(names, pattern)
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(os.path.join(self.path, _encode(name)))
+
+    def remove(self, name: str) -> None:
+        try:
+            os.remove(os.path.join(self.path, _encode(name)))
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,80 @@
+"""Per-phase / per-iteration statistics.
+
+Analog of the reference's tracing subsystem (SURVEY.md §5): per-job
+lifecycle timestamps (creation/started/finished/written, cpu_time,
+real_time — job.lua:117-152, task.lua:294-299) aggregated into per-phase
+sums and cluster wall time = max(written) − min(started)
+(server.lua:155-183). The reference computes the aggregation with MongoDB
+server-side JavaScript mapreduce; here it is a plain fold over JobTimes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from lua_mapreduce_tpu.engine.job import JobTimes
+
+
+@dataclasses.dataclass
+class PhaseStats:
+    """One phase's aggregate (reference stats schema task.lua:44-56)."""
+    count: int = 0
+    failed: int = 0
+    sum_cpu_time: float = 0.0
+    sum_real_time: float = 0.0
+    cluster_time: float = 0.0   # max(written) - min(started)
+
+    def fold(self, times: List[JobTimes], failed: int = 0) -> "PhaseStats":
+        self.count = len(times)
+        self.failed = failed
+        if times:
+            self.sum_cpu_time = sum(t.cpu for t in times)
+            self.sum_real_time = sum(t.real for t in times)
+            self.cluster_time = (max(t.written for t in times) -
+                                 min(t.started for t in times))
+        return self
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class IterationStats:
+    """Stats for one map→reduce iteration (server.lua:536-601)."""
+    iteration: int
+    map: PhaseStats = dataclasses.field(default_factory=PhaseStats)
+    reduce: PhaseStats = dataclasses.field(default_factory=PhaseStats)
+    wall_time: float = 0.0
+
+    @property
+    def cluster_time(self) -> float:
+        """map+reduce cluster time — the reference's headline metric
+        (README.md:68-70)."""
+        return self.map.cluster_time + self.reduce.cluster_time
+
+    def as_dict(self) -> dict:
+        return {
+            "iteration": self.iteration,
+            "map": self.map.as_dict(),
+            "reduce": self.reduce.as_dict(),
+            "cluster_time": self.cluster_time,
+            "wall_time": self.wall_time,
+        }
+
+
+@dataclasses.dataclass
+class TaskStats:
+    """Whole-task stats across iterations."""
+    iterations: List[IterationStats] = dataclasses.field(default_factory=list)
+    wall_time: float = 0.0
+
+    @property
+    def last(self) -> Optional[IterationStats]:
+        return self.iterations[-1] if self.iterations else None
+
+    def as_dict(self) -> dict:
+        return {
+            "iterations": [s.as_dict() for s in self.iterations],
+            "wall_time": self.wall_time,
+        }

@@ -1,0 +1,22 @@
+"""Test harness configuration.
+
+Multi-chip behavior is tested on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``), standing in for a TPU pod
+slice — the analog of the reference's Travis single-box "multi-node"
+simulation (.travis.yml:10-18, SURVEY.md §4). The axon TPU plugin registers
+itself at interpreter start, so the platform is forced back to CPU via
+jax.config (env vars alone are overridden by the plugin).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
+
+try:
+    import jax  # noqa: E402
+except ImportError:  # pure-host layers are testable without jax
+    jax = None
+else:
+    jax.config.update("jax_platforms", "cpu")

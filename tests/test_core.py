@@ -1,0 +1,72 @@
+"""Core data-model tests: tuples, heap, serialization, merge.
+
+Analog of the reference's per-module utest() asserts (SURVEY.md §4):
+tuple.lua:309-328, heap.lua:99-118, utils.lua:340-406.
+"""
+
+from lua_mapreduce_tpu.core import heap, merge, serialize, tuples
+
+
+def test_tuples_utest():
+    tuples.utest()
+
+
+def test_heap_utest():
+    heap.utest()
+
+
+def test_serialize_utest():
+    serialize.utest()
+
+
+def test_merge_utest():
+    merge.utest()
+
+
+def test_tuple_intern_table_is_bounded():
+    t = tuples.intern(("bounded-key", 1))
+    assert tuples.stats()["size"] <= tuples._MAX_ENTRIES
+    # force overflow: table clears rather than growing without bound
+    tuples._table.clear()
+    for i in range(10):
+        tuples.intern((i,))
+    old_max, tuples._MAX_ENTRIES = tuples._MAX_ENTRIES, 10
+    try:
+        tuples.intern(("overflow",))
+        assert tuples.stats()["size"] <= 10
+    finally:
+        tuples._MAX_ENTRIES = old_max
+    assert tuples.intern(("bounded-key", 1)) == t
+
+
+def test_record_roundtrip_unicode_and_nesting():
+    rec = serialize.dump_record("wörd\t\"quoted\"", [1, [2, "x"], None, True])
+    key, values = serialize.load_record(rec)
+    assert key == "wörd\t\"quoted\""
+    assert values == [1, [2, "x"], None, True]
+
+
+def test_key_order_total_on_mixed_types():
+    keys = ["z", 3, (1, 2), "a", 1, (1,), None, 2.5]
+    s = serialize.sorted_keys(keys)
+    # numbers < strings < tuples < None (stable total order)
+    assert s == [1, 2.5, 3, "a", "z", (1,), (1, 2), None]
+
+
+def test_merge_many_files_interleaved():
+    from lua_mapreduce_tpu.store.memfs import MemStore
+    store = MemStore()
+    n_files, n_keys = 7, 50
+    expected = {}
+    for i in range(n_files):
+        b = store.builder()
+        for k in range(i % 3, n_keys, 2):  # overlapping, sorted, unique keys
+            key = f"k{k:04d}"
+            b.write(serialize.dump_record(key, [i]) + "\n")
+            expected.setdefault(key, []).append(i)
+        b.build(f"run.{i}")
+    merged = dict(merge.merge_iterator(store, [f"run.{i}" for i in range(n_files)]))
+    assert {k: sorted(v) for k, v in merged.items()} == \
+           {k: sorted(v) for k, v in expected.items()}
+    # keys come out in sorted order
+    assert list(merged) == sorted(merged)

@@ -1,0 +1,74 @@
+"""Storage backend tests (analog fs.lua:213-251 utest: round-trip
+build/list/read/remove through every backend)."""
+
+import pytest
+
+from lua_mapreduce_tpu.store.memfs import MemStore
+from lua_mapreduce_tpu.store.objectfs import ObjectStore
+from lua_mapreduce_tpu.store.router import get_storage_from, parse_storage
+from lua_mapreduce_tpu.store.sharedfs import SharedStore
+
+
+def _backends(tmp_path):
+    return [
+        MemStore(),
+        SharedStore(str(tmp_path / "shared")),
+        ObjectStore(str(tmp_path / "object")),
+    ]
+
+
+@pytest.mark.parametrize("idx", [0, 1, 2])
+def test_roundtrip_build_list_read_remove(tmp_path, idx):
+    store = _backends(tmp_path)[idx]
+    b = store.builder()
+    b.write("line one\n")
+    b.write("line two\n")
+    b.build("ns.P3.M7")
+
+    b2 = store.builder()
+    b2.write("other\n")
+    b2.build("ns.P4.M7")
+
+    assert store.exists("ns.P3.M7")
+    assert store.list("ns.P*.M*") == ["ns.P3.M7", "ns.P4.M7"]
+    assert store.list("ns.P3.*") == ["ns.P3.M7"]
+    assert list(store.lines("ns.P3.M7")) == ["line one\n", "line two\n"]
+
+    store.remove("ns.P3.M7")
+    assert not store.exists("ns.P3.M7")
+    store.remove("ns.P3.M7")  # idempotent
+    assert store.list("ns.P*.M*") == ["ns.P4.M7"]
+
+
+@pytest.mark.parametrize("idx", [0, 1, 2])
+def test_build_overwrites_atomically(tmp_path, idx):
+    store = _backends(tmp_path)[idx]
+    for content in ("v1\n", "v2\n"):
+        b = store.builder()
+        b.write(content)
+        b.build("f")
+    assert list(store.lines("f")) == ["v2\n"]
+
+
+def test_names_with_slashes(tmp_path):
+    for store in _backends(tmp_path):
+        b = store.builder()
+        b.write("x\n")
+        b.build("dir/sub.P0.M1")
+        assert store.list("dir/sub.P*.M*") == ["dir/sub.P0.M1"]
+        assert list(store.lines("dir/sub.P0.M1")) == ["x\n"]
+
+
+def test_router_spec_parsing(tmp_path):
+    assert parse_storage("mem") == ("mem", None)
+    assert parse_storage("gridfs") == ("mem", None)
+    assert parse_storage(f"shared:{tmp_path}") == ("shared", str(tmp_path))
+    assert parse_storage(f"sshfs:{tmp_path}") == ("object", str(tmp_path))
+    with pytest.raises(ValueError):
+        parse_storage("bogus:x")
+    with pytest.raises(ValueError):
+        parse_storage("shared")  # needs a path
+
+    s1 = get_storage_from("mem:tagA")
+    s2 = get_storage_from("mem:tagA")
+    assert s1 is s2  # process-wide shared instance per tag

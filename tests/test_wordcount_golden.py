@@ -1,0 +1,192 @@
+"""Golden-diff WordCount matrix — the end-to-end correctness harness.
+
+Analog of reference test.sh:8-73: for each storage backend × engine config
+(combiner + flagged reducer; no combiner + flagged reducer; general
+unflagged reducer; single-module packaging), run WordCount over the
+framework's own source files and diff the result against the naive
+single-process golden count (misc/naive.lua analog).
+"""
+
+import glob
+import os
+
+import pytest
+
+from examples.wordcount.naive import naive_wordcount
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.local import LocalExecutor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = sorted(glob.glob(os.path.join(REPO, "lua_mapreduce_tpu", "**", "*.py"),
+                          recursive=True))
+
+CONFIGS = {
+    "combiner": dict(
+        taskfn="examples.wordcount.taskfn",
+        mapfn="examples.wordcount.mapfn",
+        partitionfn="examples.wordcount.partitionfn",
+        reducefn="examples.wordcount.reducefn",
+        combinerfn="examples.wordcount.reducefn",
+        finalfn="examples.wordcount.finalfn",
+    ),
+    "no_combiner": dict(
+        taskfn="examples.wordcount.taskfn",
+        mapfn="examples.wordcount.mapfn",
+        partitionfn="examples.wordcount.partitionfn",
+        reducefn="examples.wordcount.reducefn",
+        finalfn="examples.wordcount.finalfn",
+    ),
+    "general_reducer": dict(
+        taskfn="examples.wordcount.taskfn",
+        mapfn="examples.wordcount.mapfn",
+        partitionfn="examples.wordcount.partitionfn",
+        reducefn="examples.wordcount.reducefn2",
+        finalfn="examples.wordcount.finalfn",
+    ),
+    "single_module": dict(
+        taskfn="examples.wordcount.single",
+        mapfn="examples.wordcount.single",
+        partitionfn="examples.wordcount.single",
+        reducefn="examples.wordcount.single",
+        combinerfn="examples.wordcount.single",
+        finalfn="examples.wordcount.single",
+    ),
+}
+
+
+def _storages(tmp_path, tag):
+    return [
+        f"mem:{tag}",
+        f"shared:{tmp_path}/shared",
+        f"object:{tmp_path}/object",
+    ]
+
+
+def _counts_module(config):
+    if config == "single_module":
+        import examples.wordcount.single as m
+    else:
+        import examples.wordcount.finalfn as m
+    return m
+
+
+@pytest.mark.parametrize("config", list(CONFIGS))
+@pytest.mark.parametrize("storage_idx", [0, 1, 2],
+                         ids=["mem", "shared", "object"])
+def test_wordcount_matches_naive(tmp_path, config, storage_idx):
+    golden = naive_wordcount(CORPUS)
+    storage = _storages(tmp_path, f"wc-{config}-{storage_idx}")[storage_idx]
+    spec = TaskSpec(init_args={"files": CORPUS}, storage=storage,
+                    **CONFIGS[config])
+    ex = LocalExecutor(spec, map_parallelism=4)
+    stats = ex.run()
+
+    got = dict(_counts_module(config).counts)
+    assert got == golden
+
+    it = stats.iterations[-1]
+    assert it.map.count == len(CORPUS)
+    assert 0 < it.reduce.count <= 15   # ≤ NUM_REDUCERS; empty parts tolerated
+    assert it.map.failed == 0 and it.reduce.failed == 0
+    assert stats.wall_time > 0
+
+
+def test_single_module_init_called_once(tmp_path):
+    import examples.wordcount.single as single
+    before = single._init_calls
+    TaskSpec(init_args={"files": CORPUS[:2]}, storage=f"mem:initdedup",
+             **CONFIGS["single_module"])
+    assert single._init_calls == before + 1
+
+
+def test_taskfn_duplicate_keys_rejected():
+    def bad_taskfn(emit):
+        emit(1, "a")
+        emit(1, "b")
+
+    spec = TaskSpec(taskfn=bad_taskfn,
+                    mapfn="examples.wordcount.mapfn",
+                    partitionfn="examples.wordcount.partitionfn",
+                    reducefn="examples.wordcount.reducefn",
+                    storage="mem:dupkeys")
+    with pytest.raises(ValueError, match="duplicate"):
+        LocalExecutor(spec).run()
+
+
+def test_taskfn_value_size_cap():
+    big = "x" * (17 * 1024)
+
+    def bad_taskfn(emit):
+        emit(1, big)
+
+    spec = TaskSpec(taskfn=bad_taskfn,
+                    mapfn="examples.wordcount.mapfn",
+                    partitionfn="examples.wordcount.partitionfn",
+                    reducefn="examples.wordcount.reducefn",
+                    storage="mem:bigval")
+    with pytest.raises(ValueError, match="bytes"):
+        LocalExecutor(spec).run()
+
+
+def test_delete_results_on_true(tmp_path):
+    spec = TaskSpec(init_args={"files": CORPUS[:3], "delete_results": True},
+                    storage="mem:delres", **CONFIGS["combiner"])
+    ex = LocalExecutor(spec)
+    ex.run()
+    assert list(ex.results()) == []
+
+
+def test_loop_shrinking_keyset_has_no_stale_results():
+    """Partitions untouched in a later iteration must not leak the previous
+    iteration's results (regression: results are dropped per iteration,
+    reference server.lua:331-345)."""
+    state = {"it": 0, "seen": []}
+
+    def taskfn(emit):
+        emit(1, ["alpha", "beta"] if state["it"] == 0 else ["alpha"])
+
+    def mapfn(key, words, emit):
+        for w in words:
+            emit(w, 1)
+
+    def partitionfn(key):
+        return 0 if key == "alpha" else 1
+
+    def reducefn(key, values):
+        return sum(values)
+
+    def finalfn(pairs):
+        state["seen"] = sorted(k for k, _ in pairs)
+        state["it"] += 1
+        return "loop" if state["it"] < 2 else None
+
+    spec = TaskSpec(taskfn=taskfn, mapfn=mapfn, partitionfn=partitionfn,
+                    reducefn=reducefn, finalfn=finalfn, storage="mem:shrink")
+    LocalExecutor(spec).run()
+    assert state["seen"] == ["alpha"]  # no stale "beta" from iteration 1
+
+
+def test_loop_protocol_counts_iterations():
+    state = {"iters": 0}
+
+    def taskfn(emit):
+        emit(1, state["iters"])
+
+    def mapfn(key, value, emit):
+        emit("count", 1)
+
+    def partitionfn(key):
+        return 0
+
+    def reducefn(key, values):
+        return sum(values)
+
+    def finalfn(pairs):
+        state["iters"] += 1
+        return "loop" if state["iters"] < 5 else None
+
+    spec = TaskSpec(taskfn=taskfn, mapfn=mapfn, partitionfn=partitionfn,
+                    reducefn=reducefn, finalfn=finalfn, storage="mem:loop")
+    stats = LocalExecutor(spec).run()
+    assert state["iters"] == 5
+    assert len(stats.iterations) == 5
