@@ -1,0 +1,52 @@
+"""Instrumented WordCount mapfn: cross-process execution counting and
+deterministic fault injection.
+
+Test-support module for the fault-tolerance harness (the reference has no
+fault-injection tooling, SURVEY.md §5 — this fills that gap): every mapfn
+call bumps a flock-guarded counter file, and the first ``fail_times`` calls
+raise, exercising the BROKEN→re-claim→retry machinery end to end.
+"""
+
+import fcntl
+import os
+
+_count_file = None
+_fail_times = 0
+
+
+def init(args):
+    global _count_file, _fail_times
+    _count_file = args["count_file"]
+    _fail_times = int(args.get("fail_times", 0))
+
+
+def bump(path: str) -> int:
+    """Atomically increment the counter file; returns the new value."""
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o666)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        raw = os.read(fd, 64).decode().strip()
+        n = (int(raw) if raw else 0) + 1
+        os.lseek(fd, 0, os.SEEK_SET)
+        os.ftruncate(fd, 0)
+        os.write(fd, str(n).encode())
+        return n
+    finally:
+        os.close(fd)
+
+
+def read_count(path: str) -> int:
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+            return int(raw) if raw else 0
+    except FileNotFoundError:
+        return 0
+
+
+def mapfn(key, value, emit):
+    n = bump(_count_file)
+    if n <= _fail_times:
+        raise RuntimeError(f"injected map failure #{n}")
+    from examples.wordcount.mapfn import mapfn as real_mapfn
+    real_mapfn(key, value, emit)

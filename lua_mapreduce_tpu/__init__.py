@@ -23,8 +23,14 @@ from lua_mapreduce_tpu.engine.local import LocalExecutor
 
 __version__ = "0.1.0"
 
-# distributed-engine exports appear here as their modules land
-_LAZY: dict = {}
+_LAZY = {
+    "Server": ("lua_mapreduce_tpu.engine.server", "Server"),
+    "Worker": ("lua_mapreduce_tpu.engine.worker", "Worker"),
+    "MemJobStore": ("lua_mapreduce_tpu.coord.jobstore", "MemJobStore"),
+    "FileJobStore": ("lua_mapreduce_tpu.coord.filestore", "FileJobStore"),
+    "PersistentTable": ("lua_mapreduce_tpu.coord.persistent_table",
+                        "PersistentTable"),
+}
 
 
 def __getattr__(name):
@@ -40,6 +46,11 @@ def __getattr__(name):
 __all__ = [
     "TaskSpec",
     "LocalExecutor",
+    "Server",
+    "Worker",
+    "MemJobStore",
+    "FileJobStore",
+    "PersistentTable",
     "tuples",
     "utest",
 ]

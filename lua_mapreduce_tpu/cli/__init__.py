@@ -1,0 +1,1 @@
+"""CLI launchers (reference L7: execute_server.lua / execute_worker.lua)."""

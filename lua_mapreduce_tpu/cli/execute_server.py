@@ -1,0 +1,117 @@
+"""Server launcher.
+
+Analog of reference execute_server.lua:1-62 with the same positional
+contract: coordination spec, then the user-function module names, then
+storage. ``/``-paths are normalized to dotted module names
+(execute_server.lua:37-39).
+
+    python -m lua_mapreduce_tpu.cli.execute_server \\
+        COORD_DIR TASKFN MAPFN PARTITIONFN REDUCEFN \\
+        [--combinerfn M] [--finalfn M] [--storage SPEC] \\
+        [--result-ns NS] [--init-arg K=V ...]
+
+COORD_DIR is the shared job-store directory (the connection-string analog);
+"mem" runs an in-process pool with --inline-workers N.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+
+
+def normalize_module(name: str) -> str:
+    """a/b/c.py or a/b/c → a.b.c (execute_server.lua:37-39)."""
+    if name.endswith(".py"):
+        name = name[:-3]
+    return name.strip("/").replace("/", ".")
+
+
+def parse_init_args(pairs) -> dict:
+    out = {}
+    for pair in pairs or ():
+        k, sep, v = pair.partition("=")
+        if not sep:
+            raise SystemExit(f"--init-arg needs K=V, got {pair!r}")
+        out[k] = v
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="execute_server",
+        description="Run the MapReduce server (orchestrator).")
+    p.add_argument("coord", help="shared job-store directory, or 'mem'")
+    p.add_argument("taskfn")
+    p.add_argument("mapfn")
+    p.add_argument("partitionfn")
+    p.add_argument("reducefn")
+    p.add_argument("--combinerfn")
+    p.add_argument("--finalfn")
+    p.add_argument("--storage", default="mem",
+                   help="backend[:path] — mem | shared:DIR | object:DIR")
+    p.add_argument("--result-ns", default="result")
+    p.add_argument("--init-arg", action="append", metavar="K=V")
+    p.add_argument("--inline-workers", type=int, default=0,
+                   help="run N worker threads in this process")
+    p.add_argument("--poll", type=float, default=0.1)
+    p.add_argument("--stale-timeout", type=float, default=600.0,
+                   help="requeue RUNNING jobs of silently-dead workers "
+                        "after this many seconds (0 disables)")
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from lua_mapreduce_tpu.coord.filestore import FileJobStore
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.server import Server
+    from lua_mapreduce_tpu.engine.worker import Worker
+
+    spec = TaskSpec(
+        taskfn=normalize_module(args.taskfn),
+        mapfn=normalize_module(args.mapfn),
+        partitionfn=normalize_module(args.partitionfn),
+        reducefn=normalize_module(args.reducefn),
+        combinerfn=normalize_module(args.combinerfn) if args.combinerfn else None,
+        finalfn=normalize_module(args.finalfn) if args.finalfn else None,
+        init_args=parse_init_args(args.init_arg),
+        storage=args.storage,
+        result_ns=args.result_ns,
+    )
+
+    store = MemJobStore() if args.coord == "mem" else FileJobStore(args.coord)
+    server = Server(store, poll_interval=args.poll,
+                    stale_timeout_s=args.stale_timeout or None,
+                    verbose=not args.quiet).configure(spec)
+
+    for _ in range(args.inline_workers):
+        w = Worker(store).configure(max_iter=10_000)
+        threading.Thread(target=w.execute, daemon=True).start()
+
+    def report(phase: str, frac: float) -> None:
+        if not args.quiet:
+            print(f"\r[{phase}] {100 * frac:5.1f}%", end="", file=sys.stderr)
+            if frac >= 1:
+                print(file=sys.stderr)
+
+    stats = server.loop(progress=report)
+    last = stats.last
+    if not args.quiet and last is not None:
+        print(f"cluster_time={last.cluster_time:.2f}s "
+              f"wall={stats.wall_time:.2f}s "
+              f"map(sum cpu/real)={last.map.sum_cpu_time:.2f}/"
+              f"{last.map.sum_real_time:.2f}s "
+              f"reduce(sum cpu/real)={last.reduce.sum_cpu_time:.2f}/"
+              f"{last.reduce.sum_real_time:.2f}s "
+              f"failed={last.map.failed}/{last.reduce.failed}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,42 @@
+"""Worker launcher.
+
+Analog of reference execute_worker.lua:1-11:
+
+    python -m lua_mapreduce_tpu.cli.execute_worker COORD_DIR \\
+        [--max-iter N] [--max-sleep S] [--max-tasks N] [--verbose]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="execute_worker",
+        description="Run one elastic MapReduce worker.")
+    p.add_argument("coord", help="shared job-store directory")
+    p.add_argument("--max-iter", type=int, default=20)
+    p.add_argument("--max-sleep", type=float, default=20.0)
+    p.add_argument("--max-tasks", type=int, default=1)
+    p.add_argument("--name")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from lua_mapreduce_tpu.coord.filestore import FileJobStore
+    from lua_mapreduce_tpu.engine.worker import Worker
+
+    store = FileJobStore(args.coord)
+    worker = Worker(store, name=args.name, verbose=args.verbose).configure(
+        max_iter=args.max_iter, max_sleep=args.max_sleep,
+        max_tasks=args.max_tasks)
+    worker.execute()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,22 @@
+"""Coordination plane: job store, persistent table, native CAS index.
+
+This package replaces the reference's MongoDB control plane (SURVEY.md §2.6):
+job queue + claim protocol, barrier/progress counting, task-singleton
+checkpoint, errors stream, and the persistent_table distributed state — all
+designed around compare-and-swap from day one (the reference's acknowledged
+write-concern races, task.lua:300-308, are the thing *not* copied).
+
+Backends:
+- MemJobStore  — in-process (server + worker threads share one object)
+- FileJobStore — shared-directory store for multi-process / multi-host
+  pools; job status lives in a compact binary index mutated under an
+  exclusive file lock, implemented twice with one format: a C++ library
+  (native/jobstore.cpp, the luamongo-client analog) and a pure-Python
+  fallback (coord/idx_py.py). The two interoperate on the same files.
+"""
+
+from lua_mapreduce_tpu.coord.jobstore import JobStore, MemJobStore
+from lua_mapreduce_tpu.coord.filestore import FileJobStore
+from lua_mapreduce_tpu.coord.persistent_table import PersistentTable
+
+__all__ = ["JobStore", "MemJobStore", "FileJobStore", "PersistentTable"]

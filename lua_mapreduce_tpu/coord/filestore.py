@@ -1,0 +1,266 @@
+"""Shared-directory job store for multi-process / multi-host pools.
+
+The distributed coordination backend (SURVEY.md §2.6 analog): a directory on
+a filesystem all participants can reach. Mutable claim state lives in the
+binary job index (idx.py — native C++ or Python engine, both flock-CAS);
+immutable payloads, per-job timing, the task singleton, the errors stream,
+and persistent-table documents are JSON files written atomically.
+
+Write discipline per namespace: only the server inserts jobs, and payload
+files are written *before* their index records become claimable, so a worker
+that wins a claim always finds the payload. Only the claiming worker writes
+its job's timing/worker sidecars. Everything multi-writer goes through a
+flock or the index CAS.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import hashlib
+import json
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence
+
+from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES, Status
+from lua_mapreduce_tpu.coord.idx import open_index
+from lua_mapreduce_tpu.coord.jobstore import CLAIMABLE, JobStore
+
+
+def worker_hash(worker: str) -> int:
+    """Stable int64 id for a worker name (index records store integers)."""
+    h = hashlib.blake2b(worker.encode(), digest_size=8).digest()
+    return int.from_bytes(h, "little", signed=True)
+
+
+def _atomic_write_json(path: str, doc) -> None:
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp.")
+    with os.fdopen(fd, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+class _FLock:
+    def __init__(self, path: str):
+        self._path = path
+
+    def __enter__(self):
+        self._fd = os.open(self._path, os.O_RDWR | os.O_CREAT, 0o666)
+        fcntl.flock(self._fd, fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        os.close(self._fd)
+
+
+class FileJobStore(JobStore):
+    def __init__(self, root: str, engine: str = "auto"):
+        self.root = root
+        self.engine = engine
+        os.makedirs(root, exist_ok=True)
+        os.makedirs(os.path.join(root, "locks"), exist_ok=True)
+        os.makedirs(os.path.join(root, "pt"), exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+
+    def _idx(self, ns: str):
+        return open_index(os.path.join(self.root, f"{ns}.idx"), self.engine)
+
+    def _ns_dir(self, ns: str) -> str:
+        d = os.path.join(self.root, f"{ns}.d")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _payload(self, ns: str, job_id: int) -> str:
+        return os.path.join(self._ns_dir(ns), f"j{job_id}.json")
+
+    def _times(self, ns: str, job_id: int) -> str:
+        return os.path.join(self._ns_dir(ns), f"t{job_id}.json")
+
+    def _wname(self, ns: str, job_id: int) -> str:
+        return os.path.join(self._ns_dir(ns), f"w{job_id}.txt")
+
+    def _lockfile(self, name: str) -> str:
+        return os.path.join(self.root, "locks", f"{name}.lock")
+
+    # -- task singleton ----------------------------------------------------
+
+    def put_task(self, doc: dict) -> None:
+        with _FLock(self._lockfile("task")):
+            _atomic_write_json(os.path.join(self.root, "task.json"), doc)
+
+    def get_task(self) -> Optional[dict]:
+        return _read_json(os.path.join(self.root, "task.json"))
+
+    def update_task(self, fields: dict) -> None:
+        with _FLock(self._lockfile("task")):
+            path = os.path.join(self.root, "task.json")
+            doc = _read_json(path)
+            if doc is None:
+                raise RuntimeError("no task document")
+            doc.update(fields)
+            _atomic_write_json(path, doc)
+
+    def delete_task(self) -> None:
+        with _FLock(self._lockfile("task")):
+            try:
+                os.remove(os.path.join(self.root, "task.json"))
+            except FileNotFoundError:
+                pass
+
+    # -- jobs --------------------------------------------------------------
+
+    def insert_jobs(self, ns: str, docs: Sequence[dict]) -> List[int]:
+        idx = self._idx(ns)
+        base = idx.count()
+        for i, doc in enumerate(docs):
+            _atomic_write_json(self._payload(ns, base + i), doc)
+        got = idx.insert(len(docs))
+        if got != base:
+            raise RuntimeError(
+                f"concurrent insert into {ns!r}: expected base {base}, got "
+                f"{got} — a namespace has exactly one inserter (the server)")
+        return list(range(base, base + len(docs)))
+
+    def claim(self, ns, worker, preferred_ids=None, steal=True):
+        idx = self._idx(ns)
+        jid = idx.claim(worker_hash(worker), time.time(), preferred_ids, steal)
+        if jid < 0:
+            return None
+        try:
+            with open(self._wname(ns, jid), "w") as f:
+                f.write(worker)
+        except OSError:
+            pass  # observability only
+        return self._job_doc(ns, jid, idx)
+
+    def set_job_status(self, ns, job_id, status, expect=None):
+        mask = 0
+        if expect is not None:
+            for s in expect:
+                mask |= 1 << int(s)
+        return self._idx(ns).cas_status(job_id, status, mask)
+
+    def get_job(self, ns, job_id):
+        idx = self._idx(ns)
+        if idx.get(job_id) is None:
+            return None
+        return self._job_doc(ns, job_id, idx)
+
+    def jobs(self, ns):
+        idx = self._idx(ns)
+        docs = []
+        # one locked pass over the index; payload/times are per-job files
+        # but immutable/single-writer, so they need no lock
+        for jid, (status, reps, whash, started) in enumerate(idx.snapshot()):
+            payload = _read_json(self._payload(ns, jid)) or {}
+            doc = dict(payload)
+            doc.update(_id=jid, status=Status(status), repetitions=reps,
+                       worker=whash or None, started_time=started or None,
+                       times=_read_json(self._times(ns, jid)))
+            wname = _read_json_text(self._wname(ns, jid))
+            if wname:
+                doc["worker"] = wname
+            docs.append(doc)
+        return docs
+
+    def _job_doc(self, ns, jid, idx) -> dict:
+        state = idx.get(jid)
+        payload = _read_json(self._payload(ns, jid)) or {}
+        status, reps, whash, started = state
+        doc = dict(payload)
+        doc.update(_id=jid, status=Status(status), repetitions=reps,
+                   worker=whash or None,
+                   started_time=started or None,
+                   times=_read_json(self._times(ns, jid)))
+        wname = _read_json_text(self._wname(ns, jid))
+        if wname:
+            doc["worker"] = wname
+        return doc
+
+    def set_job_times(self, ns, job_id, times):
+        _atomic_write_json(self._times(ns, job_id), dict(times))
+
+    def counts(self, ns):
+        return self._idx(ns).counts()
+
+    def scavenge(self, ns, max_retries=MAX_JOB_RETRIES):
+        return self._idx(ns).scavenge(max_retries)
+
+    def requeue_stale(self, ns, older_than_s):
+        return self._idx(ns).requeue_stale(time.time() - older_than_s)
+
+    def drop_ns(self, ns):
+        try:
+            os.remove(os.path.join(self.root, f"{ns}.idx"))
+        except FileNotFoundError:
+            pass
+        d = os.path.join(self.root, f"{ns}.d")
+        if os.path.isdir(d):
+            for f in os.listdir(d):
+                try:
+                    os.remove(os.path.join(d, f))
+                except FileNotFoundError:
+                    pass
+            os.rmdir(d)
+
+    # -- errors ------------------------------------------------------------
+
+    def insert_error(self, worker, msg):
+        line = json.dumps({"worker": worker, "msg": msg, "time": time.time()})
+        with _FLock(self._lockfile("errors")):
+            with open(os.path.join(self.root, "errors.jsonl"), "a") as f:
+                f.write(line + "\n")
+
+    def drain_errors(self):
+        path = os.path.join(self.root, "errors.jsonl")
+        with _FLock(self._lockfile("errors")):
+            try:
+                with open(path) as f:
+                    lines = f.readlines()
+                os.remove(path)
+            except FileNotFoundError:
+                return []
+        return [json.loads(l) for l in lines if l.strip()]
+
+    # -- persistent documents ----------------------------------------------
+
+    def _pt_path(self, name: str) -> str:
+        return os.path.join(self.root, "pt", f"{name}.json")
+
+    def pt_get(self, name):
+        return _read_json(self._pt_path(name))
+
+    def pt_cas(self, name, expected_ts, doc):
+        with _FLock(self._lockfile(f"pt_{name}")):
+            cur = _read_json(self._pt_path(name))
+            cur_ts = cur.get("timestamp") if cur is not None else None
+            if cur_ts != expected_ts:
+                return False
+            _atomic_write_json(self._pt_path(name), doc)
+            return True
+
+    def pt_delete(self, name):
+        with _FLock(self._lockfile(f"pt_{name}")):
+            try:
+                os.remove(self._pt_path(name))
+            except FileNotFoundError:
+                pass
+
+
+def _read_json_text(path: str) -> Optional[str]:
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return None

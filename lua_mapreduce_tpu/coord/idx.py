@@ -1,0 +1,191 @@
+"""Job-index engine selection: native C++ via ctypes, or pure Python.
+
+The native library (native/jobstore.cpp) is compiled on first use with the
+host toolchain and cached next to the source; if compilation or loading
+fails the pure-Python engine (idx_py.py) takes over — both speak the same
+on-disk format, so the choice is per-process, not per-cluster.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES, Status
+from lua_mapreduce_tpu.coord.idx_py import PyJobIndex
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "jobstore.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libjobstore.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build_native() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _build_native()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.jsx_insert.restype = ctypes.c_int64
+        lib.jsx_insert.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.jsx_count.restype = ctypes.c_int64
+        lib.jsx_count.argtypes = [ctypes.c_char_p]
+        lib.jsx_claim.restype = ctypes.c_int64
+        lib.jsx_claim.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.c_int64, ctypes.c_int32]
+        lib.jsx_cas_status.restype = ctypes.c_int
+        lib.jsx_cas_status.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                       ctypes.c_int32, ctypes.c_uint32]
+        lib.jsx_get.restype = ctypes.c_int
+        lib.jsx_get.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                ctypes.POINTER(ctypes.c_int32),
+                                ctypes.POINTER(ctypes.c_int32),
+                                ctypes.POINTER(ctypes.c_int64),
+                                ctypes.POINTER(ctypes.c_double)]
+        lib.jsx_counts.restype = ctypes.c_int64
+        lib.jsx_counts.argtypes = [ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_int64)]
+        lib.jsx_scavenge.restype = ctypes.c_int64
+        lib.jsx_scavenge.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+        lib.jsx_requeue_stale.restype = ctypes.c_int64
+        lib.jsx_requeue_stale.argtypes = [ctypes.c_char_p, ctypes.c_double]
+        lib.jsx_snapshot.restype = ctypes.c_int64
+        lib.jsx_snapshot.argtypes = [ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_int32),
+                                     ctypes.POINTER(ctypes.c_int32),
+                                     ctypes.POINTER(ctypes.c_int64),
+                                     ctypes.POINTER(ctypes.c_double),
+                                     ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+class NativeJobIndex:
+    """ctypes facade over native/jobstore.cpp with PyJobIndex's API."""
+
+    def __init__(self, path: str, lib: ctypes.CDLL):
+        self.path = path
+        self._p = path.encode()
+        self._lib = lib
+
+    def insert(self, n: int) -> int:
+        r = self._lib.jsx_insert(self._p, n)
+        if r < 0:
+            raise OSError(f"jsx_insert failed on {self.path}")
+        return r
+
+    def count(self) -> int:
+        r = self._lib.jsx_count(self._p)
+        if r < 0:
+            raise OSError(f"jsx_count failed on {self.path}")
+        return r
+
+    def claim(self, worker: int, now: float,
+              preferred: Optional[Sequence[int]] = None,
+              steal: bool = True) -> int:
+        # ``now`` is taken by the native side's own clock; the arg keeps the
+        # engines' signatures identical.
+        pref = preferred or ()
+        arr = (ctypes.c_int64 * len(pref))(*pref)
+        return self._lib.jsx_claim(self._p, worker, arr, len(pref),
+                                   1 if steal else 0)
+
+    def cas_status(self, job_id: int, to: Status, expect_mask: int = 0) -> bool:
+        r = self._lib.jsx_cas_status(self._p, job_id, int(to), expect_mask)
+        if r < 0:
+            raise OSError(f"jsx_cas_status failed on {self.path}")
+        return bool(r)
+
+    def get(self, job_id: int) -> Optional[Tuple[int, int, int, float]]:
+        status = ctypes.c_int32()
+        reps = ctypes.c_int32()
+        worker = ctypes.c_int64()
+        started = ctypes.c_double()
+        r = self._lib.jsx_get(self._p, job_id, ctypes.byref(status),
+                              ctypes.byref(reps), ctypes.byref(worker),
+                              ctypes.byref(started))
+        if r < 0:
+            raise OSError(f"jsx_get failed on {self.path}")
+        if r == 0:
+            return None
+        return status.value, reps.value, worker.value, started.value
+
+    def counts(self) -> Dict[Status, int]:
+        out = (ctypes.c_int64 * 6)()
+        r = self._lib.jsx_counts(self._p, out)
+        if r < 0:
+            raise OSError(f"jsx_counts failed on {self.path}")
+        return {Status(i): out[i] for i in range(6)}
+
+    def scavenge(self, max_retries: int = MAX_JOB_RETRIES) -> int:
+        r = self._lib.jsx_scavenge(self._p, max_retries)
+        if r < 0:
+            raise OSError(f"jsx_scavenge failed on {self.path}")
+        return r
+
+    def requeue_stale(self, cutoff: float) -> int:
+        r = self._lib.jsx_requeue_stale(self._p, cutoff)
+        if r < 0:
+            raise OSError(f"jsx_requeue_stale failed on {self.path}")
+        return r
+
+    def snapshot(self):
+        cap = self.count()
+        if cap == 0:
+            return []
+        statuses = (ctypes.c_int32 * cap)()
+        reps = (ctypes.c_int32 * cap)()
+        workers = (ctypes.c_int64 * cap)()
+        started = (ctypes.c_double * cap)()
+        n = self._lib.jsx_snapshot(self._p, statuses, reps, workers,
+                                   started, cap)
+        if n < 0:
+            raise OSError(f"jsx_snapshot failed on {self.path}")
+        return [(statuses[i], reps[i], workers[i], started[i])
+                for i in range(n)]
+
+
+def open_index(path: str, engine: str = "auto"):
+    """Open a job index at ``path``.
+
+    engine: "auto" (native if it builds, else python), "native", "python".
+    """
+    if engine not in ("auto", "native", "python"):
+        raise ValueError(f"unknown index engine {engine!r}")
+    if engine in ("auto", "native"):
+        lib = _load()
+        if lib is not None:
+            return NativeJobIndex(path, lib)
+        if engine == "native":
+            raise RuntimeError("native job index unavailable (g++ build failed)")
+    return PyJobIndex(path)
+
+
+def native_available() -> bool:
+    return _load() is not None

@@ -1,0 +1,220 @@
+"""Pure-Python job-status index over a shared file (fallback engine).
+
+The claim protocol's data structure: a compact binary table of per-job
+mutable state (status, repetitions, worker, started_time), mutated only
+under an exclusive ``flock`` of the index file — which makes every operation
+a true atomic compare-and-swap across processes and hosts sharing the
+directory. This replaces the reference's Mongo single-document atomicity and
+closes its acknowledged claim races (task.lua:300-308 FIXMEs).
+
+The on-disk format is shared byte-for-byte with the native C++ engine
+(native/jobstore.cpp); processes may mix the two freely on the same files.
+
+Layout (little-endian):
+    header:  8s magic "JSIX0001" | q record count
+    record:  i status | i repetitions | q worker-hash | d started_time | d reserved
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES, Status
+
+MAGIC = b"JSIX0001"
+_HEADER = struct.Struct("<8sq")
+_REC = struct.Struct("<iiqdd")
+HEADER_SIZE = _HEADER.size       # 16
+RECORD_SIZE = _REC.size          # 32
+
+_CLAIM_MASK = (1 << Status.WAITING) | (1 << Status.BROKEN)
+
+
+class PyJobIndex:
+    """One namespace's job index. All methods open/lock/operate/close so
+    any number of processes can interleave safely."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    # -- internals ---------------------------------------------------------
+
+    def _open_locked(self, create: bool = False):
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        fd = os.open(self.path, flags, 0o666)
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        return fd
+
+    @staticmethod
+    def _read_count(fd) -> int:
+        os.lseek(fd, 0, os.SEEK_SET)
+        head = os.read(fd, HEADER_SIZE)
+        if len(head) < HEADER_SIZE:
+            return 0
+        magic, count = _HEADER.unpack(head)
+        if magic != MAGIC:
+            raise ValueError(f"bad index magic in {head!r}")
+        return count
+
+    @staticmethod
+    def _write_count(fd, count: int) -> None:
+        os.lseek(fd, 0, os.SEEK_SET)
+        os.write(fd, _HEADER.pack(MAGIC, count))
+
+    @staticmethod
+    def _read_rec(fd, job_id: int) -> Tuple[int, int, int, float, float]:
+        os.lseek(fd, HEADER_SIZE + job_id * RECORD_SIZE, os.SEEK_SET)
+        return _REC.unpack(os.read(fd, RECORD_SIZE))
+
+    @staticmethod
+    def _write_rec(fd, job_id: int, status: int, reps: int, worker: int,
+                   started: float, reserved: float = 0.0) -> None:
+        os.lseek(fd, HEADER_SIZE + job_id * RECORD_SIZE, os.SEEK_SET)
+        os.write(fd, _REC.pack(status, reps, worker, started, reserved))
+
+    # -- operations (mirror native/jobstore.cpp exports) -------------------
+
+    def insert(self, n: int) -> int:
+        """Append ``n`` WAITING records; returns the first new id."""
+        fd = self._open_locked(create=True)
+        try:
+            count = self._read_count(fd) if os.fstat(fd).st_size else 0
+            for i in range(n):
+                self._write_rec(fd, count + i, Status.WAITING, 0, 0, 0.0)
+            self._write_count(fd, count + n)
+            return count
+        finally:
+            os.close(fd)
+
+    def count(self) -> int:
+        if not os.path.exists(self.path):
+            return 0
+        fd = self._open_locked()
+        try:
+            return self._read_count(fd)
+        finally:
+            os.close(fd)
+
+    def claim(self, worker: int, now: float,
+              preferred: Optional[Sequence[int]] = None,
+              steal: bool = True) -> int:
+        """First WAITING|BROKEN → RUNNING. Returns claimed id or -1.
+        ``steal=False`` restricts the scan to ``preferred``."""
+        if not os.path.exists(self.path):
+            return -1
+        fd = self._open_locked()
+        try:
+            count = self._read_count(fd)
+
+            def try_id(jid: int) -> bool:
+                status, reps, w, st, rv = self._read_rec(fd, jid)
+                if (1 << status) & _CLAIM_MASK:
+                    self._write_rec(fd, jid, Status.RUNNING, reps, worker, now)
+                    return True
+                return False
+
+            for jid in (preferred or ()):
+                if 0 <= jid < count and try_id(jid):
+                    return jid
+            if steal:
+                for jid in range(count):
+                    if try_id(jid):
+                        return jid
+            return -1
+        finally:
+            os.close(fd)
+
+    def cas_status(self, job_id: int, to: Status,
+                   expect_mask: int = 0) -> bool:
+        """Set status iff current status is in ``expect_mask`` (bitmask of
+        ``1 << status``; 0 = unconditional). Moving to BROKEN increments
+        ``repetitions`` (job.lua:322-342). A missing index (namespace
+        dropped under a straggler) is a False, not an error."""
+        if not os.path.exists(self.path):
+            return False
+        fd = self._open_locked()
+        try:
+            if not (0 <= job_id < self._read_count(fd)):
+                return False
+            status, reps, w, st, rv = self._read_rec(fd, job_id)
+            if expect_mask and not ((1 << status) & expect_mask):
+                return False
+            if to == Status.BROKEN:
+                reps += 1
+            self._write_rec(fd, job_id, int(to), reps, w, st, rv)
+            return True
+        finally:
+            os.close(fd)
+
+    def get(self, job_id: int) -> Optional[Tuple[int, int, int, float]]:
+        if not os.path.exists(self.path):
+            return None
+        fd = self._open_locked()
+        try:
+            if not (0 <= job_id < self._read_count(fd)):
+                return None
+            status, reps, w, st, _ = self._read_rec(fd, job_id)
+            return status, reps, w, st
+        finally:
+            os.close(fd)
+
+    def counts(self) -> Dict[Status, int]:
+        out = {s: 0 for s in Status}
+        if not os.path.exists(self.path):
+            return out
+        fd = self._open_locked()
+        try:
+            for jid in range(self._read_count(fd)):
+                status, *_ = self._read_rec(fd, jid)
+                out[Status(status)] += 1
+            return out
+        finally:
+            os.close(fd)
+
+    def scavenge(self, max_retries: int = MAX_JOB_RETRIES) -> int:
+        if not os.path.exists(self.path):
+            return 0
+        fd = self._open_locked()
+        try:
+            n = 0
+            for jid in range(self._read_count(fd)):
+                status, reps, w, st, rv = self._read_rec(fd, jid)
+                if status == Status.BROKEN and reps >= max_retries:
+                    self._write_rec(fd, jid, Status.FAILED, reps, w, st, rv)
+                    n += 1
+            return n
+        finally:
+            os.close(fd)
+
+    def requeue_stale(self, cutoff: float) -> int:
+        """RUNNING|FINISHED records started before ``cutoff`` → BROKEN
+        (+1 rep). FINISHED is included so a worker killed between its
+        FINISHED and WRITTEN transitions cannot wedge the barrier."""
+        if not os.path.exists(self.path):
+            return 0
+        fd = self._open_locked()
+        try:
+            n = 0
+            for jid in range(self._read_count(fd)):
+                status, reps, w, st, rv = self._read_rec(fd, jid)
+                if status in (Status.RUNNING, Status.FINISHED) and st < cutoff:
+                    self._write_rec(fd, jid, Status.BROKEN, reps + 1, w, st, rv)
+                    n += 1
+            return n
+        finally:
+            os.close(fd)
+
+    def snapshot(self) -> List[Tuple[int, int, int, float]]:
+        """All records (status, reps, worker, started) in one locked pass —
+        the bulk-stats read path (avoids one flock per job)."""
+        if not os.path.exists(self.path):
+            return []
+        fd = self._open_locked()
+        try:
+            return [self._read_rec(fd, jid)[:4]
+                    for jid in range(self._read_count(fd))]
+        finally:
+            os.close(fd)

@@ -1,0 +1,295 @@
+"""Job store — the coordination backend interface and in-memory engine.
+
+The reference coordinates everything through MongoDB collections
+(SURVEY.md §2.6): ``map_jobs``/``red_jobs`` job queues claimed by atomically
+flipping a status field (task.lua:258-343), a ``task`` singleton document as
+the orchestrator checkpoint (task.lua:96-116), an ``errors`` collection
+(cnn.lua:62-78), and ``persistent_table`` documents with optimistic
+timestamps (persistent_table.lua:41-74). This module defines the same five
+capabilities as an explicit interface whose claim protocol is an atomic
+compare-and-swap — no claim/readback race window.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES, Status
+
+CLAIMABLE = (Status.WAITING, Status.BROKEN)
+
+
+def make_job(key: Any, value: Any) -> dict:
+    """Immutable part of a job document (reference utils.lua:87-98
+    ``make_job`` schema; mutable claim state lives in the store index)."""
+    return {"key": key, "value": value, "creation_time": time.time()}
+
+
+class JobStore(abc.ABC):
+    """Coordination-plane interface (control plane only — bulk data goes
+    through the storage layer, never through the job store)."""
+
+    # -- task singleton (orchestrator checkpoint, task.lua:96-116) ---------
+
+    @abc.abstractmethod
+    def put_task(self, doc: dict) -> None: ...
+
+    @abc.abstractmethod
+    def get_task(self) -> Optional[dict]: ...
+
+    @abc.abstractmethod
+    def update_task(self, fields: dict) -> None: ...
+
+    @abc.abstractmethod
+    def delete_task(self) -> None: ...
+
+    # -- job queues (map_jobs / red_jobs analogs) --------------------------
+
+    @abc.abstractmethod
+    def insert_jobs(self, ns: str, docs: Sequence[dict]) -> List[int]:
+        """Append job docs with status WAITING; returns their ids."""
+
+    @abc.abstractmethod
+    def claim(self, ns: str, worker: str,
+              preferred_ids: Optional[Sequence[int]] = None,
+              steal: bool = True) -> Optional[dict]:
+        """Atomically claim one WAITING|BROKEN job → RUNNING for ``worker``.
+
+        Single-CAS replacement for the reference's update-then-readback
+        (task.lua:294-309 and its FIXME races). ``preferred_ids`` implements
+        the map-affinity cache (task.lua:249-292): those ids are tried
+        first so a worker re-claims "its" map jobs across iterations;
+        ``steal=False`` restricts the claim to the preferred ids (the worker
+        steals others' jobs only after MAX_IDLE_COUNT idle polls).
+        Returns the full job doc (with ``_id``, ``status``, ``repetitions``)
+        or None if nothing is claimable.
+        """
+
+    @abc.abstractmethod
+    def set_job_status(self, ns: str, job_id: int, status: Status,
+                       expect: Optional[Sequence[Status]] = None) -> bool:
+        """CAS a job's status; bumps ``repetitions`` when moving to BROKEN
+        (job.lua:322-342). Returns False if ``expect`` did not match."""
+
+    @abc.abstractmethod
+    def get_job(self, ns: str, job_id: int) -> Optional[dict]: ...
+
+    @abc.abstractmethod
+    def jobs(self, ns: str) -> List[dict]: ...
+
+    @abc.abstractmethod
+    def set_job_times(self, ns: str, job_id: int, times: dict) -> None:
+        """Record per-job timing for stats (job.lua:117-152)."""
+
+    @abc.abstractmethod
+    def counts(self, ns: str) -> Dict[Status, int]:
+        """Per-status counts — the server's barrier poll
+        (server.lua:186-234)."""
+
+    @abc.abstractmethod
+    def scavenge(self, ns: str, max_retries: int = MAX_JOB_RETRIES) -> int:
+        """BROKEN jobs with repetitions ≥ max_retries → FAILED
+        (server.lua:192-205). Returns how many were failed."""
+
+    @abc.abstractmethod
+    def requeue_stale(self, ns: str, older_than_s: float) -> int:
+        """RUNNING or FINISHED jobs started more than ``older_than_s`` ago
+        → BROKEN (re-claimable). Covers hard-killed workers that never mark
+        their job broken — including a kill between the FINISHED and
+        WRITTEN transitions — a gap the reference leaves open (its recovery
+        relies on the worker's own xpcall handler, worker.lua:116-131).
+        ``older_than_s`` must exceed the longest expected job duration.
+        Returns count."""
+
+    @abc.abstractmethod
+    def drop_ns(self, ns: str) -> None: ...
+
+    # -- errors stream (cnn.lua:62-78) -------------------------------------
+
+    @abc.abstractmethod
+    def insert_error(self, worker: str, msg: str) -> None: ...
+
+    @abc.abstractmethod
+    def drain_errors(self) -> List[dict]: ...
+
+    # -- persistent documents (persistent_table backing) -------------------
+
+    @abc.abstractmethod
+    def pt_get(self, name: str) -> Optional[dict]: ...
+
+    @abc.abstractmethod
+    def pt_cas(self, name: str, expected_ts: Optional[int], doc: dict) -> bool:
+        """Write ``doc`` iff the stored timestamp equals ``expected_ts``
+        (None = must not exist). The optimistic-concurrency primitive of
+        persistent_table.lua:41-74."""
+
+    @abc.abstractmethod
+    def pt_delete(self, name: str) -> None: ...
+
+
+class MemJobStore(JobStore):
+    """In-process store: one lock, plain dicts. The engine for
+    single-process elastic pools (server + worker threads)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._task: Optional[dict] = None
+        self._jobs: Dict[str, List[dict]] = {}
+        self._errors: List[dict] = []
+        self._pt: Dict[str, dict] = {}
+
+    # -- task --------------------------------------------------------------
+
+    def put_task(self, doc: dict) -> None:
+        with self._lock:
+            self._task = dict(doc)
+
+    def get_task(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._task) if self._task is not None else None
+
+    def update_task(self, fields: dict) -> None:
+        with self._lock:
+            if self._task is None:
+                raise RuntimeError("no task document")
+            self._task.update(fields)
+
+    def delete_task(self) -> None:
+        with self._lock:
+            self._task = None
+
+    # -- jobs --------------------------------------------------------------
+
+    def insert_jobs(self, ns: str, docs: Sequence[dict]) -> List[int]:
+        with self._lock:
+            queue = self._jobs.setdefault(ns, [])
+            base = len(queue)
+            ids = []
+            for i, doc in enumerate(docs):
+                d = dict(doc)
+                d.update(_id=base + i, status=Status.WAITING, repetitions=0,
+                         worker=None, started_time=None, times=None)
+                queue.append(d)
+                ids.append(base + i)
+            return ids
+
+    def claim(self, ns, worker, preferred_ids=None, steal=True):
+        with self._lock:
+            queue = self._jobs.get(ns, [])
+
+            def try_claim(d):
+                if d["status"] in CLAIMABLE:
+                    d["status"] = Status.RUNNING
+                    d["worker"] = worker
+                    d["started_time"] = time.time()
+                    return dict(d)
+                return None
+
+            for jid in (preferred_ids or ()):
+                if 0 <= jid < len(queue):
+                    got = try_claim(queue[jid])
+                    if got:
+                        return got
+            if steal:
+                for d in queue:
+                    got = try_claim(d)
+                    if got:
+                        return got
+            return None
+
+    def set_job_status(self, ns, job_id, status, expect=None):
+        with self._lock:
+            queue = self._jobs.get(ns, [])
+            if not (0 <= job_id < len(queue)):
+                return False
+            d = queue[job_id]
+            if expect is not None and d["status"] not in expect:
+                return False
+            if status == Status.BROKEN:
+                d["repetitions"] += 1
+            d["status"] = status
+            return True
+
+    def get_job(self, ns, job_id):
+        with self._lock:
+            queue = self._jobs.get(ns, [])
+            return dict(queue[job_id]) if 0 <= job_id < len(queue) else None
+
+    def jobs(self, ns):
+        with self._lock:
+            return [dict(d) for d in self._jobs.get(ns, [])]
+
+    def set_job_times(self, ns, job_id, times):
+        with self._lock:
+            queue = self._jobs.get(ns)
+            if queue is not None and 0 <= job_id < len(queue):
+                queue[job_id]["times"] = dict(times)
+            # dropped namespace (straggler finishing late): ignore
+
+    def counts(self, ns):
+        with self._lock:
+            out = {s: 0 for s in Status}
+            for d in self._jobs.get(ns, []):
+                out[d["status"]] += 1
+            return out
+
+    def scavenge(self, ns, max_retries=MAX_JOB_RETRIES):
+        with self._lock:
+            n = 0
+            for d in self._jobs.get(ns, []):
+                if d["status"] == Status.BROKEN and d["repetitions"] >= max_retries:
+                    d["status"] = Status.FAILED
+                    n += 1
+            return n
+
+    def requeue_stale(self, ns, older_than_s):
+        with self._lock:
+            n = 0
+            cutoff = time.time() - older_than_s
+            for d in self._jobs.get(ns, []):
+                if (d["status"] in (Status.RUNNING, Status.FINISHED) and
+                        d["started_time"] is not None and
+                        d["started_time"] < cutoff):
+                    d["status"] = Status.BROKEN
+                    d["repetitions"] += 1
+                    n += 1
+            return n
+
+    def drop_ns(self, ns):
+        with self._lock:
+            self._jobs.pop(ns, None)
+
+    # -- errors ------------------------------------------------------------
+
+    def insert_error(self, worker, msg):
+        with self._lock:
+            self._errors.append({"worker": worker, "msg": msg,
+                                 "time": time.time()})
+
+    def drain_errors(self):
+        with self._lock:
+            out, self._errors = self._errors, []
+            return out
+
+    # -- persistent documents ----------------------------------------------
+
+    def pt_get(self, name):
+        with self._lock:
+            doc = self._pt.get(name)
+            return dict(doc) if doc is not None else None
+
+    def pt_cas(self, name, expected_ts, doc):
+        with self._lock:
+            cur = self._pt.get(name)
+            cur_ts = cur.get("timestamp") if cur is not None else None
+            if cur_ts != expected_ts:
+                return False
+            self._pt[name] = dict(doc)
+            return True
+
+    def pt_delete(self, name):
+        with self._lock:
+            self._pt.pop(name, None)

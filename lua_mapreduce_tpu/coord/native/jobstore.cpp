@@ -1,0 +1,281 @@
+// Native job-status index — the coordination client's hot path.
+//
+// TPU-native equivalent of the reference's native C++ layer (SURVEY.md
+// §2.4): where lua-mapreduce links luamongo + mongo-cxx-driver to talk to a
+// MongoDB control plane, this framework's control plane is a shared-file
+// compare-and-swap index, and this library is its native engine. The Python
+// fallback (coord/idx_py.py) implements the identical on-disk format; both
+// may operate on the same files concurrently.
+//
+// Concurrency model: every operation opens the index file, takes an
+// exclusive flock, operates with pread/pwrite, and releases on close. flock
+// is process-crash-safe (the OS drops the lock when the holder dies), which
+// is what makes worker failure recovery sound with no lease machinery.
+//
+// Layout (little-endian, matching idx_py.py):
+//   header: char magic[8] = "JSIX0001"; int64 count;
+//   record: int32 status; int32 repetitions; int64 worker; double started;
+//           double reserved;   // 32 bytes
+
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'J', 'S', 'I', 'X', '0', '0', '0', '1'};
+constexpr int64_t kHeaderSize = 16;
+constexpr int64_t kRecordSize = 32;
+
+// Status values mirror core/constants.py (reference utils.lua:33-40).
+enum Status : int32_t {
+  kWaiting = 0,
+  kRunning = 1,
+  kBroken = 2,
+  kFinished = 3,
+  kWritten = 4,
+  kFailed = 5,
+};
+
+constexpr uint32_t kClaimMask = (1u << kWaiting) | (1u << kBroken);
+
+#pragma pack(push, 1)
+struct Header {
+  char magic[8];
+  int64_t count;
+};
+struct Record {
+  int32_t status;
+  int32_t repetitions;
+  int64_t worker;
+  double started;
+  double reserved;
+};
+#pragma pack(pop)
+
+static_assert(sizeof(Header) == kHeaderSize, "header layout");
+static_assert(sizeof(Record) == kRecordSize, "record layout");
+
+class LockedIndex {
+ public:
+  explicit LockedIndex(const char* path, bool create)
+      : fd_(open(path, O_RDWR | (create ? O_CREAT : 0), 0666)) {
+    if (fd_ >= 0 && flock(fd_, LOCK_EX) != 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+  }
+  ~LockedIndex() {
+    if (fd_ >= 0) close(fd_);  // close releases the flock
+  }
+  bool ok() const { return fd_ >= 0; }
+
+  int64_t count() const {
+    Header h;
+    if (pread(fd_, &h, sizeof h, 0) != (ssize_t)sizeof h) return 0;
+    if (memcmp(h.magic, kMagic, sizeof kMagic) != 0) return -1;
+    return h.count;
+  }
+
+  bool set_count(int64_t n) const {
+    Header h;
+    memcpy(h.magic, kMagic, sizeof kMagic);
+    h.count = n;
+    return pwrite(fd_, &h, sizeof h, 0) == (ssize_t)sizeof h;
+  }
+
+  bool read(int64_t id, Record* rec) const {
+    return pread(fd_, rec, sizeof *rec, kHeaderSize + id * kRecordSize) ==
+           (ssize_t)sizeof *rec;
+  }
+
+  bool write(int64_t id, const Record& rec) const {
+    return pwrite(fd_, &rec, sizeof rec, kHeaderSize + id * kRecordSize) ==
+           (ssize_t)sizeof rec;
+  }
+
+ private:
+  int fd_;
+};
+
+double now_seconds() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Append n WAITING records; returns first new id, or -1 on error.
+int64_t jsx_insert(const char* path, int64_t n) {
+  LockedIndex idx(path, /*create=*/true);
+  if (!idx.ok()) return -1;
+  int64_t count = idx.count();  // 0 for a freshly created empty file
+  if (count < 0) return -1;
+  Record rec{kWaiting, 0, 0, 0.0, 0.0};
+  for (int64_t i = 0; i < n; ++i) {
+    if (!idx.write(count + i, rec)) return -1;
+  }
+  if (!idx.set_count(count + n)) return -1;
+  return count;
+}
+
+// Number of records, 0 if missing, -1 on corruption.
+int64_t jsx_count(const char* path) {
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  return idx.count();
+}
+
+// Claim first WAITING|BROKEN record for worker (preferred ids first; when
+// steal == 0 only the preferred ids are considered — map-affinity mode).
+// Returns claimed id or -1.
+int64_t jsx_claim(const char* path, int64_t worker, const int64_t* preferred,
+                  int64_t n_preferred, int32_t steal) {
+  if (access(path, F_OK) != 0) return -1;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  const int64_t count = idx.count();
+  if (count <= 0) return -1;
+
+  auto try_id = [&](int64_t id) -> bool {
+    Record rec;
+    if (!idx.read(id, &rec)) return false;
+    if (!((1u << rec.status) & kClaimMask)) return false;
+    rec.status = kRunning;
+    rec.worker = worker;
+    rec.started = now_seconds();
+    return idx.write(id, rec);
+  };
+
+  for (int64_t i = 0; i < n_preferred; ++i) {
+    const int64_t id = preferred[i];
+    if (id >= 0 && id < count && try_id(id)) return id;
+  }
+  if (steal) {
+    for (int64_t id = 0; id < count; ++id) {
+      if (try_id(id)) return id;
+    }
+  }
+  return -1;
+}
+
+// CAS status; expect_mask is a bitmask of (1<<status), 0 = unconditional.
+// Moving to BROKEN increments repetitions. Returns 1 on success, 0 on
+// mismatch/bounds, -1 on error.
+int jsx_cas_status(const char* path, int64_t id, int32_t to,
+                   uint32_t expect_mask) {
+  if (access(path, F_OK) != 0) return 0;  // namespace dropped: CAS misses
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  const int64_t count = idx.count();
+  if (id < 0 || id >= count) return 0;
+  Record rec;
+  if (!idx.read(id, &rec)) return -1;
+  if (expect_mask && !((1u << rec.status) & expect_mask)) return 0;
+  if (to == kBroken) rec.repetitions += 1;
+  rec.status = to;
+  return idx.write(id, rec) ? 1 : -1;
+}
+
+// Read one record. Returns 1 on success, 0 if out of bounds, -1 on error.
+int jsx_get(const char* path, int64_t id, int32_t* status,
+            int32_t* repetitions, int64_t* worker, double* started) {
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  if (id < 0 || id >= idx.count()) return 0;
+  Record rec;
+  if (!idx.read(id, &rec)) return -1;
+  *status = rec.status;
+  *repetitions = rec.repetitions;
+  *worker = rec.worker;
+  *started = rec.started;
+  return 1;
+}
+
+// Per-status counts into out[6]. Returns total count or -1.
+int64_t jsx_counts(const char* path, int64_t* out6) {
+  for (int i = 0; i < 6; ++i) out6[i] = 0;
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  const int64_t count = idx.count();
+  Record rec;
+  for (int64_t id = 0; id < count; ++id) {
+    if (!idx.read(id, &rec)) return -1;
+    if (rec.status >= 0 && rec.status < 6) out6[rec.status] += 1;
+  }
+  return count;
+}
+
+// RUNNING|FINISHED records with started < cutoff → BROKEN (+1 repetition).
+// Covers hard-killed workers, including a kill between the FINISHED and
+// WRITTEN transitions (no analog in the reference; see jobstore.py).
+int64_t jsx_requeue_stale(const char* path, double cutoff) {
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  const int64_t count = idx.count();
+  int64_t n = 0;
+  Record rec;
+  for (int64_t id = 0; id < count; ++id) {
+    if (!idx.read(id, &rec)) return -1;
+    if ((rec.status == kRunning || rec.status == kFinished) &&
+        rec.started < cutoff) {
+      rec.status = kBroken;
+      rec.repetitions += 1;
+      if (!idx.write(id, rec)) return -1;
+      ++n;
+    }
+  }
+  return n;
+}
+
+// Bulk snapshot: fill caller arrays (capacity cap) with every record's
+// state in one locked pass. Returns the number filled, or -1 on error.
+int64_t jsx_snapshot(const char* path, int32_t* statuses, int32_t* reps,
+                     int64_t* workers, double* started, int64_t cap) {
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  int64_t count = idx.count();
+  if (count > cap) count = cap;
+  Record rec;
+  for (int64_t id = 0; id < count; ++id) {
+    if (!idx.read(id, &rec)) return -1;
+    statuses[id] = rec.status;
+    reps[id] = rec.repetitions;
+    workers[id] = rec.worker;
+    started[id] = rec.started;
+  }
+  return count;
+}
+
+// BROKEN records with repetitions >= max_retries → FAILED. Returns how many.
+int64_t jsx_scavenge(const char* path, int32_t max_retries) {
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  const int64_t count = idx.count();
+  int64_t n = 0;
+  Record rec;
+  for (int64_t id = 0; id < count; ++id) {
+    if (!idx.read(id, &rec)) return -1;
+    if (rec.status == kBroken && rec.repetitions >= max_retries) {
+      rec.status = kFailed;
+      if (!idx.write(id, rec)) return -1;
+      ++n;
+    }
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,264 @@
+"""Single-controller orchestrator.
+
+Analog of reference mapreduce/server.lua (SURVEY.md §3.1): owns the task
+lifecycle — insert map jobs, wait for the elastic pool through the barrier
+poll (with the BROKEN→FAILED scavenger and the errors drain), build reduce
+jobs from the discovered map-output partitions, aggregate statistics, run
+finalfn, and honor the ``"loop"`` protocol. The task document in the job
+store is the orchestrator checkpoint: a restarted server resumes from it
+(server.lua:470-492's resume matrix).
+
+The TPU hot path never goes through here — training loops run jitted on
+device (parallel/, train/); this coordinator exists for fault tolerance,
+arbitrary-Python workloads, and multi-process pools, exactly the role the
+reference's MongoDB server played minus the hot-path round trips.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from lua_mapreduce_tpu.core.constants import (DEFAULT_SLEEP, MAX_JOB_RETRIES,
+                                              Status, TaskStatus)
+from lua_mapreduce_tpu.coord.jobstore import JobStore, make_job
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.job import JobTimes
+from lua_mapreduce_tpu.engine.local import (collect_task_jobs, delete_results,
+                                            discover_partitions, iter_results,
+                                            result_file_name)
+from lua_mapreduce_tpu.engine.worker import MAP_NS, RED_NS
+from lua_mapreduce_tpu.store.router import get_storage_from
+from lua_mapreduce_tpu.utils.stats import IterationStats, TaskStats
+
+
+class Server:
+    """Orchestrate one task over an elastic worker pool.
+
+    ``stale_timeout_s`` (None disables) requeues RUNNING jobs whose worker
+    silently died — see JobStore.requeue_stale.
+    """
+
+    def __init__(self, store: JobStore, poll_interval: float = DEFAULT_SLEEP,
+                 stale_timeout_s: Optional[float] = 600.0,
+                 verbose: bool = False):
+        self.store = store
+        self.poll_interval = poll_interval
+        self.stale_timeout_s = stale_timeout_s
+        self.verbose = verbose
+        self.spec: Optional[TaskSpec] = None
+        self.stats = TaskStats()
+        self.finished_value: Any = None
+
+    # -- configuration ------------------------------------------------------
+
+    def configure(self, spec: TaskSpec) -> "Server":
+        """Validate + register the user program (server.lua:419-462).
+        The spec must be module-path based so workers can load it, and its
+        storage must actually be reachable by the pool's workers."""
+        spec.describe()  # raises if not importable cross-process
+        self._check_storage_reachable(spec)
+        self.spec = spec
+        return self
+
+    def _check_storage_reachable(self, spec: TaskSpec) -> None:
+        """A distributed pool needs storage every worker can see. Bare
+        ``mem`` is private to each get_storage_from() call and would make
+        the task 'succeed' with empty results; ``mem:tag`` is only shared
+        in-process, so it cannot back a FileJobStore (multi-process) pool."""
+        from lua_mapreduce_tpu.coord.filestore import FileJobStore
+        from lua_mapreduce_tpu.store.router import parse_storage
+        for spec_str in (spec.storage, spec.result_storage):
+            if spec_str is None:
+                continue
+            backend, path = parse_storage(spec_str)
+            if backend != "mem":
+                continue
+            if path is None:
+                raise ValueError(
+                    f"storage {spec_str!r}: bare 'mem' is private per "
+                    "process — use 'mem:TAG' for in-process pools or "
+                    "'shared:DIR' / 'object:DIR' for multi-process pools")
+            if isinstance(self.store, FileJobStore):
+                raise ValueError(
+                    f"storage {spec_str!r} is in-process memory, but the "
+                    "job store is a FileJobStore (multi-process pool) — "
+                    "workers in other processes could not see the data; "
+                    "use 'shared:DIR' or 'object:DIR'")
+
+    # -- main loop ----------------------------------------------------------
+
+    def loop(self, progress: Optional[Callable[[str, float], None]] = None) -> TaskStats:
+        """Run the task to completion; returns aggregate stats.
+
+        Resume semantics (server.lua:470-492): FINISHED task doc → drop
+        state, start fresh; REDUCE → skip the map phase and restore the
+        spec recorded in the task doc; WAIT/MAP → resume the iteration in
+        place, keeping WRITTEN jobs.
+        """
+        t0 = time.time()
+        skip_map = False
+        iteration = 1
+
+        task = self.store.get_task()
+        if task is not None and "spec" in task:
+            status = task.get("status")
+            if status == TaskStatus.FINISHED.value:
+                self._drop_everything()
+                task = None
+            else:
+                iteration = int(task.get("iteration", 1))
+                if self.spec is None:
+                    self.spec = TaskSpec.from_description(task["spec"])
+                if status == TaskStatus.REDUCE.value:
+                    skip_map = True
+        if self.spec is None:
+            raise RuntimeError("configure() a TaskSpec before loop()")
+        if task is None:
+            self.store.put_task({
+                "_id": "unique",
+                "status": TaskStatus.WAIT.value,
+                "iteration": iteration,
+                "spec": self.spec.describe(),
+                "started": time.time(),
+            })
+
+        store = get_storage_from(self.spec.storage)
+        result_store = (get_storage_from(self.spec.result_storage)
+                        if self.spec.result_storage else store)
+
+        while True:
+            it_stats = IterationStats(iteration=iteration)
+            it_t0 = time.time()
+
+            if not skip_map:
+                delete_results(result_store, self.spec.result_ns)
+                n_map = self._prepare_map(store)
+                self._wait_phase(MAP_NS, n_map, "map", progress)
+                it_stats.map.fold(self._phase_times(MAP_NS),
+                                  failed=self.store.counts(MAP_NS)[Status.FAILED])
+            skip_map = False
+
+            n_red = self._prepare_reduce(store)
+            if n_red:
+                self._wait_phase(RED_NS, n_red, "reduce", progress)
+            it_stats.reduce.fold(self._phase_times(RED_NS),
+                                 failed=self.store.counts(RED_NS)[Status.FAILED])
+
+            verdict: Any = None
+            if self.spec.finalfn is not None:
+                verdict = self.spec.finalfn(
+                    iter_results(result_store, self.spec.result_ns))
+
+            it_stats.wall_time = time.time() - it_t0
+            self.stats.iterations.append(it_stats)
+            self.store.update_task({"stats": it_stats.as_dict()})
+            self._log(f"iteration {iteration}: cluster_time="
+                      f"{it_stats.cluster_time:.2f}s wall={it_stats.wall_time:.2f}s")
+
+            if verdict == "loop":
+                iteration += 1
+                self.store.drop_ns(MAP_NS)
+                self.store.drop_ns(RED_NS)
+                self.store.update_task({"iteration": iteration,
+                                        "status": TaskStatus.WAIT.value})
+                continue
+
+            self.finished_value = verdict
+            self.store.update_task({"status": TaskStatus.FINISHED.value})
+            if verdict is True:
+                delete_results(result_store, self.spec.result_ns)
+                self._drop_everything()
+            break
+
+        self.stats.wall_time = time.time() - t0
+        return self.stats
+
+    # -- phases -------------------------------------------------------------
+
+    def _prepare_map(self, store) -> int:
+        """Insert map jobs and open the MAP phase (server_prepare_map,
+        server.lua:249-276). On resume with an unchanged job set, WRITTEN
+        jobs are kept; in-flight claims are left alone (live workers will
+        complete them, dead ones fall to the _wait_phase stale requeue).
+        On a fresh start or a changed taskfn shape, stale intermediate run
+        files are purged first so old data can never leak into reduce."""
+        jobs = collect_task_jobs(self.spec)
+        existing = self.store.counts(MAP_NS)
+        n_existing = sum(existing.values())
+        if n_existing != len(jobs):
+            if n_existing:
+                self.store.drop_ns(MAP_NS)  # taskfn changed shape: restart
+            self._clean_runs(store)
+            self.store.insert_jobs(
+                MAP_NS, [make_job(k, v) for k, v in jobs])
+        self.store.update_task({"status": TaskStatus.MAP.value})
+        return len(jobs)
+
+    def _clean_runs(self, store) -> None:
+        """Drop every intermediate run file of this namespace
+        (``ns.P*.M*``) — the map-side analog of delete_results."""
+        for name in store.list(f"{self.spec.result_ns}.P*.M*"):
+            store.remove(name)
+
+    def _prepare_reduce(self, store) -> int:
+        """Discover map-output partitions and insert one reduce job per
+        non-empty partition (server_prepare_reduce, server.lua:279-329)."""
+        self.store.drop_ns(RED_NS)
+        parts = discover_partitions(store, self.spec.result_ns)
+        docs = []
+        for part, files in sorted(parts.items()):
+            docs.append(make_job(part, {
+                "part": part,
+                "files": files,
+                "result": result_file_name(self.spec.result_ns, part),
+            }))
+        if docs:
+            self.store.insert_jobs(RED_NS, docs)
+        self.store.update_task({"status": TaskStatus.REDUCE.value})
+        return len(docs)
+
+    def _wait_phase(self, ns: str, total: int, phase: str,
+                    progress: Optional[Callable[[str, float], None]]) -> None:
+        """Barrier poll (make_task_coroutine_wrap, server.lua:186-234):
+        every interval — scavenge BROKEN≥3→FAILED, requeue stale RUNNING,
+        drain + surface worker errors, report progress — until every job is
+        WRITTEN or FAILED."""
+        while True:
+            self.store.scavenge(ns, MAX_JOB_RETRIES)
+            if self.stale_timeout_s is not None:
+                self.store.requeue_stale(ns, self.stale_timeout_s)
+            for err in self.store.drain_errors():
+                self._log(f"worker error [{err['worker']}]: "
+                          f"{err['msg'].splitlines()[-1] if err['msg'] else ''}")
+            counts = self.store.counts(ns)
+            done = counts[Status.WRITTEN] + counts[Status.FAILED]
+            if progress is not None:
+                progress(phase, done / max(total, 1))
+            if done >= total:
+                if counts[Status.FAILED]:
+                    self._log(f"{phase}: {counts[Status.FAILED]} job(s) FAILED "
+                              f"after {MAX_JOB_RETRIES} retries")
+                return
+            time.sleep(self.poll_interval)
+
+    # -- stats / cleanup ----------------------------------------------------
+
+    def _phase_times(self, ns: str) -> List[JobTimes]:
+        out = []
+        for doc in self.store.jobs(ns):
+            t = doc.get("times")
+            if t:
+                out.append(JobTimes(started=t["started"], finished=t["finished"],
+                                    written=t["written"], cpu=t["cpu"]))
+        return out
+
+    def _drop_everything(self) -> None:
+        """server_drop_collections (server.lua:331-345)."""
+        self.store.drop_ns(MAP_NS)
+        self.store.drop_ns(RED_NS)
+        self.store.delete_task()
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            print(f"[server] {msg}", flush=True)

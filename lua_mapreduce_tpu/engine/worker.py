@@ -1,0 +1,195 @@
+"""Elastic worker runtime.
+
+Analog of reference mapreduce/worker.lua (SURVEY.md §3.2): a polling loop
+that discovers the current task phase from the task document, claims jobs
+through the store's CAS, executes them via engine/job.py, and survives user
+code failures by marking jobs BROKEN and logging to the errors stream.
+Workers are fully elastic — they may join or leave at any time; the pool
+size is simply how many of these loops are running (threads in-process, or
+processes/hosts over a FileJobStore).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+import uuid
+from typing import Dict, Optional
+
+from lua_mapreduce_tpu.core.constants import (DEFAULT_SLEEP, MAX_IDLE_COUNT,
+                                              MAX_WORKER_RETRIES, Status,
+                                              TaskStatus)
+from lua_mapreduce_tpu.coord.jobstore import JobStore
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.job import run_map_job, run_reduce_job
+from lua_mapreduce_tpu.store.router import get_storage_from
+
+MAP_NS = "map_jobs"
+RED_NS = "red_jobs"
+
+_CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks")
+
+
+class Worker:
+    """Claim-and-execute loop (reference worker.lua:42-138)."""
+
+    def __init__(self, store: JobStore, name: Optional[str] = None,
+                 verbose: bool = False):
+        self.store = store
+        self.name = name or f"worker-{uuid.uuid4().hex[:8]}-{os.getpid()}"
+        self.verbose = verbose
+        self.max_iter = 20
+        self.max_sleep = 20.0
+        self.max_tasks = 1
+        self._spec_cache: Dict[str, TaskSpec] = {}
+        self._affinity: list = []       # map-job ids this worker ran before
+        self._idle_count = 0
+        self.jobs_executed = 0
+
+    def configure(self, **params) -> "Worker":
+        """Set max_iter / max_sleep / max_tasks; unknown keys are rejected
+        (reference worker.lua:142-148)."""
+        for k, v in params.items():
+            if k not in _CONFIG_KEYS:
+                raise KeyError(f"unknown worker config key {k!r}; "
+                               f"known: {_CONFIG_KEYS}")
+            setattr(self, k, v)
+        return self
+
+    # -- one poll ----------------------------------------------------------
+
+    def poll_once(self) -> str:
+        """One discovery+claim+execute round. Returns what happened:
+        "wait" (no task yet), "idle" (nothing claimable), "executed",
+        or "finished" (task is done)."""
+        task = self.store.get_task()
+        if task is None or task.get("status") == TaskStatus.WAIT.value:
+            return "wait"
+        if task.get("status") == TaskStatus.FINISHED.value:
+            return "finished"
+
+        spec = self._get_spec(task["spec"])
+        iteration = int(task.get("iteration", 1))
+
+        if task["status"] == TaskStatus.MAP.value:
+            preferred = self._affinity if iteration > 1 else None
+            steal = not preferred or self._idle_count >= MAX_IDLE_COUNT
+            job = self.store.claim(MAP_NS, self.name, preferred, steal=steal)
+            if job is None:
+                self._idle_count += 1
+                return "idle"
+            self._idle_count = 0
+            self._execute_map(spec, job)
+            return "executed"
+
+        if task["status"] == TaskStatus.REDUCE.value:
+            job = self.store.claim(RED_NS, self.name)
+            if job is None:
+                return "idle"
+            self._execute_reduce(spec, job)
+            return "executed"
+
+        raise RuntimeError(f"unknown task status {task['status']!r}")
+
+    # -- job execution ------------------------------------------------------
+
+    def _execute_map(self, spec: TaskSpec, job: dict) -> None:
+        ns, jid = MAP_NS, job["_id"]
+        try:
+            store = get_storage_from(spec.storage)
+            times = run_map_job(spec, store, str(jid), job["key"], job["value"])
+            self.store.set_job_status(ns, jid, Status.FINISHED,
+                                      expect=(Status.RUNNING,))
+            self.store.set_job_times(ns, jid, _times_dict(times))
+            self.store.set_job_status(ns, jid, Status.WRITTEN,
+                                      expect=(Status.FINISHED,))
+            if jid not in self._affinity:
+                self._affinity.append(jid)
+            self.jobs_executed += 1
+            self._log(f"map job {jid} done ({times.real:.3f}s)")
+        except Exception:
+            self._mark_broken(ns, jid)
+            raise
+
+    def _execute_reduce(self, spec: TaskSpec, job: dict) -> None:
+        ns, jid = RED_NS, job["_id"]
+        try:
+            store = get_storage_from(spec.storage)
+            result_store = (get_storage_from(spec.result_storage)
+                            if spec.result_storage else store)
+            v = job["value"]
+            times = run_reduce_job(spec, store, result_store, str(v["part"]),
+                                   v["files"], v["result"])
+            self.store.set_job_status(ns, jid, Status.FINISHED,
+                                      expect=(Status.RUNNING,))
+            self.store.set_job_times(ns, jid, _times_dict(times))
+            self.store.set_job_status(ns, jid, Status.WRITTEN,
+                                      expect=(Status.FINISHED,))
+            self.jobs_executed += 1
+            self._log(f"reduce job {jid} done ({times.real:.3f}s)")
+        except Exception:
+            self._mark_broken(ns, jid)
+            raise
+
+    def _mark_broken(self, ns: str, jid: int) -> None:
+        """Job → BROKEN (+1 repetition) and error → errors stream
+        (reference job.lua:322-342, cnn.lua:62-66)."""
+        self.store.set_job_status(ns, jid, Status.BROKEN)
+        self.store.insert_error(self.name, traceback.format_exc())
+
+    # -- main loop ----------------------------------------------------------
+
+    def execute(self) -> int:
+        """Run until max_iter idle polls or max_tasks tasks completed
+        (reference worker.lua:42-138). Returns jobs executed. User-code
+        errors mark the job BROKEN and count against MAX_WORKER_RETRIES;
+        the worker dies after 3 consecutive failures (worker.lua:133-137)."""
+        retries = 0
+        idle_iters = 0
+        tasks_done = 0
+        sleep = DEFAULT_SLEEP
+        saw_work = False
+        while idle_iters < self.max_iter and tasks_done < self.max_tasks:
+            try:
+                outcome = self.poll_once()
+            except Exception:
+                retries += 1
+                if retries >= MAX_WORKER_RETRIES:
+                    self._log(f"giving up after {retries} failures")
+                    raise
+                time.sleep(DEFAULT_SLEEP)
+                continue
+            retries = 0
+            if outcome == "executed":
+                saw_work = True
+                idle_iters = 0
+                sleep = DEFAULT_SLEEP
+            elif outcome == "finished" and saw_work:
+                tasks_done += 1
+                saw_work = False
+            else:
+                idle_iters += 1
+                time.sleep(sleep)
+                sleep = min(sleep * 1.5, self.max_sleep)  # worker.lua:100-102
+        return self.jobs_executed
+
+    # -- helpers ------------------------------------------------------------
+
+    def _get_spec(self, desc: dict) -> TaskSpec:
+        key = json.dumps(desc, sort_keys=True, default=str)
+        spec = self._spec_cache.get(key)
+        if spec is None:
+            spec = TaskSpec.from_description(desc)
+            self._spec_cache[key] = spec
+        return spec
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            print(f"[{self.name}] {msg}", flush=True)
+
+
+def _times_dict(times) -> dict:
+    return {"started": times.started, "finished": times.finished,
+            "written": times.written, "cpu": times.cpu, "real": times.real}

@@ -1,0 +1,174 @@
+"""Job store tests: claim CAS, status machine, scavenger, stale requeue,
+native/Python index interop (analog of task.lua + cnn.lua utests)."""
+
+import threading
+
+import pytest
+
+from lua_mapreduce_tpu.coord.filestore import FileJobStore
+from lua_mapreduce_tpu.coord.idx import native_available, open_index
+from lua_mapreduce_tpu.coord.idx_py import PyJobIndex
+from lua_mapreduce_tpu.coord.jobstore import MemJobStore, make_job
+from lua_mapreduce_tpu.core.constants import Status
+
+
+def _stores(tmp_path):
+    return [MemJobStore(),
+            FileJobStore(str(tmp_path / "fs-py"), engine="python"),
+            FileJobStore(str(tmp_path / "fs-auto"))]
+
+
+@pytest.mark.parametrize("idx", [0, 1, 2], ids=["mem", "file-py", "file-auto"])
+def test_claim_and_status_machine(tmp_path, idx):
+    store = _stores(tmp_path)[idx]
+    ids = store.insert_jobs("map_jobs", [make_job(i, f"v{i}") for i in range(3)])
+    assert ids == [0, 1, 2]
+
+    j = store.claim("map_jobs", "w1")
+    assert j is not None and j["_id"] == 0 and j["key"] == 0
+    assert j["value"] == "v0"
+    assert store.get_job("map_jobs", 0)["status"] == Status.RUNNING
+
+    # double-claim cannot hand out the same job
+    j2 = store.claim("map_jobs", "w2")
+    assert j2["_id"] == 1
+
+    # CAS transitions honor expectations
+    assert store.set_job_status("map_jobs", 0, Status.FINISHED,
+                                expect=(Status.RUNNING,))
+    assert not store.set_job_status("map_jobs", 0, Status.WRITTEN,
+                                    expect=(Status.RUNNING,))
+    assert store.set_job_status("map_jobs", 0, Status.WRITTEN,
+                                expect=(Status.FINISHED,))
+
+    counts = store.counts("map_jobs")
+    assert counts[Status.WRITTEN] == 1
+    assert counts[Status.RUNNING] == 1
+    assert counts[Status.WAITING] == 1
+
+
+@pytest.mark.parametrize("idx", [0, 1], ids=["mem", "file-py"])
+def test_broken_retry_and_scavenge(tmp_path, idx):
+    store = _stores(tmp_path)[idx]
+    store.insert_jobs("map_jobs", [make_job(0, "x")])
+    for expected_reps in (1, 2, 3):
+        j = store.claim("map_jobs", "w")
+        assert j is not None
+        store.set_job_status("map_jobs", 0, Status.BROKEN)
+        assert store.get_job("map_jobs", 0)["repetitions"] == expected_reps
+    # BROKEN is re-claimable until the scavenger fails it (3 retries)
+    assert store.scavenge("map_jobs", 3) == 1
+    assert store.get_job("map_jobs", 0)["status"] == Status.FAILED
+    assert store.claim("map_jobs", "w") is None
+
+
+@pytest.mark.parametrize("idx", [0, 1], ids=["mem", "file-py"])
+def test_requeue_stale_running(tmp_path, idx):
+    store = _stores(tmp_path)[idx]
+    store.insert_jobs("ns", [make_job(0, "x")])
+    store.claim("ns", "dead-worker")
+    assert store.requeue_stale("ns", older_than_s=3600) == 0  # too young
+    assert store.requeue_stale("ns", older_than_s=0.0) == 1
+    j = store.get_job("ns", 0)
+    assert j["status"] == Status.BROKEN and j["repetitions"] == 1
+    assert store.claim("ns", "live-worker")["_id"] == 0
+
+
+@pytest.mark.parametrize("idx", [0, 1], ids=["mem", "file-py"])
+def test_requeue_stale_covers_finished(tmp_path, idx):
+    """Regression: a worker killed between FINISHED and WRITTEN must not
+    wedge the barrier — FINISHED is requeueable too."""
+    store = _stores(tmp_path)[idx]
+    store.insert_jobs("ns", [make_job(0, "x")])
+    store.claim("ns", "w")
+    store.set_job_status("ns", 0, Status.FINISHED, expect=(Status.RUNNING,))
+    assert store.requeue_stale("ns", older_than_s=0.0) == 1
+    assert store.get_job("ns", 0)["status"] == Status.BROKEN
+
+
+def test_cas_on_dropped_namespace_is_false(tmp_path):
+    """Regression: straggler CAS after drop_ns returns False (both store
+    kinds), never raises."""
+    for store in _stores(tmp_path)[:2]:
+        store.insert_jobs("ns", [make_job(0, "x")])
+        store.claim("ns", "w")
+        store.drop_ns("ns")
+        assert store.set_job_status("ns", 0, Status.FINISHED,
+                                    expect=(Status.RUNNING,)) is False
+        store.set_job_times("ns", 0, {"started": 0, "finished": 0,
+                                      "written": 0, "cpu": 0, "real": 0})
+
+
+@pytest.mark.parametrize("idx", [0, 1], ids=["mem", "file-py"])
+def test_preferred_and_steal(tmp_path, idx):
+    store = _stores(tmp_path)[idx]
+    store.insert_jobs("ns", [make_job(i, i) for i in range(4)])
+    j = store.claim("ns", "w", preferred_ids=[2])
+    assert j["_id"] == 2
+    # preferred taken, no steal → nothing
+    assert store.claim("ns", "w", preferred_ids=[2], steal=False) is None
+    # steal allowed → first free
+    assert store.claim("ns", "w", preferred_ids=[2], steal=True)["_id"] == 0
+
+
+def test_errors_stream_and_task_doc(tmp_path):
+    for store in _stores(tmp_path)[:2]:
+        store.put_task({"_id": "unique", "status": "WAIT", "iteration": 1})
+        store.update_task({"status": "MAP"})
+        assert store.get_task()["status"] == "MAP"
+
+        store.insert_error("w1", "boom")
+        store.insert_error("w2", "bang")
+        errs = store.drain_errors()
+        assert [e["worker"] for e in errs] == ["w1", "w2"]
+        assert store.drain_errors() == []
+
+        store.delete_task()
+        assert store.get_task() is None
+
+
+def test_native_python_interop(tmp_path):
+    if not native_available():
+        pytest.skip("native index unavailable")
+    path = str(tmp_path / "interop.idx")
+    nat = open_index(path, "native")
+    py = PyJobIndex(path)
+    assert type(nat).__name__ == "NativeJobIndex"
+
+    nat.insert(4)
+    assert py.count() == 4
+    assert py.claim(worker=7, now=1.0) == 0       # python claims
+    assert nat.claim(worker=8, now=2.0) == 1      # native claims next
+    s0 = py.get(0)
+    assert s0[0] == Status.RUNNING and s0[2] == 7
+    s1 = nat.get(1)
+    assert s1[0] == Status.RUNNING and s1[2] == 8
+    assert nat.cas_status(0, Status.BROKEN)
+    assert py.get(0)[1] == 1                      # repetition visible to py
+    c = nat.counts()
+    assert c[Status.RUNNING] == 1 and c[Status.BROKEN] == 1
+    assert c[Status.WAITING] == 2
+
+
+def test_concurrent_claims_are_exclusive(tmp_path):
+    """N threads hammering claim() must hand out each job exactly once."""
+    store = FileJobStore(str(tmp_path / "conc"))
+    n_jobs, n_workers = 40, 8
+    store.insert_jobs("ns", [make_job(i, i) for i in range(n_jobs)])
+    claimed = []
+    lock = threading.Lock()
+
+    def grab(wid):
+        while True:
+            j = store.claim("ns", f"w{wid}")
+            if j is None:
+                return
+            with lock:
+                claimed.append(j["_id"])
+
+    threads = [threading.Thread(target=grab, args=(i,)) for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(claimed) == list(range(n_jobs))  # no dup, no loss

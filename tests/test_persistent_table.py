@@ -1,0 +1,113 @@
+"""Persistent table tests (analog persistent_table.lua:256-264 utest:
+two clients round-tripping through one document)."""
+
+import threading
+
+import pytest
+
+from lua_mapreduce_tpu.coord.filestore import FileJobStore
+from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+from lua_mapreduce_tpu.coord.persistent_table import (ConflictError,
+                                                      PersistentTable)
+
+
+def _stores(tmp_path):
+    return [MemJobStore(), FileJobStore(str(tmp_path / "pt"))]
+
+
+@pytest.mark.parametrize("idx", [0, 1], ids=["mem", "file"])
+def test_two_clients_roundtrip(tmp_path, idx):
+    store = _stores(tmp_path)[idx]
+    a = PersistentTable("conf", store)
+    a["model"] = "m.ckpt"
+    a["epoch"] = 3
+    a.update()
+
+    b = PersistentTable("conf", store)
+    assert b["model"] == "m.ckpt" and b["epoch"] == 3
+
+    b["epoch"] = 4
+    b.update()
+    a.update()          # clean → refresh pulls b's commit
+    assert a["epoch"] == 4
+
+
+@pytest.mark.parametrize("idx", [0, 1], ids=["mem", "file"])
+def test_optimistic_conflict_detected(tmp_path, idx):
+    store = _stores(tmp_path)[idx]
+    a = PersistentTable("c", store)
+    b = PersistentTable("c", store)
+    a["x"] = 1
+    a.update()
+    b["x"] = 2          # b still holds the pre-commit timestamp
+    with pytest.raises(ConflictError):
+        b.update()
+    b.refresh()
+    b.update()          # after refresh the commit goes through
+    assert PersistentTable("c", store)["x"] == 2
+
+
+def test_lock_mutual_exclusion(tmp_path):
+    store = FileJobStore(str(tmp_path / "lk"))
+    t1 = PersistentTable("locked", store)
+    order = []
+
+    def contender():
+        t2 = PersistentTable("locked", store)
+        t2.lock(poll=0.01)
+        order.append("t2")
+        t2.unlock()
+
+    t1.lock()
+    order.append("t1")
+    th = threading.Thread(target=contender)
+    th.start()
+    th.join(timeout=0.2)
+    assert th.is_alive()        # blocked on t1's lock
+    t1.unlock()
+    th.join(timeout=5)
+    assert order == ["t1", "t2"]
+
+
+def test_reserved_keys_and_read_only(tmp_path):
+    store = MemJobStore()
+    t = PersistentTable("r", store)
+    with pytest.raises(KeyError):
+        t["timestamp"] = 1
+    with pytest.raises(KeyError):
+        t["_hidden"] = 1
+    t["ok"] = 1
+    t.update()
+
+    ro = PersistentTable("r", store, read_only=True)
+    assert ro["ok"] == 1
+    with pytest.raises(PermissionError):
+        ro["ok"] = 2
+    with pytest.raises(PermissionError):
+        ro.drop()
+
+
+def test_commit_under_lock_keeps_lock(tmp_path):
+    """Regression: update() inside a lock() section must not release the
+    advisory lock."""
+    store = MemJobStore()
+    a = PersistentTable("held", store)
+    a.lock()
+    a["x"] = 1
+    a.update()          # must preserve the locked flag
+    b = PersistentTable("held", store)
+    with pytest.raises(TimeoutError):
+        b.lock(poll=0.01, timeout=0.1)
+    a.unlock()
+    b.lock(poll=0.01, timeout=1.0)
+    b.unlock()
+
+
+def test_drop(tmp_path):
+    store = MemJobStore()
+    t = PersistentTable("d", store)
+    t["k"] = "v"
+    t.update()
+    t.drop()
+    fresh = PersistentTable("d", store)
+    assert "k" not in fresh
