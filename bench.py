@@ -1,0 +1,109 @@
+"""Benchmark: digits-MLP data-parallel training throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: images/sec/chip on the BASELINE.json flagship workload (the
+reference's APRIL-ANN digits MLP, 256→128 tanh→10 log_softmax, trained with
+synchronous data-parallel SGD).
+
+``vs_baseline``: the reference publishes no number for its NN-training
+example (BASELINE.md: "published is empty"), so the baseline is the
+reference's *architecture* measured on this machine: the identical
+training workload run through the six-function MapReduce engine
+(map = grad shards, shuffle by parameter name, reduce = grad sum,
+finalfn = optimizer step — examples/digits/mr_train.py, the faithful
+re-expression of examples/APRIL-ANN/common.lua). vs_baseline =
+tpu_native_throughput / mapreduce_path_throughput — i.e. how much the
+TPU-native hot loop beats the coordination-driven loop, the ratio the
+BASELINE.json north star targets ("zero coordination round-trips on the
+hot path").
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_tpu_native(steps: int = 100, batch: int = 8192) -> float:
+    """Images/sec/chip of the jitted DP train step on real devices."""
+    import jax
+
+    from lua_mapreduce_tpu.models.mlp import init_mlp, nll_loss
+    from lua_mapreduce_tpu.parallel.mesh import make_mesh
+    from lua_mapreduce_tpu.train.data import make_digits
+    from lua_mapreduce_tpu.train.harness import DataParallelTrainer, TrainConfig
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = make_mesh(dp=n_chips, mp=1, devices=devices)
+
+    x_tr, y_tr, _, _ = make_digits(seed=0, n_train=batch * 2)
+    params = init_mlp(jax.random.PRNGKey(0))
+    tr = DataParallelTrainer(nll_loss, params, mesh,
+                             TrainConfig(batch_size=batch))
+
+    # the hot loop is lax.scan over batches inside ONE jitted call
+    # (zero host round-trips per step — the BASELINE.md north star);
+    # stepping one batch at a time would measure dispatch latency instead
+    rng = np.random.RandomState(0)
+    n = batch * steps
+    idx = rng.randint(0, len(x_tr), n)
+    xs = x_tr[idx].reshape(steps, batch, -1)
+    ys = y_tr[idx].reshape(steps, batch)
+
+    tr.run_epoch(x_tr[:batch * 2], y_tr[:batch * 2], rng)   # compile
+    xs_d, ys_d = tr._shard_batch(xs, ys, batched=True)
+    jax.block_until_ready((xs_d, ys_d))   # exclude h2d from the timing
+    t0 = time.perf_counter()
+    p, o, losses = tr._epoch(tr.params, tr.opt_state, xs_d, ys_d)
+    jax.block_until_ready(losses)
+    dt = time.perf_counter() - t0
+    tr.params, tr.opt_state = p, o
+    return steps * batch / dt / n_chips
+
+
+def bench_mapreduce_path(iterations: int = 3) -> float:
+    """Images/sec of the same workload through the six-function engine
+    (the reference-architecture path)."""
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.local import LocalExecutor
+
+    n_shards, bunch = 4, 128
+    args = {"sizes": (256, 128, 10), "n_shards": n_shards, "bunch": bunch,
+            "max_steps": iterations, "patience": 10_000,
+            "model_store": "mem:bench-model", "seed": 0}
+    spec = TaskSpec(taskfn="examples.digits.mr_train",
+                    mapfn="examples.digits.mr_train",
+                    partitionfn="examples.digits.mr_train",
+                    reducefn="examples.digits.mr_train",
+                    finalfn="examples.digits.mr_train",
+                    init_args=args, storage="mem:bench-shuffle")
+    ex = LocalExecutor(spec, map_parallelism=n_shards,
+                       max_iterations=iterations + 1)
+    t0 = time.perf_counter()
+    ex.run()
+    dt = time.perf_counter() - t0
+    return iterations * n_shards * bunch / dt
+
+
+def main() -> None:
+    # a wedged single-tenant TPU tunnel hangs backend init forever; probe
+    # from a killable subprocess and fall back to CPU rather than hang
+    from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
+    force_cpu_if_unavailable()
+
+    native = bench_tpu_native()
+    mr = bench_mapreduce_path()
+    print(json.dumps({
+        "metric": "digits_mlp_dp_training_images_per_sec_per_chip",
+        "value": round(native, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(native / mr, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

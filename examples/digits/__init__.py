@@ -1,0 +1,12 @@
+"""Distributed NN training as iterative MapReduce.
+
+Analog of reference mapreduce/examples/APRIL-ANN (SURVEY.md §2.3, §3.5):
+epoch-wise synchronous data-parallel SGD expressed as looping MapReduce —
+map = per-shard gradients, shuffle = partition by parameter name, reduce =
+gradient sum, finalfn = optimizer step + validation + early stopping,
+``"loop"`` until converged. ``mr_train.py`` is the single-module packaging
+(the reference passes "mapreduce.examples.APRIL-ANN" for all six slots).
+
+This is the capability-parity path on the host engine; the TPU-native hot
+path for the same model is lua_mapreduce_tpu.train.DataParallelTrainer.
+"""

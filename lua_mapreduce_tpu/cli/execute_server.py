@@ -49,8 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("reducefn")
     p.add_argument("--combinerfn")
     p.add_argument("--finalfn")
-    p.add_argument("--storage", default="mem",
-                   help="backend[:path] — mem | shared:DIR | object:DIR")
+    p.add_argument("--storage", default=None,
+                   help="backend[:path] — mem:TAG | shared:DIR | object:DIR "
+                        "(default: mem:cli for an in-process pool, "
+                        "shared:<COORD>/spill for a shared-dir pool)")
     p.add_argument("--result-ns", default="result")
     p.add_argument("--init-arg", action="append", metavar="K=V")
     p.add_argument("--inline-workers", type=int, default=0,
@@ -66,11 +68,21 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    # probe the accelerator from a killable subprocess BEFORE this process
+    # touches jax — a wedged single-tenant tunnel hangs in-process init
+    from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
+    force_cpu_if_unavailable()
+
     from lua_mapreduce_tpu.coord.filestore import FileJobStore
     from lua_mapreduce_tpu.coord.jobstore import MemJobStore
     from lua_mapreduce_tpu.engine.contract import TaskSpec
     from lua_mapreduce_tpu.engine.server import Server
     from lua_mapreduce_tpu.engine.worker import Worker
+
+    import os as _os
+    storage = args.storage or (
+        "mem:cli" if args.coord == "mem"
+        else f"shared:{_os.path.join(args.coord, 'spill')}")
 
     spec = TaskSpec(
         taskfn=normalize_module(args.taskfn),
@@ -80,7 +92,7 @@ def main(argv=None) -> int:
         combinerfn=normalize_module(args.combinerfn) if args.combinerfn else None,
         finalfn=normalize_module(args.finalfn) if args.finalfn else None,
         init_args=parse_init_args(args.init_arg),
-        storage=args.storage,
+        storage=storage,
         result_ns=args.result_ns,
     )
 

@@ -27,6 +27,11 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    # probe the accelerator from a killable subprocess BEFORE this process
+    # touches jax — a wedged single-tenant tunnel hangs in-process init
+    from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
+    force_cpu_if_unavailable()
+
     from lua_mapreduce_tpu.coord.filestore import FileJobStore
     from lua_mapreduce_tpu.engine.worker import Worker
 

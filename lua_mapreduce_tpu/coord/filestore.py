@@ -144,12 +144,14 @@ class FileJobStore(JobStore):
             pass  # observability only
         return self._job_doc(ns, jid, idx)
 
-    def set_job_status(self, ns, job_id, status, expect=None):
+    def set_job_status(self, ns, job_id, status, expect=None,
+                       expect_worker=None):
         mask = 0
         if expect is not None:
             for s in expect:
                 mask |= 1 << int(s)
-        return self._idx(ns).cas_status(job_id, status, mask)
+        whash = worker_hash(expect_worker) if expect_worker else 0
+        return self._idx(ns).cas_status(job_id, status, mask, whash)
 
     def get_job(self, ns, job_id):
         idx = self._idx(ns)

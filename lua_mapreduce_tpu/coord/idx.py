@@ -9,6 +9,7 @@ on-disk format, so the choice is per-process, not per-cluster.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -26,13 +27,30 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+def _src_digest() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
 def _build_native() -> Optional[str]:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
+    # freshness by source hash, not mtime: git checkout gives source and a
+    # stale binary identical mtimes, which would mask layout changes and
+    # break the native/Python on-disk format contract
+    digest_file = _SO + ".src.sha256"
+    digest = _src_digest()
+    if os.path.exists(_SO):
+        try:
+            with open(digest_file) as f:
+                if f.read().strip() == digest:
+                    return _SO
+        except OSError:
+            pass
     try:
         subprocess.run(
             ["g++", "-O2", "-fPIC", "-shared", "-o", _SO, _SRC],
             check=True, capture_output=True, timeout=120)
+        with open(digest_file, "w") as f:
+            f.write(digest)
         return _SO
     except (OSError, subprocess.SubprocessError):
         return None
@@ -61,7 +79,8 @@ def _load() -> Optional[ctypes.CDLL]:
                                   ctypes.c_int64, ctypes.c_int32]
         lib.jsx_cas_status.restype = ctypes.c_int
         lib.jsx_cas_status.argtypes = [ctypes.c_char_p, ctypes.c_int64,
-                                       ctypes.c_int32, ctypes.c_uint32]
+                                       ctypes.c_int32, ctypes.c_uint32,
+                                       ctypes.c_int64]
         lib.jsx_get.restype = ctypes.c_int
         lib.jsx_get.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                 ctypes.POINTER(ctypes.c_int32),
@@ -116,8 +135,10 @@ class NativeJobIndex:
         return self._lib.jsx_claim(self._p, worker, arr, len(pref),
                                    1 if steal else 0)
 
-    def cas_status(self, job_id: int, to: Status, expect_mask: int = 0) -> bool:
-        r = self._lib.jsx_cas_status(self._p, job_id, int(to), expect_mask)
+    def cas_status(self, job_id: int, to: Status, expect_mask: int = 0,
+                   expect_worker: int = 0) -> bool:
+        r = self._lib.jsx_cas_status(self._p, job_id, int(to), expect_mask,
+                                     expect_worker)
         if r < 0:
             raise OSError(f"jsx_cas_status failed on {self.path}")
         return bool(r)

@@ -127,12 +127,13 @@ class PyJobIndex:
         finally:
             os.close(fd)
 
-    def cas_status(self, job_id: int, to: Status,
-                   expect_mask: int = 0) -> bool:
+    def cas_status(self, job_id: int, to: Status, expect_mask: int = 0,
+                   expect_worker: int = 0) -> bool:
         """Set status iff current status is in ``expect_mask`` (bitmask of
-        ``1 << status``; 0 = unconditional). Moving to BROKEN increments
-        ``repetitions`` (job.lua:322-342). A missing index (namespace
-        dropped under a straggler) is a False, not an error."""
+        ``1 << status``; 0 = unconditional) AND, when ``expect_worker`` is
+        nonzero, the record's claim owner matches. Moving to BROKEN
+        increments ``repetitions`` (job.lua:322-342). A missing index
+        (namespace dropped under a straggler) is a False, not an error."""
         if not os.path.exists(self.path):
             return False
         fd = self._open_locked()
@@ -141,6 +142,8 @@ class PyJobIndex:
                 return False
             status, reps, w, st, rv = self._read_rec(fd, job_id)
             if expect_mask and not ((1 << status) & expect_mask):
+                return False
+            if expect_worker and w != expect_worker:
                 return False
             if to == Status.BROKEN:
                 reps += 1

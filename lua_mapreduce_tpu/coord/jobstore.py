@@ -70,9 +70,13 @@ class JobStore(abc.ABC):
 
     @abc.abstractmethod
     def set_job_status(self, ns: str, job_id: int, status: Status,
-                       expect: Optional[Sequence[Status]] = None) -> bool:
+                       expect: Optional[Sequence[Status]] = None,
+                       expect_worker: Optional[str] = None) -> bool:
         """CAS a job's status; bumps ``repetitions`` when moving to BROKEN
-        (job.lua:322-342). Returns False if ``expect`` did not match."""
+        (job.lua:322-342). Returns False if ``expect`` (statuses) or
+        ``expect_worker`` (claim ownership) does not match — a worker whose
+        claim was stale-requeued and re-claimed by someone else must not be
+        able to clobber the new claimant's state."""
 
     @abc.abstractmethod
     def get_job(self, ns: str, job_id: int) -> Optional[dict]: ...
@@ -200,13 +204,16 @@ class MemJobStore(JobStore):
                         return got
             return None
 
-    def set_job_status(self, ns, job_id, status, expect=None):
+    def set_job_status(self, ns, job_id, status, expect=None,
+                       expect_worker=None):
         with self._lock:
             queue = self._jobs.get(ns, [])
             if not (0 <= job_id < len(queue)):
                 return False
             d = queue[job_id]
             if expect is not None and d["status"] not in expect:
+                return False
+            if expect_worker is not None and d["worker"] != expect_worker:
                 return False
             if status == Status.BROKEN:
                 d["repetitions"] += 1

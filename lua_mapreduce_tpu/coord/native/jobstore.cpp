@@ -167,11 +167,13 @@ int64_t jsx_claim(const char* path, int64_t worker, const int64_t* preferred,
   return -1;
 }
 
-// CAS status; expect_mask is a bitmask of (1<<status), 0 = unconditional.
-// Moving to BROKEN increments repetitions. Returns 1 on success, 0 on
+// CAS status; expect_mask is a bitmask of (1<<status), 0 = unconditional;
+// expect_worker != 0 additionally requires the record's claim owner to
+// match (a stale claimant must not clobber a re-claimed job). Moving to
+// BROKEN increments repetitions. Returns 1 on success, 0 on
 // mismatch/bounds, -1 on error.
 int jsx_cas_status(const char* path, int64_t id, int32_t to,
-                   uint32_t expect_mask) {
+                   uint32_t expect_mask, int64_t expect_worker) {
   if (access(path, F_OK) != 0) return 0;  // namespace dropped: CAS misses
   LockedIndex idx(path, false);
   if (!idx.ok()) return -1;
@@ -180,6 +182,7 @@ int jsx_cas_status(const char* path, int64_t id, int32_t to,
   Record rec;
   if (!idx.read(id, &rec)) return -1;
   if (expect_mask && !((1u << rec.status) & expect_mask)) return 0;
+  if (expect_worker != 0 && rec.worker != expect_worker) return 0;
   if (to == kBroken) rec.repetitions += 1;
   rec.status = to;
   return idx.write(id, rec) ? 1 : -1;

@@ -43,23 +43,27 @@ class PersistentTable:
         self._read_only = read_only
         self._ts: Optional[int] = None
         self._data: Dict[str, Any] = {}
-        self._dirty = False
+        self._dirty_keys: set = set()   # locally modified, uncommitted keys
         self._locked = False   # the advisory-lock flag as of last refresh
         self.refresh()
 
     # -- core protocol -----------------------------------------------------
 
     def refresh(self) -> None:
-        """Pull the latest committed document (discards nothing dirty)."""
+        """Pull the latest committed document. Locally-dirty keys keep
+        their local values; every other key takes the committed value — so
+        the ConflictError → refresh() → update() retry never reverts
+        another writer's commit to a key this table did not touch."""
         doc = self._store.pt_get(self._name)
         if doc is None:
             self._ts = None
-            if not self._dirty:
-                self._data = {}
+            self._data = {k: v for k, v in self._data.items()
+                          if k in self._dirty_keys}
             return
         committed = {k: v for k, v in doc.items() if k not in _RESERVED}
-        if self._dirty:
-            committed.update({k: v for k, v in self._data.items()})
+        for k in self._dirty_keys:
+            if k in self._data:
+                committed[k] = self._data[k]
         self._ts = doc["timestamp"]
         self._locked = bool(doc.get("locked", False))
         self._data = committed
@@ -67,7 +71,7 @@ class PersistentTable:
     def update(self) -> None:
         """Commit dirty state (CAS on timestamp), or refresh when clean
         (the dual role of persistent_table.lua's ``:update``)."""
-        if not self._dirty:
+        if not self._dirty_keys:
             self.refresh()
             return
         self._assert_writable()
@@ -82,7 +86,7 @@ class PersistentTable:
                 f"persistent table {self._name!r}: concurrent commit beat "
                 f"timestamp {self._ts}; refresh() and retry")
         self._ts = new_ts
-        self._dirty = False
+        self._dirty_keys.clear()
 
     def set(self, mapping: Dict[str, Any]) -> None:
         """Bulk local assignment (commit with update())."""
@@ -92,7 +96,8 @@ class PersistentTable:
     def drop(self) -> None:
         self._assert_writable()
         self._store.pt_delete(self._name)
-        self._ts, self._data, self._dirty = None, {}, False
+        self._ts, self._data = None, {}
+        self._dirty_keys.clear()
 
     # -- advisory lock (persistent_table.lua:113-161) ----------------------
 
@@ -144,7 +149,7 @@ class PersistentTable:
             raise KeyError(f"reserved key {key!r} "
                            "(reference persistent_table.lua:95-110)")
         self._data[key] = value
-        self._dirty = True
+        self._dirty_keys.add(key)
 
     def __contains__(self, key: str) -> bool:
         return key in self._data
@@ -163,7 +168,7 @@ class PersistentTable:
 
     @property
     def dirty(self) -> bool:
-        return self._dirty
+        return bool(self._dirty_keys)
 
     @property
     def read_only(self) -> bool:

@@ -49,6 +49,7 @@ class Server:
         self.spec: Optional[TaskSpec] = None
         self.stats = TaskStats()
         self.finished_value: Any = None
+        self.errors: List[dict] = []   # every drained worker error, kept
 
     # -- configuration ------------------------------------------------------
 
@@ -229,6 +230,9 @@ class Server:
             if self.stale_timeout_s is not None:
                 self.store.requeue_stale(ns, self.stale_timeout_s)
             for err in self.store.drain_errors():
+                # the drain is destructive — always retain for diagnosis,
+                # not only when verbose (server.lua:218-228 echoes live)
+                self.errors.append(err)
                 self._log(f"worker error [{err['worker']}]: "
                           f"{err['msg'].splitlines()[-1] if err['msg'] else ''}")
             counts = self.store.counts(ns)
@@ -237,8 +241,14 @@ class Server:
                 progress(phase, done / max(total, 1))
             if done >= total:
                 if counts[Status.FAILED]:
-                    self._log(f"{phase}: {counts[Status.FAILED]} job(s) FAILED "
-                              f"after {MAX_JOB_RETRIES} retries")
+                    import sys
+                    print(f"[server] {phase}: {counts[Status.FAILED]} job(s) "
+                          f"FAILED after {MAX_JOB_RETRIES} retries; "
+                          f"{len(self.errors)} worker error(s) retained in "
+                          f"Server.errors"
+                          + (f"; last:\n{self.errors[-1]['msg']}"
+                             if self.errors else ""),
+                          file=sys.stderr)
                 return
             time.sleep(self.poll_interval)
 

@@ -100,15 +100,11 @@ class Worker:
         try:
             store = get_storage_from(spec.storage)
             times = run_map_job(spec, store, str(jid), job["key"], job["value"])
-            self.store.set_job_status(ns, jid, Status.FINISHED,
-                                      expect=(Status.RUNNING,))
-            self.store.set_job_times(ns, jid, _times_dict(times))
-            self.store.set_job_status(ns, jid, Status.WRITTEN,
-                                      expect=(Status.FINISHED,))
-            if jid not in self._affinity:
-                self._affinity.append(jid)
-            self.jobs_executed += 1
-            self._log(f"map job {jid} done ({times.real:.3f}s)")
+            if self._finish(ns, jid, times):
+                if jid not in self._affinity:
+                    self._affinity.append(jid)
+                self.jobs_executed += 1
+                self._log(f"map job {jid} done ({times.real:.3f}s)")
         except Exception:
             self._mark_broken(ns, jid)
             raise
@@ -122,21 +118,35 @@ class Worker:
             v = job["value"]
             times = run_reduce_job(spec, store, result_store, str(v["part"]),
                                    v["files"], v["result"])
-            self.store.set_job_status(ns, jid, Status.FINISHED,
-                                      expect=(Status.RUNNING,))
-            self.store.set_job_times(ns, jid, _times_dict(times))
-            self.store.set_job_status(ns, jid, Status.WRITTEN,
-                                      expect=(Status.FINISHED,))
-            self.jobs_executed += 1
-            self._log(f"reduce job {jid} done ({times.real:.3f}s)")
+            if self._finish(ns, jid, times):
+                self.jobs_executed += 1
+                self._log(f"reduce job {jid} done ({times.real:.3f}s)")
         except Exception:
             self._mark_broken(ns, jid)
             raise
 
+    def _finish(self, ns: str, jid: int, times) -> bool:
+        """RUNNING→FINISHED→WRITTEN, CASing on this worker's ownership.
+        Returns False when the claim was lost (stale-requeued and taken by
+        another worker) — the work's output still landed atomically, but
+        this worker must not touch the new claimant's state."""
+        if not self.store.set_job_status(ns, jid, Status.FINISHED,
+                                         expect=(Status.RUNNING,),
+                                         expect_worker=self.name):
+            self._log(f"job {jid}: claim lost before FINISHED; yielding")
+            return False
+        self.store.set_job_times(ns, jid, _times_dict(times))
+        self.store.set_job_status(ns, jid, Status.WRITTEN,
+                                  expect=(Status.FINISHED,),
+                                  expect_worker=self.name)
+        return True
+
     def _mark_broken(self, ns: str, jid: int) -> None:
         """Job → BROKEN (+1 repetition) and error → errors stream
-        (reference job.lua:322-342, cnn.lua:62-66)."""
-        self.store.set_job_status(ns, jid, Status.BROKEN)
+        (reference job.lua:322-342, cnn.lua:62-66). Ownership-checked: if
+        the claim was already requeued and re-claimed, leave it alone."""
+        self.store.set_job_status(ns, jid, Status.BROKEN,
+                                  expect_worker=self.name)
         self.store.insert_error(self.name, traceback.format_exc())
 
     # -- main loop ----------------------------------------------------------

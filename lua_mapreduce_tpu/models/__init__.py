@@ -1,0 +1,7 @@
+"""Model zoo.
+
+The "models" of the reference are its example workloads (SURVEY.md §2.3);
+the BASELINE.json configs name the targets: digits MLP (the APRIL-ANN
+example's 256→128 tanh→10 log_softmax, examples/APRIL-ANN/init.lua:12),
+LeNet-5, ResNet-18, and the iterative k-means / ALS state workloads.
+"""

@@ -1,0 +1,63 @@
+"""Digits MLP — the flagship DP-training model.
+
+The reference trains "256 inputs 128 tanh 10 log_softmax"
+(examples/APRIL-ANN/init.lua:12) with SGD + momentum + weight decay
+(init.lua:16-20). Pure-jax pytree params; bfloat16-friendly matmuls hit the
+MXU when the batch is big enough.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jnp.ndarray]
+
+DIGITS_SIZES = (256, 128, 10)   # init.lua:12
+
+
+def init_mlp(key, sizes: Sequence[int] = DIGITS_SIZES,
+             dtype=jnp.float32) -> Params:
+    """Glorot-uniform weights, zero biases; keys W0/b0, W1/b1, …
+    (the per-parameter-name key space the example's mapfn emits,
+    common.lua:85-104)."""
+    params: Params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        bound = jnp.sqrt(6.0 / (fan_in + fan_out))
+        params[f"W{i}"] = jax.random.uniform(
+            keys[i], (fan_in, fan_out), dtype, -bound, bound)
+        params[f"b{i}"] = jnp.zeros((fan_out,), dtype)
+    return params
+
+
+def n_layers(params: Params) -> int:
+    return sum(1 for k in params if k.startswith("W"))
+
+
+def mlp_apply(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """tanh hidden layers, log_softmax output (init.lua:12)."""
+    L = n_layers(params)
+    for i in range(L - 1):
+        x = jnp.tanh(x @ params[f"W{i}"] + params[f"b{i}"])
+    logits = x @ params[f"W{L-1}"] + params[f"b{L-1}"]
+    return jax.nn.log_softmax(logits)
+
+
+def nll_loss(params: Params, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Mean negative log-likelihood over a batch (labels are int classes)."""
+    logp = mlp_apply(params, x)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+def accuracy(params: Params, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(jnp.argmax(mlp_apply(params, x), axis=1) == y)
+
+
+def flops_per_example(sizes: Sequence[int] = DIGITS_SIZES) -> int:
+    """Forward+backward matmul FLOPs per example (for MFU accounting:
+    ≈ 3 × 2 × Σ fan_in·fan_out)."""
+    fwd = sum(2 * a * b for a, b in zip(sizes[:-1], sizes[1:]))
+    return 3 * fwd

@@ -1,0 +1,27 @@
+"""TPU execution backend: SPMD MapReduce over a device mesh.
+
+This is the layer that makes the framework TPU-native (SURVEY.md §7 step 5).
+When the user's map/reduce functions are JAX-traceable array programs, the
+whole map → combine → shuffle → reduce cycle compiles to ONE jitted SPMD
+program over a ``jax.sharding.Mesh``:
+
+- the map phase is a sharded computation (one shard per device — the analog
+  of one map job per worker, SURVEY.md §2.5)
+- the combiner is per-device pre-reduction before any communication (the
+  analog of the in-map combiner, job.lua:92-96)
+- keyed reduction lowers to ``psum`` / ``reduce_scatter`` over ICI (the
+  analog of the grad-sum reducefn, the reference's "all-reduce in
+  MapReduce clothing", common.lua:112-137)
+- the partitionfn/shuffle lowers to ``all_to_all`` bucketing (the analog of
+  partition files + reduce jobs, SURVEY.md §2.6)
+
+Functions that are NOT traceable keep the host-side engine (engine/local,
+engine/server) — identical semantics, arbitrary Python. The golden-diff
+harness runs the same logical task on both paths (tests/test_tpu_engine.py).
+"""
+
+from lua_mapreduce_tpu.parallel.mesh import host_mesh, make_mesh
+from lua_mapreduce_tpu.parallel.array_task import ArrayTaskSpec
+from lua_mapreduce_tpu.parallel.tpu_engine import TpuExecutor
+
+__all__ = ["make_mesh", "host_mesh", "ArrayTaskSpec", "TpuExecutor"]

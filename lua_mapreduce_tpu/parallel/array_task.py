@@ -1,0 +1,61 @@
+"""The traceable array-task contract.
+
+The TPU twin of engine/contract.TaskSpec: the same six roles, restated for
+JAX-traceable array programs with static shapes (the "Hard parts" list of
+SURVEY.md §7 — dynamic key spaces don't compile; fixed partition counts
+do):
+
+    taskfn   →  the input provider: a global batch (pytree of arrays)
+                whose leading axis is sharded over the mesh's ``dp`` axis
+                (one shard ≈ one map job)
+    mapfn    →  shard → keyed pytree of arrays (the emit'd key/value
+                groups; the pytree structure IS the key space, so it is
+                static — the analog of the APRIL-ANN example's per-
+                parameter gradient keys, common.lua:85-104)
+    combinerfn → local fold over the shard before any communication
+                (defaults to mapfn output already being combined)
+    partitionfn → for bucketed shuffles: shard → [P, ...] bucket tensor
+                (P = num_partitions, the NUM_REDUCERS analog; bucketing
+                is the user's, padding included)
+    reducefn →  associative elementwise fold used across devices
+                (default: sum → psum/reduce_scatter)
+    finalfn  →  reduced pytree → host decision ("loop" protocol) or, when
+                itself traceable, fused into the jitted program (zero
+                host round-trips per iteration)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+
+
+def _tree_sum(a, b):
+    return jax.tree.map(lambda x, y: x + y, a, b)
+
+
+@dataclasses.dataclass
+class ArrayTaskSpec:
+    """A traceable MapReduce program.
+
+    ``reduce_op``: one of "sum", "mean", "max", "min" — associative ops
+    with a native XLA cross-device collective; or a binary fold callable
+    for local (within-shard) use combined with ``reduce_op`` across
+    devices.
+    """
+
+    mapfn: Callable[..., Any]
+    reduce_op: str = "sum"
+    combinerfn: Optional[Callable[[Any], Any]] = None
+    partitionfn: Optional[Callable[[Any], Any]] = None
+    num_partitions: Optional[int] = None
+    finalfn: Optional[Callable[[Any], Any]] = None
+
+    def __post_init__(self):
+        if self.reduce_op not in ("sum", "mean", "max", "min"):
+            raise ValueError(f"reduce_op {self.reduce_op!r} not associative-"
+                             "collective; use sum|mean|max|min")
+        if self.partitionfn is not None and not self.num_partitions:
+            raise ValueError("bucketed shuffle needs num_partitions")

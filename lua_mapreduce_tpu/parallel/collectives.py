@@ -1,0 +1,73 @@
+"""Collective primitives over the mesh — the shuffle's transport.
+
+The reference moves intermediate data through GridFS/sharedfs/sshfs files
+(SURVEY.md §2.6); here the equivalent bytes ride ICI as XLA collectives.
+These wrappers operate on pytrees and keep the mapping to the reference
+explicit:
+
+- ``psum_tree``            — reducefn with assoc+commut flags ≈ all-reduce
+- ``reduce_scatter_tree``  — same, but each reducer keeps only its
+                             partition (one reduce job per partition,
+                             server.lua:300-325)
+- ``all_to_all_buckets``   — partitionfn bucketing: every mapper sends
+                             bucket p to reducer p (the shuffle itself)
+- ``all_gather_tree``      — result collection (server_final's pair
+                             iterator over all partitions)
+- ``ppermute_ring``        — neighbor exchange; building block for ring
+                             schedules (long-context sequence parallelism)
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def psum_tree(tree, axis: str):
+    """Sum every leaf across ``axis`` (full all-reduce on ICI)."""
+    return jax.tree.map(lambda x: lax.psum(x, axis), tree)
+
+
+def pmean_tree(tree, axis: str):
+    return jax.tree.map(lambda x: lax.pmean(x, axis), tree)
+
+
+def reduce_scatter_tree(tree, axis: str, scatter_dimension: int = 0,
+                        tiled: bool = True):
+    """Sum across ``axis`` but scatter the result: device i keeps slice i
+    along ``scatter_dimension``. Halves the wire bytes of psum when each
+    reducer only needs its own partition."""
+    return jax.tree.map(
+        lambda x: lax.psum_scatter(x, axis,
+                                   scatter_dimension=scatter_dimension,
+                                   tiled=tiled),
+        tree)
+
+
+def all_to_all_buckets(x, axis: str, bucket_dim: int = 0):
+    """The shuffle: ``x`` has a leading bucket dimension of size
+    ``mesh.shape[axis]`` (one bucket per partition, built by the caller's
+    partitionfn); after the exchange, device p holds every mapper's bucket
+    p, concatenated along ``bucket_dim``.
+
+    Shape: [P, ...] → [P, ...] where the leading axis switches meaning from
+    "destination partition" to "source mapper" — exactly the
+    map-output-files → reduce-job-input relabeling of server_prepare_reduce
+    (server.lua:291-312).
+    """
+    return lax.all_to_all(x, axis, split_axis=bucket_dim,
+                          concat_axis=bucket_dim, tiled=False)
+
+
+def all_gather_tree(tree, axis: str, gather_dimension: int = 0):
+    return jax.tree.map(
+        lambda x: lax.all_gather(x, axis, axis=gather_dimension, tiled=True),
+        tree)
+
+
+def ppermute_ring(x, axis: str, mesh_size: int, shift: int = 1):
+    """Rotate shards around the ring: device i → device (i+shift) % N.
+    The building block for ring-based schedules (ring attention / ring
+    all-reduce) where each step overlaps compute with neighbor DMA."""
+    perm = [(i, (i + shift) % mesh_size) for i in range(mesh_size)]
+    return lax.ppermute(x, axis, perm)

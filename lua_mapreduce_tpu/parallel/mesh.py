@@ -1,0 +1,62 @@
+"""Device-mesh construction.
+
+The mesh is the TPU build's "worker pool": where the reference's elastic
+workers claim jobs one at a time (task.lua:258-343), devices in a mesh each
+own a static shard of the computation and exchange data over ICI. Axis
+conventions used throughout this framework:
+
+- ``dp``  — data parallel (batch / map-shard axis; the map-phase analog)
+- ``mp``  — model parallel (tensor-sharded parameters)
+
+Helper policy: prefer all devices on one axis (pure DP) unless an ``mp``
+degree is requested; axes sized 1 are kept so downstream shardings can
+always name both axes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(dp: Optional[int] = None, mp: int = 1, devices=None,
+              axis_names: Tuple[str, str] = ("dp", "mp")):
+    """Build a 2-D ``jax.sharding.Mesh`` of shape (dp, mp).
+
+    ``dp`` defaults to ``len(devices) // mp``. Raises if the device count
+    is not divisible.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp is None:
+        if n % mp:
+            raise ValueError(f"{n} devices not divisible by mp={mp}")
+        dp = n // mp
+    if dp * mp != n:
+        raise ValueError(f"mesh {dp}x{mp} != {n} devices")
+    arr = np.array(devices).reshape(dp, mp)
+    return Mesh(arr, axis_names)
+
+
+def host_mesh(n: int = 8, dp: Optional[int] = None, mp: int = 1):
+    """Mesh over virtual CPU devices — the single-box stand-in for a pod
+    slice (the .travis.yml "multi-node on one machine" analog, SURVEY.md
+    §4). Requires ``--xla_force_host_platform_device_count=<n>`` (set by
+    tests/conftest.py)."""
+    import jax
+
+    cpus = jax.devices("cpu")
+    if len(cpus) < n:
+        raise RuntimeError(
+            f"need {n} CPU devices, have {len(cpus)} — set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n}")
+    return make_mesh(dp=dp, mp=mp, devices=cpus[:n])
+
+
+def mesh_axis_size(mesh, name: str) -> int:
+    return mesh.shape[name]

@@ -1,0 +1,18 @@
+"""Iterative training harness.
+
+The APRIL-ANN-example capability (SURVEY.md §3.5) as a first-class
+subsystem: data-parallel synchronous SGD where map = per-shard gradients,
+reduce = gradient sum over ICI, finalfn = optimizer step + validation +
+early stopping, and the loop protocol is the training loop. Two faces:
+
+- :class:`DataParallelTrainer` — the TPU-native hot path: one jitted SPMD
+  step over the mesh, zero coordination-store round-trips between steps
+  (the BASELINE.md north star)
+- examples/digits — the same algorithm packaged as the six MapReduce
+  functions, running on the host engine for capability parity with
+  arbitrary elastic pools
+"""
+
+from lua_mapreduce_tpu.train.harness import DataParallelTrainer, TrainConfig
+
+__all__ = ["DataParallelTrainer", "TrainConfig"]

@@ -1,0 +1,53 @@
+"""Model checkpointing through the storage layer.
+
+The GridFS-model-file analog (SURVEY.md §5 "Checkpoint / resume"
+mechanism 3: the APRIL-ANN example serializes the whole trainer to a GridFS
+file each iteration, common.lua:24-29, 72, 191). Pytrees are written as
+text records — a JSON manifest line plus one base64 npy-bytes line per
+leaf — so any Store backend (host DRAM, shared dir, object store) can hold
+checkpoints, and the atomic-build discipline makes them crash-safe.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def save_pytree(store, name: str, tree: Any) -> None:
+    """Atomically publish ``tree`` as checkpoint file ``name``."""
+    leaves, treedef = jax.tree.flatten(tree)
+    b = store.builder()
+    b.write(json.dumps({"v": 1, "n": len(leaves),
+                        "treedef": str(treedef)}) + "\n")
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        buf = io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        b.write(base64.b64encode(buf.getvalue()).decode() + "\n")
+    b.build(name)
+
+
+def load_pytree(store, name: str, like: Any) -> Any:
+    """Load checkpoint ``name``; ``like`` supplies the tree structure
+    (leaf values are ignored)."""
+    lines = iter(store.lines(name))
+    header = json.loads(next(lines))
+    leaves = []
+    for _ in range(header["n"]):
+        raw = base64.b64decode(next(lines).strip())
+        leaves.append(np.load(io.BytesIO(raw), allow_pickle=False))
+    treedef = jax.tree.structure(like)
+    if len(leaves) != treedef.num_leaves:
+        raise ValueError(f"checkpoint {name!r} has {len(leaves)} leaves, "
+                         f"expected {treedef.num_leaves}")
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def exists(store, name: str) -> bool:
+    return store.exists(name)

@@ -1,0 +1,204 @@
+"""Data-parallel training: the TPU-native hot path.
+
+The reference's training loop costs one full MapReduce cycle per optimizer
+step — taskfn → 4 map jobs → shuffle files → 10 reduce jobs → finalfn —
+with every transition a MongoDB round trip (SURVEY.md §3.5). Here the same
+dataflow (shard grads → all-reduce → optimizer step → loop) is ONE jitted
+SPMD program per step, and whole epochs run inside ``lax.scan`` with zero
+coordination-store traffic (the BASELINE.md north star). The coordinator
+only sees checkpoints and the early-stopping verdict — exactly the split
+SURVEY.md §7 prescribes ("iteration control moves into the jitted loop").
+
+Mapping to the reference example:
+    map    = per-device grad on its batch shard        (common.lua:85-104)
+    reduce = pmean over the dp axis                    (common.lua:112-137)
+    final  = optax update + validation + early stop    (common.lua:144-202)
+    state  = persistent_table + checkpoint file        (common.lua:57-77)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from lua_mapreduce_tpu.train import checkpoint as ckpt
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Hyperparameters (structure = the reference example's,
+    examples/APRIL-ANN/init.lua:16-20: lr/momentum/weight-decay, max 40
+    epochs, bunch of 128; early stopping via holdout validation). The
+    reference's lr=0.4/momentum=0.1 are tuned to its APRIL-ANN loss
+    scaling and diverge on plain mean-NLL; these defaults are stable."""
+    learning_rate: float = 0.05
+    momentum: float = 0.9
+    weight_decay: float = 1e-5      # init.lua weight_decay
+    batch_size: int = 128           # "bunch_size" init.lua:127-141
+    max_epochs: int = 40            # init.lua max epochs
+    patience: int = 10              # train_holdout_validation analog
+    seed: int = 1234
+
+
+class DataParallelTrainer:
+    """SPMD trainer over a mesh's ``dp`` axis.
+
+    ``loss_fn(params, x, y) -> scalar`` must be JAX-traceable. Parameters
+    are replicated; batches are sharded on the leading axis; gradients are
+    ``pmean``'d over ICI inside the jitted step.
+    """
+
+    def __init__(self, loss_fn: Callable, params: Any, mesh,
+                 config: Optional[TrainConfig] = None, axis: str = "dp",
+                 optimizer=None):
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.axis = axis
+        self.config = config or TrainConfig()
+        c = self.config
+        self.optimizer = optimizer if optimizer is not None else optax.chain(
+            optax.add_decayed_weights(c.weight_decay),
+            optax.sgd(c.learning_rate, momentum=c.momentum))
+        # copy before device_put: the step donates its param buffers, and
+        # device_put to a replicated sharding may alias the caller's arrays
+        self.params = jax.device_put(
+            jax.tree.map(lambda x: jnp.array(x, copy=True), params),
+            NamedSharding(mesh, P()))                  # replicated
+        self.opt_state = jax.device_put(
+            self.optimizer.init(self.params), NamedSharding(mesh, P()))
+        self._step = self._build_step()
+        self._epoch = self._build_epoch()
+
+    # -- jitted single step -------------------------------------------------
+
+    def _build_step(self):
+        axis, loss_fn, optimizer = self.axis, self.loss_fn, self.optimizer
+
+        def step(params, opt_state, x, y):
+            def shard_step(params, x, y):
+                # differentiate the *global* (pmean'd) loss: AD inserts the
+                # gradient all-reduce itself — the reference's reducefn sum
+                # (common.lua:112-137) fused into the backward pass. (An
+                # explicit post-grad pmean would double-count under
+                # shard_map's auto-psum of replicated-input cotangents.)
+                def global_loss(p):
+                    return lax.pmean(loss_fn(p, x, y), axis)
+
+                return jax.value_and_grad(global_loss)(params)
+
+            loss, grads = jax.shard_map(
+                shard_step, mesh=self.mesh,
+                in_specs=(P(), P(axis), P(axis)), out_specs=(P(), P()),
+            )(params, x, y)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def step(self, x, y) -> float:
+        """One optimizer step (one reference "iteration", SURVEY.md §3.5)."""
+        x, y = self._shard_batch(x, y)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, x, y)
+        return float(loss)
+
+    # -- jitted whole epoch (scan over batches, zero host round-trips) ------
+
+    def _build_epoch(self):
+        step = self._step
+
+        def epoch(params, opt_state, xs, ys):
+            def body(carry, batch):
+                params, opt_state = carry
+                x, y = batch
+                params, opt_state, loss = step(params, opt_state, x, y)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = lax.scan(
+                body, (params, opt_state), (xs, ys))
+            return params, opt_state, losses
+
+        return jax.jit(epoch, donate_argnums=(0, 1))
+
+    def run_epoch(self, x: np.ndarray, y: np.ndarray,
+                  rng: np.random.RandomState) -> float:
+        """Shuffle, batch, and run one full epoch inside lax.scan."""
+        c = self.config
+        n = (len(x) // c.batch_size) * c.batch_size
+        order = rng.permutation(len(x))[:n]
+        xs = x[order].reshape(-1, c.batch_size, *x.shape[1:])
+        ys = y[order].reshape(-1, c.batch_size, *y.shape[1:])
+        xs, ys = self._shard_batch(xs, ys, batched=True)
+        self.params, self.opt_state, losses = self._epoch(
+            self.params, self.opt_state, xs, ys)
+        return float(jnp.mean(losses))
+
+    def _shard_batch(self, x, y, batched: bool = False):
+        dim = 1 if batched else 0
+        spec = [None] * (dim + 1)
+        spec[dim] = self.axis
+        sharding = NamedSharding(self.mesh, P(*spec))
+        return (jax.device_put(x, sharding), jax.device_put(y, sharding))
+
+    # -- fit loop: validation, early stopping, checkpointing ----------------
+
+    def fit(self, x_train, y_train, x_val, y_val,
+            eval_fn: Optional[Callable] = None,
+            checkpoint_store=None, checkpoint_name: str = "model.ckpt",
+            conf=None, log: Optional[Callable[[str], None]] = None
+            ) -> Dict[str, Any]:
+        """Train with holdout early stopping (the finalfn role,
+        common.lua:144-202). ``conf`` (a PersistentTable) records progress
+        across restarts; ``checkpoint_store`` receives the best params."""
+        c = self.config
+        rng = np.random.RandomState(c.seed)
+        eval_fn = eval_fn or (lambda p, x, y: float(self.loss_fn(p, x, y)))
+        best_val = float("inf")
+        best_epoch = 0
+        history = []
+        t0 = time.time()
+
+        start_epoch = 1
+        if conf is not None and "epoch" in conf and checkpoint_store is not None \
+                and ckpt.exists(checkpoint_store, checkpoint_name):
+            # resume: restore params + progress (server-restart parity)
+            self.params = jax.device_put(
+                ckpt.load_pytree(checkpoint_store, checkpoint_name,
+                                 self.params),
+                NamedSharding(self.mesh, P()))
+            start_epoch = int(conf["epoch"]) + 1
+            best_val = float(conf.get("best_val", best_val))
+            best_epoch = int(conf.get("best_epoch", 0))
+
+        for epoch in range(start_epoch, c.max_epochs + 1):
+            train_loss = self.run_epoch(x_train, y_train, rng)
+            val_loss = eval_fn(self.params, x_val, y_val)
+            history.append({"epoch": epoch, "train_loss": train_loss,
+                            "val_loss": val_loss})
+            if log:
+                log(f"epoch {epoch}: train={train_loss:.4f} "
+                    f"val={val_loss:.4f}")
+            if val_loss < best_val:
+                best_val, best_epoch = val_loss, epoch
+                if checkpoint_store is not None:
+                    ckpt.save_pytree(checkpoint_store, checkpoint_name,
+                                     self.params)
+            if conf is not None:
+                conf.set({"epoch": epoch, "best_val": best_val,
+                          "best_epoch": best_epoch})
+                conf.update()
+            if epoch - best_epoch >= c.patience:
+                break       # early stopping: no "loop"
+
+        return {"epochs": len(history) + start_epoch - 1,
+                "best_val": best_val, "best_epoch": best_epoch,
+                "history": history, "wall_time": time.time() - t0}

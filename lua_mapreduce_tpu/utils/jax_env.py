@@ -1,0 +1,68 @@
+"""JAX backend bootstrapping for multi-process pools.
+
+A TPU chip is a single-tenant resource: in an elastic pool, at most one
+process owns the accelerator; the rest run host-path Python (exactly the
+reference's split — APRIL-ANN kernels on the one GPU box, everything else
+plain Lua workers). When the configured platform fails to initialize
+(plugin contention, no chip on this host), fall back to CPU instead of
+dying — a worker that loses the chip race is still a perfectly good
+host-path worker.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_checked = False
+
+
+def probe_backend(timeout_s: float = 120.0) -> bool:
+    """Check from a THROWAWAY subprocess whether the default JAX backend
+    initializes within ``timeout_s``. A wedged accelerator tunnel hangs
+    ``jax.devices()`` inside an uninterruptible C call — the only safe
+    probe is one we can kill. Returns True when the backend is usable."""
+    code = "import jax; jax.devices(); print('ok')"
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, timeout=timeout_s)
+        return out.returncode == 0 and b"ok" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def force_cpu_if_unavailable(timeout_s: float = 120.0) -> str:
+    """If the accelerator backend cannot initialize (probed from a
+    killable subprocess), pin this process to CPU. Returns the platform
+    chosen. Safe whether or not jax is already imported, as long as no
+    backend has been initialized yet in this process."""
+    if probe_backend(timeout_s):
+        return "accelerator"
+    print("[jax_env] accelerator backend unreachable; running on CPU",
+          file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
+
+
+def ensure_backend(fallback: str = "cpu") -> str:
+    """Initialize the default JAX backend, falling back to ``fallback``
+    when the preferred platform cannot start. Returns the platform name.
+    Safe to call multiple times; only the first call probes."""
+    global _checked
+    import jax
+
+    try:
+        platform = jax.devices()[0].platform
+        _checked = True
+        return platform
+    except RuntimeError as e:
+        if not _checked:
+            print(f"[jax_env] accelerator backend unavailable "
+                  f"({str(e).splitlines()[0]}); falling back to "
+                  f"{fallback}", file=sys.stderr)
+        _checked = True
+        jax.config.update("jax_platforms", fallback)
+        return jax.devices()[0].platform

@@ -86,6 +86,31 @@ def test_requeue_stale_covers_finished(tmp_path, idx):
     assert store.get_job("ns", 0)["status"] == Status.BROKEN
 
 
+@pytest.mark.parametrize("idx", [0, 1, 2], ids=["mem", "file-py", "file-auto"])
+def test_ownership_cas_blocks_stale_claimant(tmp_path, idx):
+    """Regression: a worker whose claim was requeued and re-claimed by
+    another worker must not be able to flip the job's status."""
+    store = _stores(tmp_path)[idx]
+    store.insert_jobs("ns", [make_job(0, "x")])
+    store.claim("ns", "worker-A")
+    store.requeue_stale("ns", older_than_s=0.0)     # A judged dead
+    store.claim("ns", "worker-B")                   # B re-claims
+
+    # A's late transitions miss (both finish and mark-broken paths)
+    assert not store.set_job_status("ns", 0, Status.FINISHED,
+                                    expect=(Status.RUNNING,),
+                                    expect_worker="worker-A")
+    assert not store.set_job_status("ns", 0, Status.BROKEN,
+                                    expect_worker="worker-A")
+    reps_before = store.get_job("ns", 0)["repetitions"]
+
+    # B's transitions land
+    assert store.set_job_status("ns", 0, Status.FINISHED,
+                                expect=(Status.RUNNING,),
+                                expect_worker="worker-B")
+    assert store.get_job("ns", 0)["repetitions"] == reps_before
+
+
 def test_cas_on_dropped_namespace_is_false(tmp_path):
     """Regression: straggler CAS after drop_ns returns False (both store
     kinds), never raises."""

@@ -87,6 +87,26 @@ def test_reserved_keys_and_read_only(tmp_path):
         ro.drop()
 
 
+def test_conflict_retry_preserves_other_writers_keys(tmp_path):
+    """Regression: after ConflictError → refresh() → update(), keys this
+    table never touched must keep the OTHER writer's committed values."""
+    store = MemJobStore()
+    t1 = PersistentTable("m", store)
+    t1.set({"a": 1, "b": 1})
+    t1.update()
+
+    t2 = PersistentTable("m", store)
+    t1["a"] = 5                 # t1 dirty on 'a' only
+    t2["b"] = 2
+    t2.update()                 # t2 commits b=2 first
+    with pytest.raises(ConflictError):
+        t1.update()
+    t1.refresh()
+    t1.update()
+    final = PersistentTable("m", store)
+    assert final["a"] == 5 and final["b"] == 2   # b=2 not reverted
+
+
 def test_commit_under_lock_keeps_lock(tmp_path):
     """Regression: update() inside a lock() section must not release the
     advisory lock."""

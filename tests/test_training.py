@@ -1,0 +1,178 @@
+"""Training harness tests: the TPU-native DP trainer and the MapReduce-
+packaged digits example (the APRIL-ANN workload, SURVEY.md §3.5), plus the
+grad-equivalence and checkpoint-resume guarantees."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+from lua_mapreduce_tpu.coord.persistent_table import PersistentTable
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.local import LocalExecutor
+from lua_mapreduce_tpu.models.mlp import accuracy, init_mlp, nll_loss
+from lua_mapreduce_tpu.parallel.mesh import host_mesh
+from lua_mapreduce_tpu.store.memfs import MemStore
+from lua_mapreduce_tpu.train import checkpoint as ckpt
+from lua_mapreduce_tpu.train.data import make_digits
+from lua_mapreduce_tpu.train.harness import DataParallelTrainer, TrainConfig
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return host_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def digits():
+    return make_digits(seed=0)
+
+
+def test_dp_step_equals_single_device_step(mesh, digits):
+    """pmean of per-shard grads == full-batch grad: one mesh step must
+    match one plain optax step bit-for-bit (up to float assoc)."""
+    x, y = digits[0][:128], digits[1][:128]
+    params = init_mlp(jax.random.PRNGKey(42))
+
+    tr = DataParallelTrainer(nll_loss, params, mesh, TrainConfig())
+    tr.step(x, y)
+
+    opt = optax.chain(optax.add_decayed_weights(TrainConfig.weight_decay),
+                      optax.sgd(TrainConfig.learning_rate,
+                                momentum=TrainConfig.momentum))
+    state = opt.init(params)
+    grads = jax.grad(nll_loss)(params, jnp.asarray(x), jnp.asarray(y))
+    updates, _ = opt.update(grads, state, params)
+    expected = optax.apply_updates(params, updates)
+
+    for k in params:
+        np.testing.assert_allclose(np.asarray(tr.params[k]),
+                                   np.asarray(expected[k]),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_fit_learns_and_checkpoints(mesh, digits):
+    x_tr, y_tr, x_va, y_va = digits
+    params = init_mlp(jax.random.PRNGKey(0))
+    tr = DataParallelTrainer(nll_loss, params, mesh,
+                             TrainConfig(max_epochs=6, patience=6))
+    store = MemStore()
+    conf = PersistentTable("conf", MemJobStore())
+    out = tr.fit(x_tr, y_tr, x_va, y_va, checkpoint_store=store, conf=conf)
+    assert out["best_val"] < 0.5
+    assert float(accuracy(tr.params, x_va, y_va)) > 0.9
+    assert store.exists("model.ckpt")
+    assert conf["epoch"] >= 1 and conf["best_val"] == out["best_val"]
+
+    # checkpoint round-trips exactly
+    loaded = ckpt.load_pytree(store, "model.ckpt", params)
+    best = out["best_epoch"]
+    assert best >= 1
+    for k in params:
+        assert loaded[k].shape == np.asarray(params[k]).shape
+
+
+def test_fit_resumes_from_conf(mesh, digits):
+    """Restart parity (SURVEY.md §5 checkpoint/resume): a second fit() with
+    the same conf+store continues from the recorded epoch."""
+    x_tr, y_tr, x_va, y_va = digits
+    store = MemStore()
+    jobstore = MemJobStore()
+    conf = PersistentTable("conf", jobstore)
+    tr = DataParallelTrainer(nll_loss, init_mlp(jax.random.PRNGKey(0)), mesh,
+                             TrainConfig(max_epochs=3, patience=10))
+    tr.fit(x_tr, y_tr, x_va, y_va, checkpoint_store=store, conf=conf)
+    assert conf["epoch"] == 3
+
+    tr2 = DataParallelTrainer(nll_loss, init_mlp(jax.random.PRNGKey(9)), mesh,
+                              TrainConfig(max_epochs=5, patience=10))
+    conf2 = PersistentTable("conf", jobstore)
+    out2 = tr2.fit(x_tr, y_tr, x_va, y_va, checkpoint_store=store,
+                   conf=conf2)
+    # resumed at epoch 4, ran 4 and 5 only
+    assert [h["epoch"] for h in out2["history"]] == [4, 5]
+
+
+SMALL = {"sizes": (64, 32, 10), "n_shards": 4, "bunch": 64,
+         "max_steps": 30, "patience": 30}
+
+
+def test_mapreduce_digits_example_learns():
+    """The six-function DP-SGD loop (APRIL-ANN analog) on the host engine:
+    loops until convergence/max and the validation loss drops."""
+    import examples.digits.mr_train as mr
+    model_store = "mem:digits-e2e"
+    spec = TaskSpec(taskfn="examples.digits.mr_train",
+                    mapfn="examples.digits.mr_train",
+                    partitionfn="examples.digits.mr_train",
+                    reducefn="examples.digits.mr_train",
+                    finalfn="examples.digits.mr_train",
+                    init_args={**SMALL, "model_store": model_store},
+                    storage="mem:digits-e2e-shuffle")
+    stats = LocalExecutor(spec, max_iterations=100).run()
+    meta = mr.read_meta(model_store)
+    assert meta["step"] == len(stats.iterations)
+    assert meta["step"] >= 5
+    # untrained small MLP starts near ln(10) ≈ 2.3 val NLL; must improve a lot
+    assert meta["best_val"] < 1.0
+    assert meta["finished"]
+
+
+def test_mapreduce_step_matches_direct_math(tmp_path):
+    """Exact parity: one MapReduce iteration == the same update computed
+    directly (grad sum over shards, 1/sqrt(count) smoothing, momentum SGD)."""
+    import examples.digits.mr_train as mr
+    from lua_mapreduce_tpu.store.router import get_storage_from
+
+    model_store = "mem:digits-parity"
+    args = {"sizes": (32, 16, 10), "n_shards": 2, "bunch": 16,
+            "max_steps": 1, "patience": 99, "model_store": model_store,
+            "seed": 3}
+    spec = TaskSpec(taskfn="examples.digits.mr_train",
+                    mapfn="examples.digits.mr_train",
+                    partitionfn="examples.digits.mr_train",
+                    reducefn="examples.digits.mr_train",
+                    finalfn="examples.digits.mr_train",
+                    init_args=args, storage="mem:digits-parity-shuffle")
+    # snapshot initial state before running
+    store = get_storage_from(model_store)
+    state0 = mr._load_state(store)
+    data = make_digits(seed=3, dim=32)
+
+    LocalExecutor(spec, max_iterations=2).run()
+    state1 = mr._load_state(store)
+
+    # recompute expected update
+    x_tr, y_tr = data[0], data[1]
+    grads_sum = {k: np.zeros_like(np.asarray(v))
+                 for k, v in state0["params"].items()}
+    for shard in range(2):
+        rng = np.random.RandomState(1000 + 0 + shard)   # step=0
+        idx = rng.randint(0, len(x_tr), 16)
+        g = jax.grad(nll_loss)(state0["params"], jnp.asarray(x_tr[idx]),
+                               jnp.asarray(y_tr[idx]))
+        for k in grads_sum:
+            grads_sum[k] += np.asarray(g[k])
+    for k, p in state0["params"].items():
+        smoothed = grads_sum[k] / np.sqrt(2) + 1e-5 * np.asarray(p)
+        vel = -0.05 * smoothed                          # momentum starts at 0
+        np.testing.assert_allclose(np.asarray(state1["params"][k]),
+                                   np.asarray(p) + vel, rtol=1e-5, atol=1e-6)
+
+
+def test_checkpoint_roundtrip_all_backends(tmp_path):
+    from lua_mapreduce_tpu.store.objectfs import ObjectStore
+    from lua_mapreduce_tpu.store.sharedfs import SharedStore
+
+    tree = {"W": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "nested": {"b": np.array([1.5, -2.5], dtype=np.float64),
+                       "i": np.array([1, 2, 3], dtype=np.int32)}}
+    for store in (MemStore(), SharedStore(str(tmp_path / "s")),
+                  ObjectStore(str(tmp_path / "o"))):
+        ckpt.save_pytree(store, "t.ckpt", tree)
+        out = ckpt.load_pytree(store, "t.ckpt", tree)
+        for va, vb in zip(jax.tree.leaves(tree), jax.tree.leaves(out)):
+            np.testing.assert_array_equal(va, vb)
+            assert np.asarray(va).dtype == np.asarray(vb).dtype
