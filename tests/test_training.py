@@ -86,6 +86,18 @@ def test_fit_resumes_from_conf(mesh, digits):
     tr.fit(x_tr, y_tr, x_va, y_va, checkpoint_store=store, conf=conf)
     assert conf["epoch"] == 3
 
+    # the resume checkpoint must hold LAST-epoch params AND optimizer
+    # state — resuming from the best-only file would rewind training and
+    # zero the momentum buffers
+    assert store.exists("model.ckpt.resume")
+    saved_params, saved_opt = ckpt.load_pytree(
+        store, "model.ckpt.resume", (tr.params, tr.opt_state))
+    for k in tr.params:
+        np.testing.assert_array_equal(np.asarray(saved_params[k]),
+                                      np.asarray(tr.params[k]))
+    momentum = [np.asarray(x) for x in jax.tree.leaves(saved_opt)]
+    assert any(np.any(m != 0) for m in momentum)
+
     tr2 = DataParallelTrainer(nll_loss, init_mlp(jax.random.PRNGKey(9)), mesh,
                               TrainConfig(max_epochs=5, patience=10))
     conf2 = PersistentTable("conf", jobstore)
