@@ -55,7 +55,8 @@ def bench_tpu_native(steps: int = 100, batch: int = 8192) -> float:
     ys = y_tr[idx].reshape(steps, batch)
 
     xs_d, ys_d = tr._shard_batch(xs, ys, batched=True)
-    np.asarray(jax.device_get(ys_d))      # exclude h2d from the timing
+    # h2d of both shards is forced to finish by the warm-up call below,
+    # which consumes them before the timed window opens
     # warm up on the SAME shapes as the timed call — the scan length is
     # baked into the trace, so a different-length warmup would leave a
     # full XLA recompile inside the timed window

@@ -1,0 +1,111 @@
+"""LeNet-5 — the CIFAR-10 conv model (BASELINE.json config 3).
+
+The reference's NN capability delegates all tensor kernels to the external
+APRIL-ANN toolkit (SURVEY.md §2.4: conv/pool/softmax live there, not in the
+repo); BASELINE.json names "LeNet-5 CIFAR-10 (Pallas conv2d/maxpool
+kernels)" as the target config. This module expresses LeNet-5 with this
+framework's own TPU ops: ``ops.conv2d`` (im2col → MXU matmul),
+``ops.maxpool2d`` and ``ops.log_softmax`` (Pallas kernels), so the whole
+forward pass is conv-as-matmul on the systolic array.
+
+Layouts are TPU-native: activations NHWC, weights HWIO (channel = lane
+dim). Params are a flat name→array dict — the same per-parameter-name key
+space the MapReduce grad shuffle partitions on (the APRIL-ANN example
+emits gradients keyed by parameter name, common.lua:85-104), so the model
+drops into both the TPU-native trainer and the six-function engine path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from lua_mapreduce_tpu.ops.conv import conv2d
+from lua_mapreduce_tpu.ops.pool import maxpool2d
+from lua_mapreduce_tpu.ops.softmax import log_softmax
+
+Params = Dict[str, jnp.ndarray]
+
+CIFAR_SHAPE = (32, 32, 3)
+N_CLASSES = 10
+
+# (name, kind, shape-spec). LeNet-5 adapted to 32x32x3 inputs:
+# conv 5x5x6 → pool → conv 5x5x16 → pool → fc120 → fc84 → fc10.
+_CONVS = (("c1", 5, 6), ("c2", 5, 16))
+_FCS = (("f1", 120), ("f2", 84), ("f3", N_CLASSES))
+
+
+def _flat_dim(input_shape: Sequence[int]) -> int:
+    h, w, _ = input_shape
+    for (_, k, _c) in _CONVS:
+        h, w = (h - k + 1) // 2, (w - k + 1) // 2   # VALID conv, 2x2 pool
+    return h * w * _CONVS[-1][2]
+
+
+def init_lenet(key, input_shape: Sequence[int] = CIFAR_SHAPE,
+               dtype=jnp.float32) -> Params:
+    """Glorot-uniform weights, zero biases; conv weights HWIO."""
+    params: Params = {}
+    n_params = len(_CONVS) + len(_FCS)
+    keys = jax.random.split(key, n_params)
+    c_in = input_shape[-1]
+    i = 0
+    for name, k, c_out in _CONVS:
+        fan_in, fan_out = k * k * c_in, k * k * c_out
+        bound = jnp.sqrt(6.0 / (fan_in + fan_out))
+        params[f"{name}_W"] = jax.random.uniform(
+            keys[i], (k, k, c_in, c_out), dtype, -bound, bound)
+        params[f"{name}_b"] = jnp.zeros((c_out,), dtype)
+        c_in = c_out
+        i += 1
+    d_in = _flat_dim(input_shape)
+    for name, d_out in _FCS:
+        bound = jnp.sqrt(6.0 / (d_in + d_out))
+        params[f"{name}_W"] = jax.random.uniform(
+            keys[i], (d_in, d_out), dtype, -bound, bound)
+        params[f"{name}_b"] = jnp.zeros((d_out,), dtype)
+        d_in = d_out
+        i += 1
+    return params
+
+
+def lenet_apply(params: Params, x: jnp.ndarray, *,
+                backend: str = "auto") -> jnp.ndarray:
+    """(N,32,32,3) → (N,10) log-probabilities."""
+    for name, _k, _c in _CONVS:
+        x = conv2d(x, params[f"{name}_W"], params[f"{name}_b"],
+                   padding="VALID", backend=backend)
+        x = jnp.tanh(x)
+        x = maxpool2d(x, window=2, backend=backend)
+    x = x.reshape(x.shape[0], -1)
+    for name, _d in _FCS[:-1]:
+        x = jnp.tanh(x @ params[f"{name}_W"] + params[f"{name}_b"])
+    name = _FCS[-1][0]
+    logits = x @ params[f"{name}_W"] + params[f"{name}_b"]
+    return log_softmax(logits, backend=backend)
+
+
+def nll_loss(params: Params, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    logp = lenet_apply(params, x)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+def accuracy(params: Params, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(jnp.argmax(lenet_apply(params, x), axis=1) == y)
+
+
+def flops_per_example(input_shape: Sequence[int] = CIFAR_SHAPE) -> int:
+    """Fwd+bwd matmul-equivalent FLOPs per example (MFU accounting)."""
+    h, w, c_in = input_shape
+    fwd = 0
+    for _name, k, c_out in _CONVS:
+        ho, wo = h - k + 1, w - k + 1
+        fwd += 2 * ho * wo * k * k * c_in * c_out
+        h, w, c_in = ho // 2, wo // 2, c_out
+    d_in = h * w * c_in
+    for _name, d_out in _FCS:
+        fwd += 2 * d_in * d_out
+        d_in = d_out
+    return 3 * fwd

@@ -52,7 +52,8 @@ def _matmul_pallas(a, b, block_m=256, block_n=256, block_k=256,
                    out_dtype=None, interpret=False):
     m, k = a.shape
     k2, n = b.shape
-    assert k == k2, f"contracting dims differ: {k} vs {k2}"
+    if k != k2:    # not assert: must survive python -O, else _pad_to
+        raise ValueError(f"contracting dims differ: {k} vs {k2}")
     out_dtype = out_dtype or jnp.promote_types(a.dtype, b.dtype)
 
     # clamp blocks to the (padded-to-tile) problem, keep MXU/VPU alignment

@@ -70,14 +70,25 @@ def _pool_pallas(x, window, stride, mode, interpret=False):
 
 
 def _pool_xla(x, window, stride, mode):
+    # KH·KW static strided slices combined elementwise — NOT
+    # lax.reduce_window, which has no linearization rule and kills
+    # reverse-mode AD under shard_map/scan (the DP-trainer hot path).
     kh, kw = window
-    init, op = ((-jnp.inf, jax.lax.max) if mode == "max"
-                else (0.0, jax.lax.add))
-    out = jax.lax.reduce_window(
-        x, jnp.array(init, x.dtype), op,
-        window_dimensions=(1, kh, kw, 1),
-        window_strides=(1,) + tuple(stride) + (1,),
-        padding="VALID")
+    sh, sw = stride
+    n, h, w, c = x.shape
+    ho = (h - kh) // sh + 1
+    wo = (w - kw) // sw + 1
+    out = None
+    for i in range(kh):
+        for j in range(kw):
+            s = jax.lax.slice(
+                x, (0, i, j, 0),
+                (n, i + (ho - 1) * sh + 1, j + (wo - 1) * sw + 1, c),
+                (1, sh, sw, 1))
+            if out is None:
+                out = s
+            else:
+                out = jnp.maximum(out, s) if mode == "max" else out + s
     if mode == "avg":
         out = out / (kh * kw)
     return out

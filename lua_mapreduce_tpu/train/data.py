@@ -35,3 +35,35 @@ def make_digits(seed: int = 0, n_train: int = N_TRAIN, n_val: int = N_VAL,
     x_tr, y_tr = sample(n_train)
     x_va, y_va = sample(n_val)
     return x_tr, y_tr, x_va, y_va
+
+
+def make_images(seed: int = 0, n_train: int = 2048, n_val: int = 512,
+                shape: Tuple[int, int, int] = (32, 32, 3),
+                n_classes: int = N_CLASSES, noise: float = 0.3
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Synthetic CIFAR/ImageNet-shaped image classification data.
+
+    Same contract as ``make_digits`` but NHWC images (the LeNet-5 /
+    ResNet-18 BASELINE.json configs). Class prototypes are smooth 2-D
+    patterns (low-frequency sinusoids per channel) so the conv models
+    have spatial structure to learn; deterministic in the seed.
+    """
+    h, w, c = shape
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    protos = np.empty((n_classes, h, w, c), np.float32)
+    for cls in range(n_classes):
+        for ch in range(c):
+            fy, fx = rng.uniform(0.5, 3.0, size=2)
+            py, px = rng.uniform(0, 2 * np.pi, size=2)
+            protos[cls, :, :, ch] = 0.5 + 0.5 * np.sin(
+                2 * np.pi * (fy * yy / h + fx * xx / w) + py + px)
+
+    def sample(n):
+        y = rng.randint(0, n_classes, size=n)
+        x = protos[y] + noise * rng.randn(n, h, w, c).astype(np.float32)
+        return np.clip(x, 0.0, 1.0).astype(np.float32), y.astype(np.int32)
+
+    x_tr, y_tr = sample(n_train)
+    x_va, y_va = sample(n_val)
+    return x_tr, y_tr, x_va, y_va

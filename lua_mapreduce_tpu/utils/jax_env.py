@@ -18,7 +18,10 @@ import tempfile
 import time
 
 _checked = False
-_probe_result: bool | None = None
+# in-process memo: (platform key) → (verdict, monotonic stamp); entries
+# expire on the same TTLs as the disk cache and are keyed on JAX_PLATFORMS
+# so a post-fallback re-probe isn't answered with the accelerator verdict
+_probe_memo: dict = {}
 
 # both verdicts expire: a healthy tunnel can wedge after a positive probe
 # (the hang the probe exists to prevent) and a wedged one can recover
@@ -48,9 +51,13 @@ def probe_backend(timeout_s: float = 120.0) -> bool:
     ``jax.devices()`` inside an uninterruptible C call — the only safe
     probe is one we can kill. Results are cached in-process and on disk
     per boot with a TTL per verdict. Returns True when usable."""
-    global _probe_result
-    if _probe_result is not None:
-        return _probe_result
+    key = os.environ.get("JAX_PLATFORMS", "default")
+    hit = _probe_memo.get(key)
+    if hit is not None:
+        verdict, stamp = hit
+        ttl = POSITIVE_PROBE_TTL_S if verdict else NEGATIVE_PROBE_TTL_S
+        if time.monotonic() - stamp < ttl:
+            return verdict
     cache = _probe_cache_path()
     try:
         st = os.stat(cache)
@@ -72,7 +79,7 @@ def probe_backend(timeout_s: float = 120.0) -> bool:
         ok = out.returncode == 0 and b"ok" in out.stdout
     except subprocess.TimeoutExpired:
         ok = False
-    _probe_result = ok
+    _probe_memo[key] = (ok, time.monotonic())
     try:
         tmp = cache + f".{os.getpid()}"
         with open(tmp, "w") as f:

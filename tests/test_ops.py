@@ -47,7 +47,7 @@ def test_matmul_bf16_inputs_f32_accumulate():
 
 
 def test_matmul_shape_mismatch():
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError):
         ops.matmul(rand(4, 5), rand(6, 7), backend="pallas_interpret")
 
 
