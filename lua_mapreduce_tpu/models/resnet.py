@@ -1,0 +1,228 @@
+"""ResNet-18 — the ImageNet-1k conv model (BASELINE.json config 4).
+
+Like LeNet-5 (models/lenet.py), every FLOP goes through this framework's
+own TPU ops: ``ops.conv2d`` (im2col → MXU matmul), ``ops.maxpool2d`` /
+``ops.avgpool2d`` (Pallas window reductions). The reference delegated its
+conv kernels to the external APRIL-ANN toolkit (SURVEY.md §2.4); this is
+the TPU-native stand-in at ImageNet scale, fed by the sharded input
+pipeline (train/sharding.py, the misc/make_sharded.lua analog named by
+BASELINE.json: "misc/make_sharded.lua → GCS shards, 197-split map").
+
+Normalization is GroupNorm rather than BatchNorm: it is stateless (no
+running statistics threaded through the trainer or psum'd across the dp
+axis), batch-size independent, and keeps params a flat name→array dict —
+the per-parameter-name key space the MapReduce grad shuffle partitions on
+(the APRIL-ANN example emits gradients keyed by parameter name,
+common.lua:85-104). Layouts are TPU-native: activations NHWC, weights
+HWIO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from lua_mapreduce_tpu.ops.conv import conv2d
+from lua_mapreduce_tpu.ops.pool import maxpool2d
+from lua_mapreduce_tpu.ops.softmax import log_softmax
+
+Params = Dict[str, jnp.ndarray]
+
+IMAGENET_SHAPE = (224, 224, 3)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    """Architecture knobs. Hashable/frozen so it can ride through jit as a
+    static argument. ``imagenet18()`` is the BASELINE.json config;
+    ``tiny()`` is the same topology at test scale."""
+    input_shape: Tuple[int, int, int] = IMAGENET_SHAPE
+    n_classes: int = 1000
+    widths: Tuple[int, ...] = (64, 128, 256, 512)
+    blocks_per_stage: Tuple[int, ...] = (2, 2, 2, 2)
+    imagenet_stem: bool = True      # 7x7/2 conv + 3x3/2 maxpool; else 3x3/1
+    norm_groups: int = 32
+
+    @staticmethod
+    def imagenet18() -> "ResNetConfig":
+        return ResNetConfig()
+
+    @staticmethod
+    def cifar18() -> "ResNetConfig":
+        """ResNet-18 with the standard CIFAR stem (3x3/1, no maxpool)."""
+        return ResNetConfig(input_shape=(32, 32, 3), n_classes=10,
+                            imagenet_stem=False)
+
+    @staticmethod
+    def tiny() -> "ResNetConfig":
+        """Full block structure at test scale (fast on CPU)."""
+        return ResNetConfig(input_shape=(16, 16, 3), n_classes=10,
+                            widths=(8, 16), blocks_per_stage=(1, 1),
+                            imagenet_stem=False, norm_groups=4)
+
+
+def _groups(cfg: ResNetConfig, c: int) -> int:
+    g = min(cfg.norm_groups, c)
+    while c % g:
+        g -= 1
+    return g
+
+
+def _conv_init(key, k: int, c_in: int, c_out: int, dtype) -> jnp.ndarray:
+    """He-normal HWIO weights (relu networks)."""
+    std = jnp.sqrt(2.0 / (k * k * c_in))
+    return std * jax.random.normal(key, (k, k, c_in, c_out), dtype)
+
+
+def init_resnet(key, cfg: ResNetConfig = ResNetConfig(),
+                dtype=jnp.float32) -> Params:
+    """Flat name→array params for the full network.
+
+    Names: ``stem_W``, per block ``s<stage>b<block>_{conv1,conv2,proj}_W``
+    plus GroupNorm ``*_g``/``*_be`` scale/bias pairs, final ``fc_W/fc_b``.
+    Convs feeding a norm carry no bias. All norm scales init to 1 — a
+    zero-init residual scale would leave the branch convs with exactly
+    zero gradient at init, breaking the invariant that every parameter
+    name carries a live gradient shard through the MapReduce shuffle.
+    """
+    params: Params = {}
+    keys = iter(jax.random.split(key, 4 * sum(cfg.blocks_per_stage) + 2))
+
+    def norm(name: str, c: int):
+        params[f"{name}_g"] = jnp.ones((c,), dtype)
+        params[f"{name}_be"] = jnp.zeros((c,), dtype)
+
+    c_in = cfg.input_shape[-1]
+    k_stem = 7 if cfg.imagenet_stem else 3
+    params["stem_W"] = _conv_init(next(keys), k_stem, c_in, cfg.widths[0],
+                                  dtype)
+    norm("stem_n", cfg.widths[0])
+    c_in = cfg.widths[0]
+
+    for s, (c_out, n_blocks) in enumerate(
+            zip(cfg.widths, cfg.blocks_per_stage)):
+        for b in range(n_blocks):
+            p = f"s{s}b{b}"
+            stride = 2 if (s > 0 and b == 0) else 1
+            params[f"{p}_conv1_W"] = _conv_init(next(keys), 3, c_in, c_out,
+                                                dtype)
+            norm(f"{p}_n1", c_out)
+            params[f"{p}_conv2_W"] = _conv_init(next(keys), 3, c_out, c_out,
+                                                dtype)
+            norm(f"{p}_n2", c_out)
+            if stride != 1 or c_in != c_out:
+                params[f"{p}_proj_W"] = _conv_init(next(keys), 1, c_in,
+                                                   c_out, dtype)
+                norm(f"{p}_np", c_out)
+            c_in = c_out
+
+    bound = jnp.sqrt(6.0 / (c_in + cfg.n_classes))
+    params["fc_W"] = jax.random.uniform(next(keys), (c_in, cfg.n_classes),
+                                        dtype, -bound, bound)
+    params["fc_b"] = jnp.zeros((cfg.n_classes,), dtype)
+    return params
+
+
+def _group_norm(params: Params, name: str, x: jnp.ndarray,
+                groups: int) -> jnp.ndarray:
+    n, h, w, c = x.shape
+    xg = x.reshape(n, h, w, groups, c // groups)
+    mean = jnp.mean(xg, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(xg, axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + 1e-5)
+    return xg.reshape(n, h, w, c) * params[f"{name}_g"] \
+        + params[f"{name}_be"]
+
+
+def _stem(params: Params, x: jnp.ndarray, cfg: ResNetConfig,
+          backend: str) -> jnp.ndarray:
+    if cfg.imagenet_stem:
+        x = conv2d(x, params["stem_W"], stride=2, padding="SAME",
+                   backend=backend)
+        x = jax.nn.relu(_group_norm(params, "stem_n", x,
+                                    _groups(cfg, x.shape[-1])))
+        # SAME 3x3/2 maxpool = pad 1 with -inf, then VALID window
+        x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)),
+                    constant_values=-jnp.inf)
+        return maxpool2d(x, window=3, stride=2, backend=backend)
+    x = conv2d(x, params["stem_W"], stride=1, padding="SAME",
+               backend=backend)
+    return jax.nn.relu(_group_norm(params, "stem_n", x,
+                                   _groups(cfg, x.shape[-1])))
+
+
+def resnet_apply(params: Params, x: jnp.ndarray, *,
+                 cfg: ResNetConfig = ResNetConfig(),
+                 backend: str = "auto") -> jnp.ndarray:
+    """(N, H, W, C) → (N, n_classes) log-probabilities."""
+    x = _stem(params, x, cfg, backend)
+    for s, n_blocks in enumerate(cfg.blocks_per_stage):
+        for b in range(n_blocks):
+            p = f"s{s}b{b}"
+            stride = 2 if (s > 0 and b == 0) else 1
+            g = _groups(cfg, cfg.widths[s])
+            h = conv2d(x, params[f"{p}_conv1_W"], stride=stride,
+                       padding="SAME", backend=backend)
+            h = jax.nn.relu(_group_norm(params, f"{p}_n1", h, g))
+            h = conv2d(h, params[f"{p}_conv2_W"], stride=1, padding="SAME",
+                       backend=backend)
+            h = _group_norm(params, f"{p}_n2", h, g)
+            if f"{p}_proj_W" in params:
+                x = conv2d(x, params[f"{p}_proj_W"], stride=stride,
+                           padding="SAME", backend=backend)
+                x = _group_norm(params, f"{p}_np", x, g)
+            x = jax.nn.relu(x + h)
+    # global average pool: a full-map mean has no window structure for the
+    # pooling kernels to exploit — one XLA reduction is the right lowering
+    x = jnp.mean(x, axis=(1, 2))
+    logits = x @ params["fc_W"] + params["fc_b"]
+    return log_softmax(logits, backend=backend)
+
+
+def make_loss(cfg: ResNetConfig, backend: str = "auto"):
+    """``loss_fn(params, x, y)`` closure for the DP trainer (mean NLL)."""
+    def nll_loss(params, x, y):
+        logp = resnet_apply(params, x, cfg=cfg, backend=backend)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+    return nll_loss
+
+
+def accuracy(params: Params, x, y, *, cfg: ResNetConfig = ResNetConfig(),
+             backend: str = "auto") -> jnp.ndarray:
+    return jnp.mean(
+        jnp.argmax(resnet_apply(params, x, cfg=cfg, backend=backend),
+                   axis=1) == y)
+
+
+def flops_per_example(cfg: ResNetConfig = ResNetConfig()) -> int:
+    """Fwd+bwd matmul-equivalent FLOPs per example (MFU accounting)."""
+    h, w, c_in = cfg.input_shape
+
+    def conv_flops(h, w, k, s, c_in, c_out):
+        ho, wo = -(-h // s), -(-w // s)     # SAME
+        return ho, wo, 2 * ho * wo * k * k * c_in * c_out
+
+    fwd = 0
+    if cfg.imagenet_stem:
+        h, w, f = conv_flops(h, w, 7, 2, c_in, cfg.widths[0])
+        fwd += f
+        h, w = -(-h // 2), -(-w // 2)       # 3x3/2 SAME maxpool
+    else:
+        h, w, f = conv_flops(h, w, 3, 1, c_in, cfg.widths[0])
+        fwd += f
+    c_in = cfg.widths[0]
+    for s, (c_out, n_blocks) in enumerate(
+            zip(cfg.widths, cfg.blocks_per_stage)):
+        for b in range(n_blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            ho, wo, f1 = conv_flops(h, w, 3, stride, c_in, c_out)
+            _, _, f2 = conv_flops(ho, wo, 3, 1, c_out, c_out)
+            fwd += f1 + f2
+            if stride != 1 or c_in != c_out:
+                fwd += conv_flops(h, w, 1, stride, c_in, c_out)[2]
+            h, w, c_in = ho, wo, c_out
+    fwd += 2 * c_in * cfg.n_classes
+    return 3 * fwd

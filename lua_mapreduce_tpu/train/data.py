@@ -55,9 +55,9 @@ def make_images(seed: int = 0, n_train: int = 2048, n_val: int = 512,
     for cls in range(n_classes):
         for ch in range(c):
             fy, fx = rng.uniform(0.5, 3.0, size=2)
-            py, px = rng.uniform(0, 2 * np.pi, size=2)
+            phase = rng.uniform(0, 2 * np.pi)
             protos[cls, :, :, ch] = 0.5 + 0.5 * np.sin(
-                2 * np.pi * (fy * yy / h + fx * xx / w) + py + px)
+                2 * np.pi * (fy * yy / h + fx * xx / w) + phase)
 
     def sample(n):
         y = rng.randint(0, n_classes, size=n)

@@ -1,0 +1,101 @@
+"""Sharded input pipeline (misc/make_sharded.lua analog): shard layout,
+manifest contract, map-split view, host-sliced batch streams."""
+
+import numpy as np
+import pytest
+
+from lua_mapreduce_tpu.store.memfs import MemStore
+from lua_mapreduce_tpu.train.sharding import ShardedDataset, make_sharded
+
+
+@pytest.fixture
+def data():
+    rng = np.random.RandomState(0)
+    x = rng.rand(197, 8).astype(np.float32)     # 197-split contract size
+    y = rng.randint(0, 10, 197).astype(np.int32)
+    return x, y
+
+
+def test_roundtrip_covers_every_example(data):
+    x, y = data
+    store = MemStore()
+    names = make_sharded(store, "euro", x, y, n_shards=13)
+    assert len(names) == 13
+    ds = ShardedDataset(store, "euro")
+    assert ds.n_shards == 13 and ds.n_examples == 197
+    xs, ys = zip(*(ds.load_shard(i) for i in range(13)))
+    np.testing.assert_array_equal(np.concatenate(xs), x)
+    np.testing.assert_array_equal(np.concatenate(ys), y)
+
+
+def test_shard_names_are_the_map_splits(data):
+    store = MemStore()
+    make_sharded(store, "euro", *data, n_shards=5)
+    ds = ShardedDataset(store, "euro")
+    for name in ds.shard_names():
+        assert store.exists(name)
+
+
+def test_host_partition_disjoint_and_complete(data):
+    """Across hosts, every example is seen exactly once per epoch
+    (shard i → host i % n_hosts; labels used as example identity)."""
+    x, y = data
+    y = np.arange(197, dtype=np.int64)          # unique ids
+    store = MemStore()
+    make_sharded(store, "euro", x, y, n_shards=8)
+    ds = ShardedDataset(store, "euro")
+    seen = []
+    for host in range(3):
+        rng = np.random.RandomState(host)
+        for _, yb in ds.batches(7, rng=rng, host_id=host, n_hosts=3,
+                                drop_remainder=False):
+            seen.extend(yb.tolist())
+    assert sorted(seen) == list(range(197))
+
+
+def test_batches_cross_shard_boundaries(data):
+    """Batch size larger than a shard: leftovers must carry across
+    shards instead of yielding short batches."""
+    x, y = data
+    store = MemStore()
+    make_sharded(store, "euro", x, y, n_shards=10)   # ~20/shard
+    ds = ShardedDataset(store, "euro")
+    batches = list(ds.batches(32, rng=np.random.RandomState(1)))
+    assert all(len(xb) == 32 for xb, _ in batches)
+    assert len(batches) == 197 // 32
+
+
+def test_manifest_required_and_remove_idempotent(data):
+    store = MemStore()
+    with pytest.raises(FileNotFoundError):
+        ShardedDataset(store, "nope")
+    make_sharded(store, "euro", *data, n_shards=4)
+    ds = ShardedDataset(store, "euro")
+    ds.remove()
+    ds.remove()
+    assert store.list("euro*") == []
+
+
+def test_rejects_bad_shard_count(data):
+    x, y = data
+    store = MemStore()
+    with pytest.raises(ValueError):
+        make_sharded(store, "e", x, y, n_shards=0)
+    with pytest.raises(ValueError):
+        make_sharded(store, "e", x, y, n_shards=198)
+
+
+def test_equal_step_counts_across_hosts(data):
+    """SPMD contract: with drop_remainder every host yields EXACTLY
+    steps_per_epoch batches, however unevenly shards divide (unequal
+    counts would deadlock the collective steps)."""
+    x, y = data
+    store = MemStore()
+    make_sharded(store, "euro", x, y, n_shards=8)    # 3/3/2 shards → 3 hosts
+    ds = ShardedDataset(store, "euro")
+    expect = ds.steps_per_epoch(7, n_hosts=3)
+    assert expect >= 1
+    counts = [sum(1 for _ in ds.batches(7, rng=np.random.RandomState(h),
+                                        host_id=h, n_hosts=3))
+              for h in range(3)]
+    assert counts == [expect] * 3, counts
