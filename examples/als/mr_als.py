@@ -1,0 +1,165 @@
+"""Iterative ALS matrix factorization as the six MapReduce functions,
+with item factors persisted in a :class:`PersistentTable` across
+iterations (BASELINE.json config 5: "ALS matrix-factorization
+(persistent_table.lua state across MapReduce iters)").
+
+Loop shape (SURVEY.md §3.5, the looping-MapReduce template):
+
+    init        — build the ratings matrix; seed item factors V into the
+                  persistent table
+    taskfn      — emit n_shards user shards
+    mapfn       — read V; solve this shard's user factors (ridge
+                  regression per user — embarrassingly parallel); emit
+                  each item's partial normal equations (A_i, b_i) and the
+                  shard's ("SSE", …) against the solved users
+    partitionfn — item id hash % NUM_REDUCERS
+    reducefn    — matrix/vector partial sums (assoc+commut flags)
+    finalfn     — solve every item's (A_i + λI) v_i = b_i, commit V,
+                  loop for a fixed number of rounds
+
+The TPU-native fast path of the same algorithm (users sharded over the
+mesh, partials psum'd over ICI) is models/als.py; the two must agree —
+see tests/test_kmeans_als.py.
+
+State-store scope: ``coord="mem"`` (the default) backs the persistent
+table with an in-process store and is ONLY valid on the in-process
+LocalExecutor. A multi-process pool (server + execute_worker processes)
+MUST pass a shared directory path as ``coord`` — with "mem", every
+process gets an isolated table and the loop silently reiterates round 1
+(the reference has no such default: every process is pointed at the same
+MongoDB by its connection string, execute_server.lua:25-35).
+"""
+
+import numpy as np
+
+from lua_mapreduce_tpu.coord.filestore import FileJobStore
+from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+from lua_mapreduce_tpu.coord.persistent_table import PersistentTable
+
+NUM_REDUCERS = 8
+TABLE = "als_state"
+
+_cfg = {}
+_r = None
+_w = None
+_pt_store = None
+
+
+def _table(read_only=False) -> PersistentTable:
+    return PersistentTable(TABLE, _pt_store, read_only=read_only)
+
+
+def init(args):
+    global _cfg, _r, _w, _pt_store
+    from lua_mapreduce_tpu.train.data import make_ratings
+    _cfg = {
+        "n_users": int(args.get("n_users", 256)),
+        "n_items": int(args.get("n_items", 64)),
+        "rank": int(args.get("rank", 4)),
+        "density": float(args.get("density", 0.3)),
+        "reg": float(args.get("reg", 0.1)),
+        "n_shards": int(args.get("n_shards", 4)),
+        "max_iters": int(args.get("max_iters", 10)),
+        "seed": int(args.get("seed", 0)),
+        "coord": args.get("coord", "mem"),
+    }
+    _r, _w = make_ratings(seed=_cfg["seed"], n_users=_cfg["n_users"],
+                          n_items=_cfg["n_items"], rank=_cfg["rank"],
+                          density=_cfg["density"])
+    _pt_store = MemJobStore() if _cfg["coord"] == "mem" \
+        else FileJobStore(_cfg["coord"])
+    pt = _table()
+    if "item_factors" not in pt:
+        rng = np.random.RandomState(_cfg["seed"])
+        v0 = 0.1 * rng.randn(_cfg["n_items"], _cfg["rank"])
+        pt.set({"item_factors": v0.tolist(), "iter": 0, "finished": False,
+                "rmse": None})
+        pt.update()
+
+
+def taskfn(emit):
+    for i in range(_cfg["n_shards"]):
+        emit(i, i)
+
+
+def _shard_rows(shard: int):
+    sl = slice(int(shard), None, _cfg["n_shards"])
+    return _r[sl], _w[sl]
+
+
+def mapfn(key, shard, emit):
+    pt = _table(read_only=True)
+    v = np.asarray(pt["item_factors"], np.float32)      # (n_items, k)
+    r, w = _shard_rows(shard)
+    k = v.shape[1]
+    eye = _cfg["reg"] * np.eye(k, dtype=np.float32)
+
+    # user step: per-user ridge solve given V, batched over the shard
+    # (np.linalg.solve broadcasts over the leading axis — one LAPACK
+    # dispatch for the whole shard, the host analog of models/als.py's
+    # vmap'd solve)
+    vw = v[None, :, :] * w[:, :, None]              # (n_u, n_items, k)
+    a = vw.transpose(0, 2, 1) @ v + eye             # (n_u, k, k)
+    b = vw.transpose(0, 2, 1) @ r[:, :, None]       # (n_u, k, 1)
+    u = np.linalg.solve(a, b)[..., 0].astype(np.float32)
+
+    # item-step partials: A_i = Σ_u w_ui u uᵀ, b_i = Σ_u w_ui r_ui u
+    a = np.einsum("ui,uk,ul->ikl", w, u, u)
+    b = np.einsum("ui,ui,uk->ik", w, r, u)
+    for item in range(v.shape[0]):
+        emit(int(item), {"a": a[item].tolist(), "b": b[item].tolist()})
+
+    err = w * (u @ v.T - r)
+    emit("SSE", {"sq": float((err ** 2).sum()), "cnt": float(w.sum())})
+
+
+def partitionfn(key):
+    return sum(str(key).encode()) % NUM_REDUCERS
+
+
+def reducefn(key, values):
+    if key == "SSE":
+        return {"sq": sum(v["sq"] for v in values),
+                "cnt": sum(v["cnt"] for v in values)}
+    a = np.asarray(values[0]["a"], np.float64)
+    b = np.asarray(values[0]["b"], np.float64)
+    for v in values[1:]:
+        a = a + np.asarray(v["a"], np.float64)
+        b = b + np.asarray(v["b"], np.float64)
+    return {"a": a.tolist(), "b": b.tolist()}
+
+
+reducefn.associative_reducer = True
+reducefn.commutative_reducer = True
+
+
+def finalfn(pairs):
+    pt = _table()
+    v = np.asarray(pt["item_factors"], np.float32)
+    k = v.shape[1]
+    eye = _cfg["reg"] * np.eye(k)
+    sq = cnt = 0.0
+    for key, vs in pairs:
+        val = vs[0]
+        if key == "SSE":
+            sq, cnt = val["sq"], val["cnt"]
+        else:
+            a = np.asarray(val["a"], np.float64)
+            b = np.asarray(val["b"], np.float64)
+            v[int(key)] = np.linalg.solve(a + eye, b)
+    # SSE is measured against the PRE-update V (the mapfn's read), i.e.
+    # the RMSE of round i's user step — same monotone signal, one round
+    # behind models/als.py's history which scores the updated V
+    rmse = float(np.sqrt(sq / max(cnt, 1.0)))
+    it = pt["iter"] + 1
+    finished = it >= _cfg["max_iters"]
+    pt.set({"item_factors": v.tolist(), "iter": it, "finished": finished,
+            "rmse": rmse})
+    pt.update()
+    return False if finished else "loop"
+
+
+def read_state(coord="mem", pt_store=None):
+    store = pt_store or (_pt_store if coord == "mem"
+                         else FileJobStore(coord))
+    return PersistentTable(TABLE, store, read_only=True).as_dict()

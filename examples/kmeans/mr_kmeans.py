@@ -1,0 +1,149 @@
+"""Iterative k-means as the six MapReduce functions, with cross-iteration
+state in a :class:`PersistentTable` (BASELINE.json config 5: "iterative
+k-means … persistent_table.lua state across MapReduce iters").
+
+The loop shape mirrors the reference's APRIL-ANN example (SURVEY.md §3.5)
+with centroids in place of model weights:
+
+    init        — build data; seed centroids into the persistent table
+                  (the conf-table role, common.lua:57-77)
+    taskfn      — emit n_shards point shards
+    mapfn       — read centroids from the table; assign shard points;
+                  emit per-cluster partial (sum, count) + ("SSE", …)
+    partitionfn — cluster id hash % NUM_REDUCERS
+    reducefn    — elementwise partial sums (assoc+commut+idempotent flags
+                  → combiner + merge fast path, SURVEY.md §2.5)
+    finalfn     — recompute centroids, commit to the table, loop until
+                  the max centroid shift < tol (the "loop" protocol,
+                  server.lua:387-403)
+
+The TPU-native fast path of the same algorithm is models/kmeans.py; the
+two must agree (golden-diff discipline, SURVEY.md §4) — see
+tests/test_kmeans_als.py.
+
+State-store scope: ``coord="mem"`` (the default) backs the persistent
+table with an in-process store and is ONLY valid on the in-process
+LocalExecutor. A multi-process pool (server + execute_worker processes)
+MUST pass a shared directory path as ``coord`` — with "mem", every
+process gets an isolated table and the loop silently converges after one
+effective iteration (the reference has no such default: every process is
+pointed at the same MongoDB by its connection string,
+execute_server.lua:25-35).
+"""
+
+import numpy as np
+
+from lua_mapreduce_tpu.coord.filestore import FileJobStore
+from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+from lua_mapreduce_tpu.coord.persistent_table import PersistentTable
+
+NUM_REDUCERS = 8
+TABLE = "kmeans_state"
+
+_cfg = {}
+_x = None
+_pt_store = None
+
+
+def _table(read_only=False) -> PersistentTable:
+    return PersistentTable(TABLE, _pt_store, read_only=read_only)
+
+
+def init(args):
+    global _cfg, _x, _pt_store
+    from lua_mapreduce_tpu.train.data import make_blobs
+    _cfg = {
+        "k": int(args.get("k", 8)),
+        "n": int(args.get("n", 2048)),
+        "dim": int(args.get("dim", 16)),
+        "n_shards": int(args.get("n_shards", 4)),
+        "max_iters": int(args.get("max_iters", 20)),
+        "tol": float(args.get("tol", 1e-4)),
+        "seed": int(args.get("seed", 0)),
+        "coord": args.get("coord", "mem"),
+    }
+    _x, _, _ = make_blobs(seed=_cfg["seed"], n=_cfg["n"], k=_cfg["k"],
+                          dim=_cfg["dim"])
+    _pt_store = MemJobStore() if _cfg["coord"] == "mem" \
+        else FileJobStore(_cfg["coord"])
+    pt = _table()
+    if "centroids" not in pt:
+        # deterministic seed: the first k points (matches the TPU-native
+        # parity test, which starts kmeans_fit from the same rows)
+        pt.set({"centroids": _x[:_cfg["k"]].tolist(), "iter": 0,
+                "finished": False, "sse": None})
+        pt.update()
+
+
+def taskfn(emit):
+    for i in range(_cfg["n_shards"]):
+        emit(i, i)
+
+
+def _shard_points(shard: int) -> np.ndarray:
+    return _x[int(shard)::_cfg["n_shards"]]
+
+
+def mapfn(key, shard, emit):
+    pt = _table(read_only=True)
+    centroids = np.asarray(pt["centroids"], np.float32)
+    x = _shard_points(shard)
+    d2 = (np.sum(x ** 2, axis=1)[:, None]
+          - 2.0 * x @ centroids.T
+          + np.sum(centroids ** 2, axis=1)[None, :])
+    nearest = np.argmin(d2, axis=1)
+    sse = float(d2[np.arange(len(x)), nearest].sum())
+    for j in range(centroids.shape[0]):
+        sel = nearest == j
+        if sel.any():       # empty partitions are tolerated (SURVEY.md §6)
+            emit(int(j), {"sum": x[sel].sum(axis=0).tolist(),
+                          "count": int(sel.sum())})
+    emit("SSE", {"sse": sse})
+
+
+def partitionfn(key):
+    return sum(str(key).encode()) % NUM_REDUCERS
+
+
+def reducefn(key, values):
+    if key == "SSE":
+        return {"sse": sum(v["sse"] for v in values)}
+    acc = np.asarray(values[0]["sum"], np.float64)
+    count = values[0]["count"]
+    for v in values[1:]:
+        acc = acc + np.asarray(v["sum"], np.float64)
+        count += v["count"]
+    return {"sum": acc.tolist(), "count": count}
+
+
+reducefn.associative_reducer = True
+reducefn.commutative_reducer = True
+reducefn.idempotent_reducer = True
+
+
+def finalfn(pairs):
+    pt = _table()
+    old = np.asarray(pt["centroids"], np.float32)
+    new = old.copy()
+    sse = None
+    for key, vs in pairs:
+        v = vs[0]
+        if key == "SSE":
+            sse = v["sse"]
+        else:
+            new[int(key)] = np.asarray(v["sum"], np.float64) / v["count"]
+    shift = float(np.abs(new - old).max())
+    it = pt["iter"] + 1
+    finished = shift < _cfg["tol"] or it >= _cfg["max_iters"]
+    pt.set({"centroids": new.tolist(), "iter": it, "finished": finished,
+            "sse": sse, "shift": shift})
+    pt.update()
+    return False if finished else "loop"
+
+
+def read_state(coord="mem", pt_store=None):
+    """Final state for callers/tests (pass the FileJobStore path used as
+    ``coord``, or reuse the in-process store when coord was "mem")."""
+    store = pt_store or (_pt_store if coord == "mem"
+                         else FileJobStore(coord))
+    return PersistentTable(TABLE, store, read_only=True).as_dict()

@@ -64,6 +64,21 @@ class ResNetConfig:
                             imagenet_stem=False, norm_groups=4)
 
 
+def _block_plan(cfg: ResNetConfig):
+    """Yield (name, stride, needs_proj, c_in, c_out) for every residual
+    block — the single source of the downsampling/projection topology;
+    init, apply, and the FLOPs accounting all consume this plan so the
+    three can never drift apart."""
+    c_in = cfg.widths[0]
+    for s, (c_out, n_blocks) in enumerate(
+            zip(cfg.widths, cfg.blocks_per_stage)):
+        for b in range(n_blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            yield (f"s{s}b{b}", stride, stride != 1 or c_in != c_out,
+                   c_in, c_out)
+            c_in = c_out
+
+
 def _groups(cfg: ResNetConfig, c: int) -> int:
     g = min(cfg.norm_groups, c)
     while c % g:
@@ -100,25 +115,20 @@ def init_resnet(key, cfg: ResNetConfig = ResNetConfig(),
     params["stem_W"] = _conv_init(next(keys), k_stem, c_in, cfg.widths[0],
                                   dtype)
     norm("stem_n", cfg.widths[0])
-    c_in = cfg.widths[0]
 
-    for s, (c_out, n_blocks) in enumerate(
-            zip(cfg.widths, cfg.blocks_per_stage)):
-        for b in range(n_blocks):
-            p = f"s{s}b{b}"
-            stride = 2 if (s > 0 and b == 0) else 1
-            params[f"{p}_conv1_W"] = _conv_init(next(keys), 3, c_in, c_out,
-                                                dtype)
-            norm(f"{p}_n1", c_out)
-            params[f"{p}_conv2_W"] = _conv_init(next(keys), 3, c_out, c_out,
-                                                dtype)
-            norm(f"{p}_n2", c_out)
-            if stride != 1 or c_in != c_out:
-                params[f"{p}_proj_W"] = _conv_init(next(keys), 1, c_in,
-                                                   c_out, dtype)
-                norm(f"{p}_np", c_out)
-            c_in = c_out
+    for p, _stride, needs_proj, c_in, c_out in _block_plan(cfg):
+        params[f"{p}_conv1_W"] = _conv_init(next(keys), 3, c_in, c_out,
+                                            dtype)
+        norm(f"{p}_n1", c_out)
+        params[f"{p}_conv2_W"] = _conv_init(next(keys), 3, c_out, c_out,
+                                            dtype)
+        norm(f"{p}_n2", c_out)
+        if needs_proj:
+            params[f"{p}_proj_W"] = _conv_init(next(keys), 1, c_in,
+                                               c_out, dtype)
+            norm(f"{p}_np", c_out)
 
+    c_in = cfg.widths[-1]
     bound = jnp.sqrt(6.0 / (c_in + cfg.n_classes))
     params["fc_W"] = jax.random.uniform(next(keys), (c_in, cfg.n_classes),
                                         dtype, -bound, bound)
@@ -159,22 +169,19 @@ def resnet_apply(params: Params, x: jnp.ndarray, *,
                  backend: str = "auto") -> jnp.ndarray:
     """(N, H, W, C) → (N, n_classes) log-probabilities."""
     x = _stem(params, x, cfg, backend)
-    for s, n_blocks in enumerate(cfg.blocks_per_stage):
-        for b in range(n_blocks):
-            p = f"s{s}b{b}"
-            stride = 2 if (s > 0 and b == 0) else 1
-            g = _groups(cfg, cfg.widths[s])
-            h = conv2d(x, params[f"{p}_conv1_W"], stride=stride,
+    for p, stride, needs_proj, _c_in, c_out in _block_plan(cfg):
+        g = _groups(cfg, c_out)
+        h = conv2d(x, params[f"{p}_conv1_W"], stride=stride,
+                   padding="SAME", backend=backend)
+        h = jax.nn.relu(_group_norm(params, f"{p}_n1", h, g))
+        h = conv2d(h, params[f"{p}_conv2_W"], stride=1, padding="SAME",
+                   backend=backend)
+        h = _group_norm(params, f"{p}_n2", h, g)
+        if needs_proj:
+            x = conv2d(x, params[f"{p}_proj_W"], stride=stride,
                        padding="SAME", backend=backend)
-            h = jax.nn.relu(_group_norm(params, f"{p}_n1", h, g))
-            h = conv2d(h, params[f"{p}_conv2_W"], stride=1, padding="SAME",
-                       backend=backend)
-            h = _group_norm(params, f"{p}_n2", h, g)
-            if f"{p}_proj_W" in params:
-                x = conv2d(x, params[f"{p}_proj_W"], stride=stride,
-                           padding="SAME", backend=backend)
-                x = _group_norm(params, f"{p}_np", x, g)
-            x = jax.nn.relu(x + h)
+            x = _group_norm(params, f"{p}_np", x, g)
+        x = jax.nn.relu(x + h)
     # global average pool: a full-map mean has no window structure for the
     # pooling kernels to exploit — one XLA reduction is the right lowering
     x = jnp.mean(x, axis=(1, 2))
@@ -213,16 +220,12 @@ def flops_per_example(cfg: ResNetConfig = ResNetConfig()) -> int:
     else:
         h, w, f = conv_flops(h, w, 3, 1, c_in, cfg.widths[0])
         fwd += f
-    c_in = cfg.widths[0]
-    for s, (c_out, n_blocks) in enumerate(
-            zip(cfg.widths, cfg.blocks_per_stage)):
-        for b in range(n_blocks):
-            stride = 2 if (s > 0 and b == 0) else 1
-            ho, wo, f1 = conv_flops(h, w, 3, stride, c_in, c_out)
-            _, _, f2 = conv_flops(ho, wo, 3, 1, c_out, c_out)
-            fwd += f1 + f2
-            if stride != 1 or c_in != c_out:
-                fwd += conv_flops(h, w, 1, stride, c_in, c_out)[2]
-            h, w, c_in = ho, wo, c_out
-    fwd += 2 * c_in * cfg.n_classes
+    for _p, stride, needs_proj, c_in, c_out in _block_plan(cfg):
+        ho, wo, f1 = conv_flops(h, w, 3, stride, c_in, c_out)
+        _, _, f2 = conv_flops(ho, wo, 3, 1, c_out, c_out)
+        fwd += f1 + f2
+        if needs_proj:
+            fwd += conv_flops(h, w, 1, stride, c_in, c_out)[2]
+        h, w = ho, wo
+    fwd += 2 * cfg.widths[-1] * cfg.n_classes
     return 3 * fwd

@@ -37,6 +37,33 @@ def make_digits(seed: int = 0, n_train: int = N_TRAIN, n_val: int = N_VAL,
     return x_tr, y_tr, x_va, y_va
 
 
+def make_blobs(seed: int = 0, n: int = 2048, k: int = 8, dim: int = 16,
+               spread: float = 0.15
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Gaussian blobs for the k-means workload (BASELINE.json config 5):
+    (points (n, dim), labels (n,), true centers (k, dim)), deterministic
+    in the seed."""
+    rng = np.random.RandomState(seed)
+    centers = rng.rand(k, dim).astype(np.float32)
+    y = rng.randint(0, k, n)
+    x = centers[y] + spread * rng.randn(n, dim).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.int32), centers
+
+
+def make_ratings(seed: int = 0, n_users: int = 256, n_items: int = 64,
+                 rank: int = 4, density: float = 0.3, noise: float = 0.01
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Low-rank ratings matrix + observation mask for the ALS workload
+    (BASELINE.json config 5). R = U Vᵀ + noise is exactly rank-``rank``
+    up to the noise, so ALS at that rank drives masked RMSE → noise."""
+    rng = np.random.RandomState(seed)
+    u = rng.randn(n_users, rank).astype(np.float32)
+    v = rng.randn(n_items, rank).astype(np.float32)
+    r = u @ v.T + noise * rng.randn(n_users, n_items).astype(np.float32)
+    w = (rng.rand(n_users, n_items) < density).astype(np.float32)
+    return r.astype(np.float32), w
+
+
 def make_images(seed: int = 0, n_train: int = 2048, n_val: int = 512,
                 shape: Tuple[int, int, int] = (32, 32, 3),
                 n_classes: int = N_CLASSES, noise: float = 0.3

@@ -43,6 +43,11 @@ def make_sharded(store, prefix: str, x: np.ndarray, y: np.ndarray,
     shard it names is complete."""
     if not 1 <= n_shards <= len(x):
         raise ValueError(f"n_shards={n_shards} not in [1, {len(x)}]")
+    if store.exists(f"{prefix}.manifest"):
+        # re-sharding: retire the old layout manifest-first (readers fail
+        # at open, not mid-epoch) and delete ALL old shards — a smaller
+        # new n_shards must not leak orphans the new manifest never names
+        ShardedDataset(store, prefix).remove()
     names = []
     bounds = np.linspace(0, len(x), n_shards + 1, dtype=int)
     for i in range(n_shards):
@@ -95,12 +100,27 @@ class ShardedDataset:
         common step count every host must use: in SPMD training each step
         is a collective program, so hosts running unequal step counts
         deadlock the mesh. Computed from the manifest's shard sizes, so
-        every host derives the same number without communicating."""
+        every host derives the same number without communicating.
+
+        Raises rather than returning 0 (a silent 0 would make every
+        host's epoch a no-op): every host must own at least one shard
+        (shard i → host i % n_hosts requires n_shards ≥ n_hosts) and the
+        smallest host's share must cover one full batch."""
         sizes = self.meta["sizes"]
-        return min(
+        if self.n_shards < n_hosts:
+            raise ValueError(
+                f"{self.n_shards} shards cannot feed {n_hosts} hosts — "
+                f"re-shard with n_shards >= n_hosts")
+        steps = min(
             sum(sizes[i] for i in self._host_shards(h, n_hosts))
             // batch_size
             for h in range(n_hosts))
+        if steps == 0:
+            raise ValueError(
+                f"batch_size={batch_size} exceeds the smallest host's "
+                f"share ({min(sizes)}-example shards over {n_hosts} "
+                f"hosts) — every epoch would yield zero steps")
+        return steps
 
     def batches(self, batch_size: int, *, rng: np.random.RandomState,
                 host_id: int = 0, n_hosts: int = 1, drop_remainder=True

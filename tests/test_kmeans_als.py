@@ -1,0 +1,148 @@
+"""Iterative-state workloads (BASELINE.json config 5): k-means and ALS.
+
+Three angles per algorithm, mirroring the golden-diff discipline of the
+reference's test.sh (SURVEY.md §4):
+- the TPU-native jitted fit converges on synthetic data,
+- the mesh-sharded run agrees with the single-device run,
+- the six-function MapReduce packaging (persistent_table state) agrees
+  with the TPU-native fit.
+"""
+
+import numpy as np
+import pytest
+
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.local import LocalExecutor
+from lua_mapreduce_tpu.models import als, kmeans
+from lua_mapreduce_tpu.parallel.mesh import host_mesh
+from lua_mapreduce_tpu.train.data import make_blobs, make_ratings
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return host_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    return make_blobs(seed=3, n=2048, k=8, dim=16)
+
+
+@pytest.fixture(scope="module")
+def ratings():
+    return make_ratings(seed=4, n_users=256, n_items=64, rank=4)
+
+
+class TestKMeansNative:
+    def test_recovers_centers_and_monotone_inertia(self, blobs):
+        x, _, centers = blobs
+        res = kmeans.kmeans_fit(x, kmeans.init_centroids(
+            __import__("jax").random.PRNGKey(0), x, 8), n_iters=25)
+        hist = np.asarray(res.history)
+        assert (np.diff(hist) <= 1e-3).all(), "Lloyd inertia must not rise"
+        # every true center has a fitted centroid nearby
+        d = np.linalg.norm(np.asarray(res.centroids)[None, :, :]
+                           - centers[:, None, :], axis=-1)
+        assert d.min(axis=1).max() < 0.25, d.min(axis=1)
+
+    def test_mesh_matches_single_device(self, blobs, mesh):
+        x = blobs[0]
+        c0 = x[:8]
+        single = kmeans.kmeans_fit(x, c0, n_iters=10)
+        sharded = kmeans.kmeans_fit(x, c0, n_iters=10, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(single.centroids),
+                                   np.asarray(sharded.centroids),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(float(single.inertia),
+                                   float(sharded.inertia), rtol=1e-3)
+
+    def test_empty_cluster_keeps_centroid(self):
+        x = np.zeros((16, 2), np.float32)       # all points identical
+        c0 = np.array([[0.0, 0.0], [9.0, 9.0]], np.float32)
+        res = kmeans.kmeans_fit(x, c0, n_iters=3)
+        np.testing.assert_allclose(np.asarray(res.centroids)[1],
+                                   [9.0, 9.0])  # never assigned, unmoved
+
+
+class TestALSNative:
+    def test_converges_to_noise_floor(self, ratings):
+        import jax
+        r, w = ratings
+        v0 = als.init_item_factors(jax.random.PRNGKey(0), 64, 4)
+        res = als.als_fit(r, w, v0, n_iters=10, reg=0.01)
+        hist = np.asarray(res.history)
+        assert hist[-1] < 0.05, hist
+        assert hist[-1] <= hist[0]
+        # factors reconstruct observed entries
+        recon = np.asarray(res.user_factors) @ np.asarray(res.item_factors).T
+        err = (w * (recon - r))
+        assert np.sqrt((err ** 2).sum() / w.sum()) < 0.05
+
+    def test_mesh_matches_single_device(self, ratings, mesh):
+        import jax
+        r, w = ratings
+        v0 = als.init_item_factors(jax.random.PRNGKey(1), 64, 4)
+        single = als.als_fit(r, w, v0, n_iters=5)
+        sharded = als.als_fit(r, w, v0, n_iters=5, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(single.item_factors),
+                                   np.asarray(sharded.item_factors),
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(single.user_factors),
+                                   np.asarray(sharded.user_factors),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def _run_example(module, args, iterations):
+    spec = TaskSpec(taskfn=module, mapfn=module, partitionfn=module,
+                    reducefn=module, finalfn=module,
+                    init_args=args, storage="mem:kmals-test")
+    ex = LocalExecutor(spec, map_parallelism=4,
+                       max_iterations=iterations + 1)
+    ex.run()
+    return ex
+
+
+class TestMapReducePackaging:
+    def test_kmeans_example_matches_native(self):
+        """Six-function k-means (persistent_table state) ≡ the jitted
+        kmeans_fit from the same seed centroids."""
+        from examples.kmeans import mr_kmeans
+        args = {"k": 8, "n": 1024, "dim": 8, "n_shards": 4,
+                "max_iters": 5, "tol": 0.0, "seed": 5, "coord": "mem"}
+        _run_example("examples.kmeans.mr_kmeans", args, iterations=5)
+        state = mr_kmeans.read_state("mem")
+        assert state["iter"] == 5 and state["finished"]
+
+        x, _, _ = make_blobs(seed=5, n=1024, k=8, dim=8)
+        native = kmeans.kmeans_fit(x, x[:8], n_iters=5)
+        np.testing.assert_allclose(
+            np.asarray(state["centroids"]),
+            np.asarray(native.centroids), rtol=1e-3, atol=1e-3)
+
+    def test_kmeans_example_converges_by_tol(self):
+        from examples.kmeans import mr_kmeans
+        args = {"k": 4, "n": 512, "dim": 8, "n_shards": 4,
+                "max_iters": 30, "tol": 1e-3, "seed": 6, "coord": "mem"}
+        _run_example("examples.kmeans.mr_kmeans", args, iterations=30)
+        state = mr_kmeans.read_state("mem")
+        assert state["finished"] and state["iter"] < 30, state["iter"]
+        assert state["shift"] < 1e-3
+
+    def test_als_example_matches_native(self):
+        from examples.als import mr_als
+        args = {"n_users": 128, "n_items": 32, "rank": 4, "density": 0.4,
+                "reg": 0.1, "n_shards": 4, "max_iters": 6, "seed": 7,
+                "coord": "mem"}
+        _run_example("examples.als.mr_als", args, iterations=6)
+        state = mr_als.read_state("mem")
+        assert state["iter"] == 6 and state["finished"]
+        # mr rmse is the pre-update measurement (one round behind native)
+        assert state["rmse"] < 0.5
+
+        r, w = make_ratings(seed=7, n_users=128, n_items=32, rank=4,
+                            density=0.4)
+        v0 = 0.1 * np.random.RandomState(7).randn(32, 4)
+        native = als.als_fit(r, w, v0, n_iters=6, reg=0.1)
+        np.testing.assert_allclose(
+            np.asarray(state["item_factors"]),
+            np.asarray(native.item_factors), rtol=5e-3, atol=5e-3)

@@ -99,3 +99,27 @@ def test_equal_step_counts_across_hosts(data):
                                         host_id=h, n_hosts=3))
               for h in range(3)]
     assert counts == [expect] * 3, counts
+
+
+def test_zero_step_hosts_raise(data):
+    """steps_per_epoch must fail loudly, never return a silent 0."""
+    x, y = data
+    store = MemStore()
+    make_sharded(store, "euro", x, y, n_shards=4)
+    ds = ShardedDataset(store, "euro")
+    with pytest.raises(ValueError, match="cannot feed"):
+        ds.steps_per_epoch(7, n_hosts=8)        # hosts without shards
+    with pytest.raises(ValueError, match="zero steps"):
+        ds.steps_per_epoch(120, n_hosts=2)      # batch > host share
+
+
+def test_reshard_replaces_layout_without_orphans(data):
+    x, y = data
+    store = MemStore()
+    make_sharded(store, "euro", x, y, n_shards=13)
+    make_sharded(store, "euro", x, y, n_shards=5)
+    shards = [n for n in store.list("euro.S*")]
+    assert len(shards) == 5, shards              # no 13-shard orphans
+    ds = ShardedDataset(store, "euro")
+    xs, _ = zip(*(ds.load_shard(i) for i in range(5)))
+    np.testing.assert_array_equal(np.concatenate(xs), x)
