@@ -1,0 +1,224 @@
+"""Decoder-only transformer LM — the long-context model family.
+
+The reference's model zoo is its example workloads (SURVEY.md §2.3); this
+family extends the zoo to sequences, the capability the sequence-parallel
+layer (parallel/ring_attention.py) exists for. One model, three execution
+forms that must agree (golden-diff discipline, SURVEY.md §4):
+
+- :func:`transformer_apply` — single-device oracle (full attention).
+- :func:`make_sharded_apply` — the same forward inside ``shard_map`` over
+  a (dp, sp) mesh: batch sharded on ``dp``, sequence sharded on ``sp``,
+  attention via the ring (KV shards rotating over ICI) or Ulysses
+  (all_to_all head reshard). No device ever holds a full sequence —
+  context length scales with the sp axis.
+- :func:`make_train_step` — jitted SPMD LM training step over the mesh:
+  per-device loss on its (batch, seq) tile, gradient pmean over BOTH axes
+  fused into the backward pass (the reference's reducefn-sum shape,
+  common.lua:112-137).
+
+Params are a flat name→array dict (the grad-shuffle key space, like every
+model in this zoo). Layout: activations (B, L, D); attention heads split
+D as (H, D/H). Weights stay f32; matmul FLOPs ride the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from lua_mapreduce_tpu.parallel.ring_attention import (
+    _ring_shard, _ulysses_shard, attention_reference)
+
+Params = Dict[str, jnp.ndarray]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq: int = 512
+
+    @staticmethod
+    def tiny() -> "TransformerConfig":
+        return TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                 n_layers=2, d_ff=64, max_seq=128)
+
+
+def init_transformer(key, cfg: TransformerConfig = TransformerConfig(),
+                     dtype=jnp.float32) -> Params:
+    """Flat params: tok/pos embeddings, per layer fused qkv + out proj +
+    2-layer MLP + 2 layernorms, final layernorm; the LM head is tied to
+    the token embedding (standard weight tying)."""
+    d, ff = cfg.d_model, cfg.d_ff
+    params: Params = {}
+    keys = iter(jax.random.split(key, 2 + 4 * cfg.n_layers))
+    params["tok_emb"] = 0.02 * jax.random.normal(
+        next(keys), (cfg.vocab, d), dtype)
+    params["pos_emb"] = 0.02 * jax.random.normal(
+        next(keys), (cfg.max_seq, d), dtype)
+    for i in range(cfg.n_layers):
+        p = f"L{i}"
+        params[f"{p}_qkv_W"] = jax.random.normal(
+            next(keys), (d, 3 * d), dtype) / np.sqrt(d)
+        params[f"{p}_out_W"] = jax.random.normal(
+            next(keys), (d, d), dtype) / np.sqrt(d)
+        params[f"{p}_ff1_W"] = jax.random.normal(
+            next(keys), (d, ff), dtype) / np.sqrt(d)
+        params[f"{p}_ff1_b"] = jnp.zeros((ff,), dtype)
+        params[f"{p}_ff2_W"] = jax.random.normal(
+            next(keys), (ff, d), dtype) / np.sqrt(ff)
+        params[f"{p}_ff2_b"] = jnp.zeros((d,), dtype)
+        for ln in ("ln1", "ln2"):
+            params[f"{p}_{ln}_g"] = jnp.ones((d,), dtype)
+            params[f"{p}_{ln}_b"] = jnp.zeros((d,), dtype)
+    params["lnf_g"] = jnp.ones((d,), dtype)
+    params["lnf_b"] = jnp.zeros((d,), dtype)
+    return params
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * g + b
+
+
+def _block(params: Params, i: int, x, cfg: TransformerConfig, attn_fn):
+    """One pre-LN decoder block; ``attn_fn(q, k, v) -> out`` supplies the
+    (possibly sequence-parallel) attention."""
+    p = f"L{i}"
+    b, l, d = x.shape
+    h, hd = cfg.n_heads, d // cfg.n_heads
+    y = _layer_norm(x, params[f"{p}_ln1_g"], params[f"{p}_ln1_b"])
+    qkv = y @ params[f"{p}_qkv_W"]                      # (B, L, 3D) MXU
+    q, k, v = (t.reshape(b, l, h, hd)
+               for t in jnp.split(qkv, 3, axis=-1))
+    a = attn_fn(q, k, v).reshape(b, l, d)
+    x = x + a @ params[f"{p}_out_W"]
+    y = _layer_norm(x, params[f"{p}_ln2_g"], params[f"{p}_ln2_b"])
+    y = jax.nn.gelu(y @ params[f"{p}_ff1_W"] + params[f"{p}_ff1_b"])
+    return x + y @ params[f"{p}_ff2_W"] + params[f"{p}_ff2_b"]
+
+
+def _check_seq(global_len: int, cfg: TransformerConfig) -> None:
+    """Static-shape guard: out-of-range position gathers would silently
+    CLAMP to pos_emb's last row under jit, not raise."""
+    if global_len > cfg.max_seq:
+        raise ValueError(
+            f"sequence length {global_len} exceeds max_seq={cfg.max_seq}")
+
+
+def _forward(params: Params, tokens, pos, cfg: TransformerConfig,
+             attn_fn):
+    """Shared body: tokens (B, L) int32, pos (L,) global positions."""
+    x = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    for i in range(cfg.n_layers):
+        x = _block(params, i, x, cfg, attn_fn)
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    return x @ params["tok_emb"].T                      # tied head
+
+
+def transformer_apply(params: Params, tokens, *,
+                      cfg: TransformerConfig = TransformerConfig()
+                      ) -> jnp.ndarray:
+    """Single-device oracle: (B, L) tokens → (B, L, vocab) logits."""
+    _check_seq(tokens.shape[1], cfg)
+    pos = jnp.arange(tokens.shape[1])
+    return _forward(params, tokens, pos, cfg,
+                    functools.partial(attention_reference, causal=True))
+
+
+def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int):
+    """Resolve the sequence-parallel attention body; strict — a typo'd
+    name must fail at factory time, never silently pick an algorithm."""
+    if attn == "ring":
+        return functools.partial(_ring_shard, axis=sp_axis,
+                                 n_shards=n_sp, causal=True)
+    if attn == "ulysses":
+        return functools.partial(_ulysses_shard, axis=sp_axis,
+                                 n_shards=n_sp, causal=True)
+    raise ValueError(f"unknown attn {attn!r} (want 'ring' or 'ulysses')")
+
+
+def make_sharded_apply(cfg: TransformerConfig, mesh, *,
+                       attn: str = "ring", dp_axis: str = "dp",
+                       sp_axis: str = "sp"):
+    """Jitted forward over the mesh: tokens P(dp, sp), params replicated,
+    attention sequence-parallel over ``sp``."""
+    n_sp = mesh.shape[sp_axis]
+
+    attn_shard = _attn_shard_fn(attn, sp_axis, n_sp)
+
+    def shard_fwd(params, tokens):
+        l_loc = tokens.shape[1]
+        _check_seq(l_loc * n_sp, cfg)
+        pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
+        return _forward(params, tokens, pos, cfg, attn_shard)
+
+    fn = jax.shard_map(shard_fwd, mesh=mesh,
+                       in_specs=(P(), P(dp_axis, sp_axis)),
+                       out_specs=P(dp_axis, sp_axis))
+    return jax.jit(fn)
+
+
+def lm_loss_local(params, tokens, targets, cfg, attn_fn, pos):
+    """Mean next-token NLL on this device's tile (targets pre-shifted by
+    the caller — with a sharded sequence the shift crosses shard edges,
+    so it happens host-side before sharding)."""
+    logits = _forward(params, tokens, pos, cfg, attn_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
+                    attn: str = "ring", dp_axis: str = "dp",
+                    sp_axis: str = "sp"):
+    """Jitted SPMD LM train step: ``step(params, opt_state, tokens,
+    targets) -> (params, opt_state, loss)`` with tokens/targets sharded
+    P(dp, sp) and the gradient all-reduce (pmean over dp AND sp) fused
+    into the backward pass."""
+    n_sp = mesh.shape[sp_axis]
+    attn_shard = _attn_shard_fn(attn, sp_axis, n_sp)
+
+    def shard_step(params, tokens, targets):
+        l_loc = tokens.shape[1]
+        _check_seq(l_loc * n_sp, cfg)
+        pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
+
+        def global_loss(p):
+            local = lm_loss_local(p, tokens, targets, cfg, attn_shard,
+                                  pos)
+            return lax.pmean(lax.pmean(local, sp_axis), dp_axis)
+
+        return jax.value_and_grad(global_loss)(params)
+
+    mapped = jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(P(), P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
+        out_specs=(P(), P()))
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = mapped(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def shard_batch(mesh, tokens, targets, dp_axis="dp", sp_axis="sp"):
+    """Place a (B, L) batch with batch over dp, sequence over sp."""
+    sharding = NamedSharding(mesh, P(dp_axis, sp_axis))
+    return (jax.device_put(tokens, sharding),
+            jax.device_put(targets, sharding))

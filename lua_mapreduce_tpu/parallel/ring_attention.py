@@ -1,0 +1,191 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no sequence dimension (SURVEY.md §5 "Long-context …
+absent"), but its two shuffle topologies are exactly the two ways long
+sequences are parallelized on a TPU mesh, so this framework treats them
+as first-class:
+
+- **Ring attention** (:func:`ring_attention`) is the *streaming k-way
+  merge* shape (utils.lua:206-271): no device ever materializes the full
+  sequence; KV shards rotate around the ring (``ppermute`` over ICI, one
+  neighbor hop per step) while each device folds incoming blocks into an
+  online-softmax accumulator — compute overlaps the next block's DMA,
+  the same overlap the reference gets by merging file streams lazily.
+  Memory per device is O(L/P), enabling context lengths that cannot fit
+  on one chip.
+
+- **Ulysses** (:func:`ulysses_attention`) is the *partitionfn →
+  all_to_all* shuffle shape (SURVEY.md §2.6): one collective reshards
+  from sequence-sharded to head-sharded, each device runs its heads'
+  full attention locally, and the inverse all_to_all reshards back.
+  Cheaper per step than a ring when heads ≥ devices and the full
+  sequence fits per device head-slice.
+
+Both compute EXACTLY standard softmax attention — tests golden-diff them
+against :func:`attention_reference` (the single-device oracle), the same
+discipline test.sh applies to the wordcount engine (SURVEY.md §4).
+
+Layout: (batch, seq, heads, head_dim), sequence sharded over the mesh
+axis (default ``"sp"``). All einsums are MXU contractions; the online
+softmax keeps f32 accumulators regardless of input dtype (bf16-safe).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30      # finite mask fill: -inf breaks the m-subtraction
+
+
+def attention_reference(q, k, v, *, causal: bool = False):
+    """Single-device softmax attention oracle, (B, L, H, D) layout."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1])
+    s = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((lq, lk), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhlm,bmhd->blhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _block_fold(o, m, l, q, k, v, mask, scale):
+    """Fold one KV block into the online-softmax accumulator (o, m, l):
+    the flash-attention update, shapes (B,H,Lq,D), (B,H,Lq), (B,H,Lq)."""
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale     # (B,H,Lq,Lk) MXU
+    s = jnp.where(mask, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # p is explicitly re-masked: when a whole block is masked, s - m_new
+    # is 0 (both _NEG_INF) and exp would contribute 1s without it
+    p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum("bhlm,bmhd->bhld", p, v)
+    return o_new, m_new, l_new
+
+
+def _ring_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
+    """Per-device body (inside shard_map): local q stays put, (k, v)
+    rotate the ring; after step i this device holds the KV shard of
+    device (my - i) mod P."""
+    b, l_loc, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(d)
+    my = lax.axis_index(axis)
+    qf = q.astype(jnp.float32)
+    pos_q = my * l_loc + jnp.arange(l_loc)              # global q rows
+
+    # accumulators are derived from q (zeroed) rather than jnp.zeros so
+    # they inherit q's varying-axes type: fresh constants are replicated
+    # in shard_map's vma typing and would mismatch the scan carry — and
+    # deriving from q stays correct however many mesh axes the CALLER's
+    # shard_map adds around this body (e.g. dp × sp in the transformer)
+    z = jnp.transpose(qf, (0, 2, 1, 3)) * 0.0           # (B,H,Lq,D)
+    o = z
+    m = z[..., 0] + _NEG_INF
+    l = z[..., 0]
+
+    def step(carry, i):
+        o, m, l, kb, vb = carry
+        src = (my - i) % n_shards                       # whose KV is here
+        pos_k = src * l_loc + jnp.arange(l_loc)
+        if causal:
+            mask = pos_q[:, None] >= pos_k[None, :]     # (Lq, Lk)
+        else:
+            mask = jnp.ones((l_loc, l_loc), bool)
+        o, m, l = _block_fold(o, m, l, qf, kb.astype(jnp.float32),
+                              vb.astype(jnp.float32), mask, scale)
+        # rotate AFTER folding; the last fold needs no send. ppermute
+        # i→i+1 means we receive from our anticlockwise neighbor, so the
+        # held shard index decreases by one each step.
+        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        return (o, m, l, kb, vb), None
+
+    # scan, not fori_loop: the trip count is static and scan supports
+    # reverse-mode AD (training needs d(attention)/d(qkv) through the ring)
+    (o, m, l, _, _), _ = lax.scan(step, (o, m, l, k, v),
+                                  jnp.arange(n_shards))
+    out = o / jnp.maximum(l, 1e-30)[..., None]          # (B,H,Lq,D)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_jit(mesh, axis: str, causal: bool):
+    """One compiled callable per (mesh, axis, causal) — jit caches key on
+    the function object, so building shard_map+jit per call would retrace
+    and recompile every invocation."""
+    fn = jax.shard_map(
+        functools.partial(_ring_shard, axis=axis,
+                          n_shards=mesh.shape[axis], causal=causal),
+        mesh=mesh, in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis))
+    return jax.jit(fn)
+
+
+def ring_attention(q, k, v, mesh, *, axis: str = "sp",
+                   causal: bool = False):
+    """Exact attention over a sequence sharded on ``axis`` of ``mesh``.
+
+    Inputs (B, L, H, D) are resharded to P(None, axis) if not already;
+    L must divide evenly by the axis size. Output has the same sharding.
+    """
+    n_shards = mesh.shape[axis]
+    if q.shape[1] % n_shards:
+        raise ValueError(
+            f"seq len {q.shape[1]} not divisible by {axis}={n_shards}")
+    sharding = NamedSharding(mesh, P(None, axis))
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return _ring_jit(mesh, axis, causal)(q, k, v)
+
+
+def _ulysses_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
+    """Per-device body: all_to_all seq-sharded → head-sharded, local full
+    attention, all_to_all back."""
+    def seq_to_heads(x):
+        # (B, L/P, H, D) → (B, L, H/P, D): split heads, concat sequence
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention_reference(qh, kh, vh, causal=causal)
+    return heads_to_seq(out)
+
+
+@functools.lru_cache(maxsize=None)
+def _ulysses_jit(mesh, axis: str, causal: bool):
+    fn = jax.shard_map(
+        functools.partial(_ulysses_shard, axis=axis,
+                          n_shards=mesh.shape[axis], causal=causal),
+        mesh=mesh, in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis))
+    return jax.jit(fn)
+
+
+def ulysses_attention(q, k, v, mesh, *, axis: str = "sp",
+                      causal: bool = False):
+    """Exact attention via the all-to-all (Ulysses) reshard. Heads must
+    divide evenly by the axis size (each device owns H/P full-sequence
+    heads between the two collectives)."""
+    n_shards = mesh.shape[axis]
+    if q.shape[2] % n_shards:
+        raise ValueError(
+            f"{q.shape[2]} heads not divisible by {axis}={n_shards}")
+    if q.shape[1] % n_shards:
+        raise ValueError(
+            f"seq len {q.shape[1]} not divisible by {axis}={n_shards}")
+    sharding = NamedSharding(mesh, P(None, axis))
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return _ulysses_jit(mesh, axis, causal)(q, k, v)

@@ -1,0 +1,103 @@
+"""Transformer LM family: the sharded (dp × sp) forms must golden-diff
+against the single-device oracle, and the sequence-parallel train step
+must actually learn."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from lua_mapreduce_tpu.models import transformer as tfm
+from lua_mapreduce_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    # 2 dp × 4 sp over the 8 virtual CPU devices
+    return make_mesh(dp=2, mp=4, devices=jax.devices("cpu")[:8],
+                     axis_names=("dp", "sp"))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tfm.TransformerConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return tfm.init_transformer(jax.random.PRNGKey(0), cfg)
+
+
+def _tokens(cfg, b=4, l=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randint(0, cfg.vocab, (b, l)), jnp.int32)
+
+
+@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+def test_sharded_forward_matches_oracle(mesh, cfg, params, attn):
+    tokens = _tokens(cfg)
+    want = tfm.transformer_apply(params, tokens, cfg=cfg)
+    fwd = tfm.make_sharded_apply(cfg, mesh, attn=attn)
+    got = fwd(params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_train_step_learns_copy_task(mesh, cfg):
+    """Sequence-parallel training on a deterministic pattern must reach
+    low loss: sequences follow tok[t+1] = (tok[t] + 1) % vocab."""
+    rng = np.random.RandomState(1)
+    b, l = 8, 64
+    start = rng.randint(0, cfg.vocab, (b, 1))
+    seq = (start + np.arange(l + 1)) % cfg.vocab
+    tokens = jnp.asarray(seq[:, :-1], jnp.int32)
+    targets = jnp.asarray(seq[:, 1:], jnp.int32)
+
+    params = tfm.init_transformer(jax.random.PRNGKey(2), cfg)
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+    step = tfm.make_train_step(cfg, mesh, opt, attn="ring")
+    tokens_d, targets_d = tfm.shard_batch(mesh, tokens, targets)
+
+    losses = []
+    for _ in range(60):
+        params, opt_state, loss = step(params, opt_state, tokens_d,
+                                       targets_d)
+        losses.append(float(loss))
+    assert losses[-1] < 0.5, losses[::10]
+    assert losses[-1] < losses[0] / 4
+
+
+def test_grads_cover_every_param(mesh, cfg):
+    """The fused pmean backward must deliver a gradient for every
+    parameter name (the grad-shuffle key-space invariant)."""
+    tokens = _tokens(cfg, seed=3)
+    targets = _tokens(cfg, seed=4)
+    # the step donates its param buffers — snapshot to host first
+    params = tfm.init_transformer(jax.random.PRNGKey(5), cfg)
+    before = {k: np.asarray(v).copy() for k, v in params.items()}
+    opt = optax.sgd(0.1)
+    step = tfm.make_train_step(cfg, mesh, opt, attn="ulysses")
+    new_params, _, loss = step(params, opt.init(params),
+                               *tfm.shard_batch(mesh, tokens, targets))
+    assert np.isfinite(float(loss))
+    moved = [k for k in before
+             if not np.allclose(before[k], np.asarray(new_params[k]))]
+    assert set(moved) == set(before), set(before) - set(moved)
+
+
+def test_seq_exceeding_max_seq_raises(mesh, cfg, params):
+    long_tokens = jnp.zeros((2, cfg.max_seq + 4), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq"):
+        tfm.transformer_apply(params, long_tokens, cfg=cfg)
+    fwd = tfm.make_sharded_apply(cfg, mesh, attn="ring")
+    with pytest.raises(ValueError, match="max_seq"):
+        fwd(params, jnp.zeros((2, cfg.max_seq + 8), jnp.int32))
+
+
+def test_unknown_attn_rejected_at_factory_time(mesh, cfg):
+    with pytest.raises(ValueError, match="unknown attn"):
+        tfm.make_train_step(cfg, mesh, optax.sgd(0.1), attn="rign")
+    with pytest.raises(ValueError, match="unknown attn"):
+        tfm.make_sharded_apply(cfg, mesh, attn="flash")
