@@ -9,100 +9,56 @@ on-disk format, so the choice is per-process, not per-cluster.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
-import threading
 from typing import Dict, Optional, Sequence, Tuple
 
 from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES, Status
+from lua_mapreduce_tpu.core.native_build import load_native
 from lua_mapreduce_tpu.coord.idx_py import PyJobIndex
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
 _SRC = os.path.join(_NATIVE_DIR, "jobstore.cpp")
 _SO = os.path.join(_NATIVE_DIR, "libjobstore.so")
 
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
-
-
-def _src_digest() -> str:
-    with open(_SRC, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()
-
-
-def _build_native() -> Optional[str]:
-    # freshness by source hash, not mtime: git checkout gives source and a
-    # stale binary identical mtimes, which would mask layout changes and
-    # break the native/Python on-disk format contract
-    digest_file = _SO + ".src.sha256"
-    digest = _src_digest()
-    if os.path.exists(_SO):
-        try:
-            with open(digest_file) as f:
-                if f.read().strip() == digest:
-                    return _SO
-        except OSError:
-            pass
-    try:
-        subprocess.run(
-            ["g++", "-O2", "-fPIC", "-shared", "-o", _SO, _SRC],
-            check=True, capture_output=True, timeout=120)
-        with open(digest_file, "w") as f:
-            f.write(digest)
-        return _SO
-    except (OSError, subprocess.SubprocessError):
-        return None
-
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
-    with _lock:
-        if _tried:
-            return _lib
-        _tried = True
-        so = _build_native()
-        if so is None:
-            return None
-        try:
-            lib = ctypes.CDLL(so)
-        except OSError:
-            return None
-        lib.jsx_insert.restype = ctypes.c_int64
-        lib.jsx_insert.argtypes = [ctypes.c_char_p, ctypes.c_int64]
-        lib.jsx_count.restype = ctypes.c_int64
-        lib.jsx_count.argtypes = [ctypes.c_char_p]
-        lib.jsx_claim.restype = ctypes.c_int64
-        lib.jsx_claim.argtypes = [ctypes.c_char_p, ctypes.c_int64,
-                                  ctypes.POINTER(ctypes.c_int64),
-                                  ctypes.c_int64, ctypes.c_int32]
-        lib.jsx_cas_status.restype = ctypes.c_int
-        lib.jsx_cas_status.argtypes = [ctypes.c_char_p, ctypes.c_int64,
-                                       ctypes.c_int32, ctypes.c_uint32,
-                                       ctypes.c_int64]
-        lib.jsx_get.restype = ctypes.c_int
-        lib.jsx_get.argtypes = [ctypes.c_char_p, ctypes.c_int64,
-                                ctypes.POINTER(ctypes.c_int32),
-                                ctypes.POINTER(ctypes.c_int32),
-                                ctypes.POINTER(ctypes.c_int64),
-                                ctypes.POINTER(ctypes.c_double)]
-        lib.jsx_counts.restype = ctypes.c_int64
-        lib.jsx_counts.argtypes = [ctypes.c_char_p,
-                                   ctypes.POINTER(ctypes.c_int64)]
-        lib.jsx_scavenge.restype = ctypes.c_int64
-        lib.jsx_scavenge.argtypes = [ctypes.c_char_p, ctypes.c_int32]
-        lib.jsx_requeue_stale.restype = ctypes.c_int64
-        lib.jsx_requeue_stale.argtypes = [ctypes.c_char_p, ctypes.c_double]
-        lib.jsx_snapshot.restype = ctypes.c_int64
-        lib.jsx_snapshot.argtypes = [ctypes.c_char_p,
-                                     ctypes.POINTER(ctypes.c_int32),
-                                     ctypes.POINTER(ctypes.c_int32),
-                                     ctypes.POINTER(ctypes.c_int64),
-                                     ctypes.POINTER(ctypes.c_double),
-                                     ctypes.c_int64]
-        _lib = lib
-        return _lib
+    lib = load_native(_SRC, _SO)
+    if lib is None or getattr(lib, "_jsx_configured", False):
+        return lib
+    lib._jsx_configured = True
+    lib.jsx_insert.restype = ctypes.c_int64
+    lib.jsx_insert.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.jsx_count.restype = ctypes.c_int64
+    lib.jsx_count.argtypes = [ctypes.c_char_p]
+    lib.jsx_claim.restype = ctypes.c_int64
+    lib.jsx_claim.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                              ctypes.POINTER(ctypes.c_int64),
+                              ctypes.c_int64, ctypes.c_int32]
+    lib.jsx_cas_status.restype = ctypes.c_int
+    lib.jsx_cas_status.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                   ctypes.c_int32, ctypes.c_uint32,
+                                   ctypes.c_int64]
+    lib.jsx_get.restype = ctypes.c_int
+    lib.jsx_get.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                            ctypes.POINTER(ctypes.c_int32),
+                            ctypes.POINTER(ctypes.c_int32),
+                            ctypes.POINTER(ctypes.c_int64),
+                            ctypes.POINTER(ctypes.c_double)]
+    lib.jsx_counts.restype = ctypes.c_int64
+    lib.jsx_counts.argtypes = [ctypes.c_char_p,
+                               ctypes.POINTER(ctypes.c_int64)]
+    lib.jsx_scavenge.restype = ctypes.c_int64
+    lib.jsx_scavenge.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+    lib.jsx_requeue_stale.restype = ctypes.c_int64
+    lib.jsx_requeue_stale.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.jsx_snapshot.restype = ctypes.c_int64
+    lib.jsx_snapshot.argtypes = [ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_int32),
+                                 ctypes.POINTER(ctypes.c_int32),
+                                 ctypes.POINTER(ctypes.c_int64),
+                                 ctypes.POINTER(ctypes.c_double),
+                                 ctypes.c_int64]
+    return lib
 
 
 class NativeJobIndex:

@@ -35,18 +35,20 @@ def merge_iterator(store, filenames: Sequence[str]) -> Iterator[Tuple[Any, List[
 
     while not heap.empty():
         key, values, idx = heap.pop()
-        values = list(values)
-        # drain every file whose head shares this key
+        # drain every file whose head shares this key; concatenate in
+        # RUN-FILE ORDER (not heap pop order) so reduce inputs are
+        # deterministic and identical to the native C++ merge's output
+        drained = [(idx, values)]
         while not heap.empty() and not key_lt(key, heap.top()[0]):
             _, more, jdx = heap.pop()
-            values.extend(more)
+            drained.append((jdx, more))
+        merged: List[Any] = []
+        for jdx, more in sorted(drained):
+            merged.extend(more)
             nxt = _take_next(iters[jdx])
             if nxt is not None:
                 heap.push((nxt[0], nxt[1], jdx))
-        nxt = _take_next(iters[idx])
-        if nxt is not None:
-            heap.push((nxt[0], nxt[1], idx))
-        yield key, values
+        yield key, merged
 
 
 def _take_next(it) -> Tuple[Any, List[Any]] | None:

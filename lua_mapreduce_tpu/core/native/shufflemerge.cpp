@@ -1,0 +1,373 @@
+// Native k-way shuffle merge over sorted JSON-line run files.
+//
+// The reference's bulk-data path is native C++ (luamongo + mongo-cxx-driver
+// GridFS chunk streaming, SURVEY.md §2.4); this is the new framework's
+// native piece for the same role on the host shuffle: merge every mapper's
+// sorted run for one partition into a single run whose equal-key value
+// lists are concatenated — the merge_iterator contract (reference
+// utils.lua:206-271) executed in one C++ pass instead of a Python heap
+// loop, so the reduce phase streams one pre-merged file.
+//
+// Record format: one JSON array per line, [key, [v1, v2, ...]] (see
+// core/serialize.py). Keys are compared with EXACTLY serialize.key_lt's
+// total order: type rank (bool < number < string < array < null), then
+// value — numbers int-exact when both sides are integral, strings by
+// Unicode code point (== UTF-8 byte order after unescaping), arrays
+// lexicographic then by length. Values are never parsed: their raw JSON
+// spans are spliced into the output line untouched.
+//
+// C ABI (ctypes): smerge_files(inputs, n, output) -> 0 ok, 1 I/O error,
+// 2 parse error. The output file is written directly; the Python caller
+// owns tmp+rename atomicity (the fs.lua:80-115 discipline).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <algorithm>
+#include <fstream>
+#include <queue>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Key {
+    int rank = 4;               // bool=0, num=1, str=2, arr=3, null=4
+    bool bval = false;
+    bool is_int = false;
+    bool neg = false;           // sign of an integral key
+    std::string digits;         // |value| digit string of an integral key
+    double dval = 0.0;
+    std::string sval;           // UTF-8 bytes, unescaped
+    std::vector<Key> arr;
+};
+
+// ---- minimal JSON parsing (keys only; values stay raw) --------------------
+
+void skip_ws(const char*& p) {
+    while (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n') ++p;
+}
+
+bool parse_hex4(const char*& p, unsigned& out) {
+    out = 0;
+    for (int i = 0; i < 4; ++i) {
+        char c = *p++;
+        out <<= 4;
+        if (c >= '0' && c <= '9') out |= (unsigned)(c - '0');
+        else if (c >= 'a' && c <= 'f') out |= (unsigned)(c - 'a' + 10);
+        else if (c >= 'A' && c <= 'F') out |= (unsigned)(c - 'A' + 10);
+        else return false;
+    }
+    return true;
+}
+
+void append_utf8(std::string& s, unsigned cp) {
+    if (cp < 0x80) {
+        s += (char)cp;
+    } else if (cp < 0x800) {
+        s += (char)(0xC0 | (cp >> 6));
+        s += (char)(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+        s += (char)(0xE0 | (cp >> 12));
+        s += (char)(0x80 | ((cp >> 6) & 0x3F));
+        s += (char)(0x80 | (cp & 0x3F));
+    } else {
+        s += (char)(0xF0 | (cp >> 18));
+        s += (char)(0x80 | ((cp >> 12) & 0x3F));
+        s += (char)(0x80 | ((cp >> 6) & 0x3F));
+        s += (char)(0x80 | (cp & 0x3F));
+    }
+}
+
+bool parse_string(const char*& p, std::string& out) {
+    if (*p != '"') return false;
+    ++p;
+    while (*p && *p != '"') {
+        if (*p == '\\') {
+            ++p;
+            switch (*p) {
+                case '"': out += '"'; ++p; break;
+                case '\\': out += '\\'; ++p; break;
+                case '/': out += '/'; ++p; break;
+                case 'b': out += '\b'; ++p; break;
+                case 'f': out += '\f'; ++p; break;
+                case 'n': out += '\n'; ++p; break;
+                case 'r': out += '\r'; ++p; break;
+                case 't': out += '\t'; ++p; break;
+                case 'u': {
+                    ++p;
+                    unsigned cp;
+                    if (!parse_hex4(p, cp)) return false;
+                    if (cp >= 0xD800 && cp <= 0xDBFF && p[0] == '\\' &&
+                        p[1] == 'u') {
+                        p += 2;
+                        unsigned lo;
+                        if (!parse_hex4(p, lo)) return false;
+                        cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                    }
+                    append_utf8(out, cp);
+                    break;
+                }
+                default: return false;
+            }
+        } else {
+            out += *p++;
+        }
+    }
+    if (*p != '"') return false;
+    ++p;
+    return true;
+}
+
+bool parse_key(const char*& p, Key& k) {
+    skip_ws(p);
+    if (*p == 't') {
+        if (strncmp(p, "true", 4)) return false;
+        p += 4; k.rank = 0; k.bval = true; return true;
+    }
+    if (*p == 'f') {
+        if (strncmp(p, "false", 5)) return false;
+        p += 5; k.rank = 0; k.bval = false; return true;
+    }
+    if (*p == 'n') {
+        if (strncmp(p, "null", 4)) return false;
+        p += 4; k.rank = 4; return true;
+    }
+    if (*p == '"') {
+        k.rank = 2;
+        return parse_string(p, k.sval);
+    }
+    if (*p == '[') {
+        ++p;
+        k.rank = 3;
+        skip_ws(p);
+        if (*p == ']') { ++p; return true; }
+        while (true) {
+            k.arr.emplace_back();
+            if (!parse_key(p, k.arr.back())) return false;
+            skip_ws(p);
+            if (*p == ',') { ++p; continue; }
+            if (*p == ']') { ++p; return true; }
+            return false;
+        }
+    }
+    if (*p == '-' || (*p >= '0' && *p <= '9')) {
+        const char* start = p;
+        bool integral = true;
+        if (*p == '-') ++p;
+        while (*p >= '0' && *p <= '9') ++p;
+        if (*p == '.' || *p == 'e' || *p == 'E') {
+            integral = false;
+            if (*p == '.') { ++p; while (*p >= '0' && *p <= '9') ++p; }
+            if (*p == 'e' || *p == 'E') {
+                ++p;
+                if (*p == '+' || *p == '-') ++p;
+                while (*p >= '0' && *p <= '9') ++p;
+            }
+        }
+        std::string num(start, (size_t)(p - start));
+        k.rank = 1;
+        k.dval = strtod(num.c_str(), nullptr);
+        if (integral) {
+            // exact arbitrary-precision compare via the digit string —
+            // Python ints never round through double (two 2**64-scale
+            // keys differing by 1 must NOT merge)
+            k.is_int = true;
+            k.neg = num[0] == '-';
+            k.digits = k.neg ? num.substr(1) : num;
+            if (k.digits == "0") k.neg = false;         // -0 == 0
+        }
+        return true;
+    }
+    return false;
+}
+
+// key_lt: -1 / 0 / +1 matching serialize.key_lt's total order
+int key_cmp(const Key& a, const Key& b) {
+    if (a.rank != b.rank) return a.rank < b.rank ? -1 : 1;
+    switch (a.rank) {
+        case 0:
+            if (a.bval == b.bval) return 0;
+            return a.bval ? 1 : -1;         // false < true
+        case 1:
+            if (a.is_int && b.is_int) {
+                if (a.neg != b.neg) return a.neg ? -1 : 1;
+                int mag;
+                if (a.digits.size() != b.digits.size())
+                    mag = a.digits.size() < b.digits.size() ? -1 : 1;
+                else {
+                    int c = a.digits.compare(b.digits);
+                    mag = c < 0 ? -1 : (c > 0 ? 1 : 0);
+                }
+                return a.neg ? -mag : mag;
+            }
+            return a.dval < b.dval ? -1 : (a.dval > b.dval ? 1 : 0);
+        case 2: {
+            int c = a.sval.compare(b.sval);  // UTF-8 bytes == code points
+            return c < 0 ? -1 : (c > 0 ? 1 : 0);
+        }
+        case 3: {
+            size_t n = a.arr.size() < b.arr.size() ? a.arr.size()
+                                                   : b.arr.size();
+            for (size_t i = 0; i < n; ++i) {
+                int c = key_cmp(a.arr[i], b.arr[i]);
+                if (c) return c;
+            }
+            if (a.arr.size() != b.arr.size())
+                return a.arr.size() < b.arr.size() ? -1 : 1;
+            return 0;
+        }
+        default:
+            return 0;                       // null == null
+    }
+}
+
+// find the end of a balanced JSON value starting at p (string-aware);
+// returns nullptr on malformed input
+const char* span_end(const char* p) {
+    skip_ws(p);
+    if (*p == '"') {
+        ++p;
+        while (*p && *p != '"') {
+            if (*p == '\\' && p[1]) ++p;
+            ++p;
+        }
+        return *p == '"' ? p + 1 : nullptr;
+    }
+    if (*p == '[' || *p == '{') {
+        char open = *p, close = (*p == '[') ? ']' : '}';
+        int depth = 0;
+        while (*p) {
+            if (*p == '"') {
+                ++p;
+                while (*p && *p != '"') {
+                    if (*p == '\\' && p[1]) ++p;
+                    ++p;
+                }
+                if (!*p) return nullptr;
+            } else if (*p == open) {
+                ++depth;
+            } else if (*p == close) {
+                if (--depth == 0) return p + 1;
+            }
+            ++p;
+        }
+        return nullptr;
+    }
+    while (*p && *p != ',' && *p != ']' && *p != '}' && *p != ' ' &&
+           *p != '\t' && *p != '\r' && *p != '\n')
+        ++p;
+    return p;
+}
+
+// ---- run-file cursor ------------------------------------------------------
+
+struct Run {
+    std::ifstream f;
+    std::string line;
+    Key key;
+    std::string key_raw;        // raw JSON of the key (spliced to output)
+    std::string vals_raw;       // raw contents INSIDE the values [ ... ]
+    bool ok = false;
+
+    // 0 = record loaded, 1 = eof, 2 = parse error
+    int advance() {
+        while (std::getline(f, line)) {
+            size_t b = line.find_first_not_of(" \t\r\n");
+            if (b == std::string::npos) continue;       // skip blank lines
+            const char* p = line.c_str();
+            skip_ws(p);
+            if (*p != '[') return 2;
+            ++p;
+            skip_ws(p);
+            const char* kstart = p;
+            key = Key();
+            if (!parse_key(p, key)) return 2;
+            key_raw.assign(kstart, (size_t)(p - kstart));
+            skip_ws(p);
+            if (*p != ',') return 2;
+            ++p;
+            skip_ws(p);
+            if (*p != '[') return 2;
+            const char* vend = span_end(p);
+            if (!vend) return 2;
+            vals_raw.assign(p + 1, (size_t)(vend - p - 2));  // inside [ ]
+            ok = true;
+            return 0;
+        }
+        ok = false;
+        return 1;
+    }
+};
+
+struct HeapCmp {
+    const std::vector<Run*>* runs;
+    bool operator()(int a, int b) const {
+        // std::priority_queue is a max-heap; invert for min-key order
+        return key_cmp((*runs)[a]->key, (*runs)[b]->key) > 0;
+    }
+};
+
+}  // namespace
+
+extern "C" int smerge_files(const char** inputs, int n_inputs,
+                            const char* output) {
+    std::vector<Run*> runs;
+    runs.reserve((size_t)n_inputs);
+    for (int i = 0; i < n_inputs; ++i) {
+        Run* r = new Run();
+        r->f.open(inputs[i]);
+        runs.push_back(r);
+    }
+    int rc = 0;
+    {
+        std::priority_queue<int, std::vector<int>, HeapCmp> heap(
+            HeapCmp{&runs});
+        for (int i = 0; i < n_inputs && rc == 0; ++i) {
+            if (!runs[(size_t)i]->f.is_open()) { rc = 1; break; }
+            int st = runs[(size_t)i]->advance();
+            if (st == 0) heap.push(i);
+            else if (st == 2) rc = 2;
+        }
+        std::ofstream out;
+        if (rc == 0) {
+            out.open(output, std::ios::trunc);
+            if (!out.is_open()) rc = 1;
+        }
+        while (rc == 0 && !heap.empty()) {
+            int first = heap.top();
+            heap.pop();
+            std::vector<int> drained{first};
+            while (!heap.empty() &&
+                   key_cmp(runs[(size_t)heap.top()]->key,
+                           runs[(size_t)first]->key) == 0) {
+                drained.push_back(heap.top());
+                heap.pop();
+            }
+            // concatenate in run-file order (deterministic reduce
+            // inputs, matching core/merge.py's contract)
+            std::sort(drained.begin(), drained.end());
+            std::string merged;
+            for (int j : drained) {
+                if (runs[(size_t)j]->vals_raw.empty()) continue;
+                if (!merged.empty()) merged += ',';
+                merged += runs[(size_t)j]->vals_raw;
+            }
+            out << '[' << runs[(size_t)first]->key_raw << ",[" << merged
+                << "]]\n";
+            for (int j : drained) {
+                int st = runs[(size_t)j]->advance();
+                if (st == 0) heap.push(j);
+                else if (st == 2) { rc = 2; break; }
+            }
+        }
+        if (rc == 0) {
+            out.flush();
+            if (!out.good()) rc = 1;
+        }
+    }
+    for (Run* r : runs) delete r;
+    return rc;
+}

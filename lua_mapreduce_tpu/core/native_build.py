@@ -1,0 +1,67 @@
+"""Shared build/load scaffolding for native C++ components.
+
+One discipline for every native piece (coord/native/jobstore.cpp,
+core/native/shufflemerge.cpp): compile on first use with the host
+toolchain, cache the .so keyed on a SOURCE HASH (git checkout gives
+source and a stale binary identical mtimes, which would mask layout
+changes), load via ctypes, and report failure as None — native code is
+always an optimization with a pure-Python fallback, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+_lock = threading.Lock()
+_cache: Dict[str, Optional[ctypes.CDLL]] = {}   # so_path → lib or None
+
+
+def _src_digest(src: str) -> str:
+    with open(src, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _build(src: str, so: str) -> Optional[str]:
+    digest_file = so + ".src.sha256"
+    digest = _src_digest(src)
+    if os.path.exists(so):
+        try:
+            with open(digest_file) as f:
+                if f.read().strip() == digest:
+                    return so
+        except OSError:
+            pass
+    try:
+        # compile to a tmp name + atomic rename: a concurrent builder in
+        # another process must never load a half-written .so
+        tmp = f"{so}.tmp.{os.getpid()}"
+        subprocess.run(["g++", "-O2", "-fPIC", "-shared", "-o", tmp, src],
+                       check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
+        with open(digest_file, "w") as f:
+            f.write(digest)
+        return so
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def load_native(src: str, so: str) -> Optional[ctypes.CDLL]:
+    """Build (if stale/absent) and load ``src`` as ``so``; None on any
+    failure. Caches per-process: one compile attempt per .so path."""
+    with _lock:
+        if so in _cache:
+            return _cache[so]
+        path = _build(src, so)
+        lib = None
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                lib = None
+        _cache[so] = lib
+        return lib

@@ -1,0 +1,111 @@
+"""ctypes facade over the native C++ shuffle merge.
+
+Build/load discipline is the shared one (core/native_build.py): the
+native path is an OPTIMIZATION of core/merge.py's merge_iterator, never a
+requirement — both produce identical merged groups (tests golden-diff
+them), so a box without g++ just runs the Python heap merge, and ANY
+native failure (including records only the Python parser understands)
+falls back the same way.
+
+The native merge applies when every run file is a local POSIX path (the
+SharedStore backend exposes ``local_path``); other backends keep the
+streaming Python path, exactly how the reference routes gridfs/sshfs
+through different iterators (fs.lua:185-208). Tradeoff: the C++ pass
+materializes the merged partition as one file (written next to the run
+files, same real filesystem — NOT the system tmpfs) before the reduce
+fold starts, buying a single-pass merge at the cost of the Python path's
+record-at-a-time streaming; partitions too big for that are what the
+fallback is for.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import tempfile
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from lua_mapreduce_tpu.core.native_build import load_native
+from lua_mapreduce_tpu.core.serialize import load_record
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "native")
+_SRC = os.path.join(_NATIVE_DIR, "shufflemerge.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libshufflemerge.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    lib = load_native(_SRC, _SO)
+    if lib is not None and not hasattr(lib.smerge_files, "_configured"):
+        lib.smerge_files.restype = ctypes.c_int
+        lib.smerge_files.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_char_p]
+        lib.smerge_files._configured = True
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def merge_paths(paths: Sequence[str], out_path: str) -> None:
+    """Merge sorted run files at local ``paths`` into ``out_path``
+    (equal-key value lists concatenated in run order). Raises on
+    failure."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native shuffle merge unavailable")
+    arr = (ctypes.c_char_p * len(paths))(
+        *[p.encode() for p in paths])
+    rc = lib.smerge_files(arr, len(paths), out_path.encode())
+    if rc == 1:
+        raise OSError(f"native merge I/O error over {list(paths)}")
+    if rc:
+        raise ValueError(f"native merge parse error over {list(paths)}")
+
+
+def native_merge_records(store, filenames: Sequence[str]
+                         ) -> Optional[Iterator[Tuple[object, List[object]]]]:
+    """merge_iterator-compatible stream via the native pass, or ``None``
+    when the native path can't serve these runs — wrong store type, no
+    toolchain, or records the C++ parser rejects (e.g. NaN keys, which
+    json.dumps emits as bare ``NaN``). The merge runs EAGERLY here so
+    every failure mode surfaces as None (caller falls back) rather than
+    as an exception mid-reduce."""
+    local_path = getattr(store, "local_path", None)
+    if local_path is None or not native_available():
+        return None
+    paths = []
+    for name in filenames:
+        p = local_path(name)
+        if not os.path.exists(p):
+            return None
+        paths.append(p)
+
+    out_dir = getattr(store, "path", None) or tempfile.gettempdir()
+    fd, out = tempfile.mkstemp(prefix=".tmp.merge.", suffix=".jsonl",
+                               dir=out_dir)
+    os.close(fd)
+    try:
+        merge_paths(paths, out)
+    except (OSError, ValueError, RuntimeError):
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+        return None
+
+    def stream() -> Iterator[Tuple[object, List[object]]]:
+        try:
+            with open(out) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield load_record(line)
+        finally:
+            try:
+                os.unlink(out)
+            except OSError:
+                pass
+
+    return stream()

@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional
 from lua_mapreduce_tpu.core import tuples
 from lua_mapreduce_tpu.core.constants import MAX_MAP_RESULT
 from lua_mapreduce_tpu.core.merge import merge_iterator
+from lua_mapreduce_tpu.core.native_merge import native_merge_records
 from lua_mapreduce_tpu.core.serialize import (assert_serializable, dump_record,
                                               sorted_keys)
 from lua_mapreduce_tpu.engine.contract import TaskSpec
@@ -126,7 +127,13 @@ def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
     builder = result_store.builder()
     fast = spec.fast_path
     reducefn = spec.reducefn
-    for key, values in merge_iterator(store, run_files):
+    # native C++ single-pass merge when the runs are local files (shared
+    # backend); identical groups to the Python heap merge — golden-diffed
+    # in tests/test_native_merge.py
+    merged = native_merge_records(store, run_files)
+    if merged is None:
+        merged = merge_iterator(store, run_files)
+    for key, values in merged:
         if fast and len(values) == 1:
             reduced = values[0]
         else:

@@ -138,13 +138,19 @@ def transformer_apply(params: Params, tokens, *,
                     functools.partial(attention_reference, causal=True))
 
 
-def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int):
+def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int,
+                   cfg: TransformerConfig):
     """Resolve the sequence-parallel attention body; strict — a typo'd
-    name must fail at factory time, never silently pick an algorithm."""
+    name or an infeasible head split must fail at factory time, never as
+    a shape error deep inside a collective."""
     if attn == "ring":
         return functools.partial(_ring_shard, axis=sp_axis,
                                  n_shards=n_sp, causal=True)
     if attn == "ulysses":
+        if cfg.n_heads % n_sp:
+            raise ValueError(
+                f"ulysses needs n_heads divisible by the {sp_axis} axis: "
+                f"{cfg.n_heads} heads over {n_sp} devices")
         return functools.partial(_ulysses_shard, axis=sp_axis,
                                  n_shards=n_sp, causal=True)
     raise ValueError(f"unknown attn {attn!r} (want 'ring' or 'ulysses')")
@@ -157,7 +163,7 @@ def make_sharded_apply(cfg: TransformerConfig, mesh, *,
     attention sequence-parallel over ``sp``."""
     n_sp = mesh.shape[sp_axis]
 
-    attn_shard = _attn_shard_fn(attn, sp_axis, n_sp)
+    attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
 
     def shard_fwd(params, tokens):
         l_loc = tokens.shape[1]
@@ -189,7 +195,7 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
     P(dp, sp) and the gradient all-reduce (pmean over dp AND sp) fused
     into the backward pass."""
     n_sp = mesh.shape[sp_axis]
-    attn_shard = _attn_shard_fn(attn, sp_axis, n_sp)
+    attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
 
     def shard_step(params, tokens, targets):
         l_loc = tokens.shape[1]

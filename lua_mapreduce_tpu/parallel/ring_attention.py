@@ -92,28 +92,34 @@ def _ring_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
     m = z[..., 0] + _NEG_INF
     l = z[..., 0]
 
-    def step(carry, i):
-        o, m, l, kb, vb = carry
-        src = (my - i) % n_shards                       # whose KV is here
+    def fold(o, m, l, kb, vb, src):
+        """Fold the KV block belonging to global shard ``src``."""
         pos_k = src * l_loc + jnp.arange(l_loc)
         if causal:
             mask = pos_q[:, None] >= pos_k[None, :]     # (Lq, Lk)
         else:
             mask = jnp.ones((l_loc, l_loc), bool)
-        o, m, l = _block_fold(o, m, l, qf, kb.astype(jnp.float32),
-                              vb.astype(jnp.float32), mask, scale)
-        # rotate AFTER folding; the last fold needs no send. ppermute
-        # i→i+1 means we receive from our anticlockwise neighbor, so the
-        # held shard index decreases by one each step.
+        return _block_fold(o, m, l, qf, kb.astype(jnp.float32),
+                           vb.astype(jnp.float32), mask, scale)
+
+    # step 0 folds the LOCAL block before any communication, so the ring
+    # makes exactly n_shards - 1 sends — the final fold needs no rotate
+    o, m, l = fold(o, m, l, k, v, my)
+
+    def step(carry, i):
+        o, m, l, kb, vb = carry
+        # ppermute j→j+1 receives from the anticlockwise neighbor: after
+        # i rotations this device holds the KV of shard (my - i) mod P
         perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
         kb = lax.ppermute(kb, axis, perm)
         vb = lax.ppermute(vb, axis, perm)
+        o, m, l = fold(o, m, l, kb, vb, (my - i) % n_shards)
         return (o, m, l, kb, vb), None
 
     # scan, not fori_loop: the trip count is static and scan supports
     # reverse-mode AD (training needs d(attention)/d(qkv) through the ring)
     (o, m, l, _, _), _ = lax.scan(step, (o, m, l, k, v),
-                                  jnp.arange(n_shards))
+                                  jnp.arange(1, n_shards))
     out = o / jnp.maximum(l, 1e-30)[..., None]          # (B,H,Lq,D)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 

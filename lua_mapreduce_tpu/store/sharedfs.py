@@ -56,6 +56,11 @@ class SharedStore(Store):
         with open(os.path.join(self.path, _encode(name))) as f:
             yield from f
 
+    def local_path(self, name: str) -> str:
+        """POSIX path of ``name`` — lets native code (the C++ shuffle
+        merge) read runs directly instead of through Python iterators."""
+        return os.path.join(self.path, _encode(name))
+
     def list(self, pattern: str) -> List[str]:
         names = []
         for p in _glob.glob(os.path.join(self.path, "*")):

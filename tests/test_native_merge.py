@@ -1,0 +1,135 @@
+"""Native C++ shuffle merge: must produce EXACTLY the Python heap merge's
+groups (the golden-diff contract of core/native_merge.py) across key
+types, and slot transparently into the reduce path."""
+
+import numpy as np
+import pytest
+
+from lua_mapreduce_tpu.core import native_merge
+from lua_mapreduce_tpu.core.merge import merge_iterator
+from lua_mapreduce_tpu.core.serialize import dump_record, sorted_keys
+from lua_mapreduce_tpu.store.sharedfs import SharedStore
+
+pytestmark = pytest.mark.skipif(
+    not native_merge.native_available(),
+    reason="native merge did not build (no g++?)")
+
+
+def _write_run(store, name, records):
+    b = store.builder()
+    for k, vs in records:
+        b.write(dump_record(k, vs) + "\n")
+    b.build(name)
+
+
+def _sorted_run(pairs):
+    keys = sorted_keys([k for k, _ in pairs])
+    d = dict(pairs)
+    return [(k, d[k]) for k in keys]
+
+
+def test_matches_python_merge_mixed_types(tmp_path):
+    store = SharedStore(str(tmp_path))
+    runs = {
+        "r.0": _sorted_run([(False, [1]), (3, [10]), ("apple", [1, 2]),
+                            ((1, "a"), [5]), (None, ["z"])]),
+        "r.1": _sorted_run([(True, [2]), (3, [20]), (3.5, [9]),
+                            ("apple", [3]), ("käse", [7]),
+                            ((1, "a"), [6]), ((1, "a", 0), [8])]),
+        "r.2": _sorted_run([(-2, [0]), ("Zebra", [4]),
+                            ("line\nbreak\t\"q\"", [11])]),
+    }
+    for name, recs in runs.items():
+        _write_run(store, name, recs)
+    names = sorted(runs)
+    want = list(merge_iterator(store, names))
+    got = list(native_merge.native_merge_records(store, names))
+    assert got == want
+
+
+def test_large_fanin_wordcount_shape(tmp_path):
+    """Many runs, overlapping string keys, concatenated value lists."""
+    store = SharedStore(str(tmp_path))
+    rng = np.random.RandomState(0)
+    vocab = [f"w{i:03d}" for i in range(200)]
+    names = []
+    for r in range(16):
+        words = sorted(rng.choice(vocab, size=80, replace=False))
+        _write_run(store, f"run.{r}", [(w, [1] * rng.randint(1, 4))
+                                       for w in words])
+        names.append(f"run.{r}")
+    want = list(merge_iterator(store, names))
+    got = list(native_merge.native_merge_records(store, names))
+    assert got == want
+    assert sum(len(v) for _, v in got) == sum(len(v) for _, v in want)
+
+
+def test_empty_and_blank_runs(tmp_path):
+    store = SharedStore(str(tmp_path))
+    _write_run(store, "a", [("k", [1])])
+    b = store.builder()
+    b.write("\n\n")
+    b.build("blank")
+    b2 = store.builder()
+    b2.build("empty")
+    got = list(native_merge.native_merge_records(
+        store, ["a", "blank", "empty"]))
+    assert got == [("k", [1])]
+
+
+def test_non_local_store_falls_back(tmp_path):
+    from lua_mapreduce_tpu.store.memfs import MemStore
+    assert native_merge.native_merge_records(MemStore(), ["x"]) is None
+
+
+def test_reduce_path_uses_it_end_to_end(tmp_path):
+    """Whole engine run on the shared backend still golden-diffs (the
+    reduce path now routes through the native merge)."""
+    import types, sys
+    mod = types.ModuleType("nm_wc")
+    corpus = {"d1": "a b a c", "d2": "b a"}
+    mod.taskfn = lambda emit: [emit(k, v) for k, v in corpus.items()]
+    def mapfn(key, value, emit):
+        for w in value.split():
+            emit(w, 1)
+    mod.mapfn = mapfn
+    mod.partitionfn = lambda key: sum(key.encode()) % 3
+    def reducefn(key, values):
+        return sum(values)
+    reducefn.associative_reducer = True
+    reducefn.commutative_reducer = True
+    mod.reducefn = reducefn
+    sys.modules["nm_wc"] = mod
+
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.local import LocalExecutor
+    spec = TaskSpec(taskfn="nm_wc", mapfn="nm_wc", partitionfn="nm_wc",
+                    reducefn="nm_wc", storage=f"shared:{tmp_path}/spill")
+    ex = LocalExecutor(spec)
+    ex.run()
+    out = {k: v[0] for k, v in ex.results()}
+    assert out == {"a": 3, "b": 2, "c": 1}
+
+
+def test_bigint_keys_stay_distinct(tmp_path):
+    """Keys beyond double precision must not merge (exact digit-string
+    comparison, matching Python's arbitrary-precision ints)."""
+    store = SharedStore(str(tmp_path))
+    big = 2 ** 64
+    _write_run(store, "a", [(big, [1])])
+    _write_run(store, "b", [(big + 1, [2]), (-big - 1, [3])])
+    names = ["a", "b"]
+    want = list(merge_iterator(store, names))
+    got = list(native_merge.native_merge_records(store, names))
+    assert got == want
+    assert len(got) == 3
+
+
+def test_unparseable_records_fall_back(tmp_path):
+    """NaN keys parse on the Python path but not in C++ — the native
+    wrapper must return None (fallback), not raise mid-reduce."""
+    store = SharedStore(str(tmp_path))
+    b = store.builder()
+    b.write('[NaN,[1]]\n')
+    b.build("nan_run")
+    assert native_merge.native_merge_records(store, ["nan_run"]) is None
