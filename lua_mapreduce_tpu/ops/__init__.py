@@ -35,9 +35,10 @@ from lua_mapreduce_tpu.ops.matmul import matmul  # noqa: E402
 from lua_mapreduce_tpu.ops.softmax import log_softmax, softmax  # noqa: E402
 from lua_mapreduce_tpu.ops.conv import conv2d  # noqa: E402
 from lua_mapreduce_tpu.ops.pool import avgpool2d, maxpool2d  # noqa: E402
+from lua_mapreduce_tpu.ops.attention import flash_attention  # noqa: E402
 
 __all__ = [
     "default_backend", "resolve_backend",
     "matmul", "log_softmax", "softmax", "conv2d",
-    "maxpool2d", "avgpool2d",
+    "maxpool2d", "avgpool2d", "flash_attention",
 ]

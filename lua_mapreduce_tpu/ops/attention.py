@@ -1,0 +1,198 @@
+"""Fused flash attention — MXU matmuls with an online softmax in VMEM.
+
+Completes the kernel library (SURVEY.md §2.4's APRIL-ANN-kernel role) for
+the transformer family: one `pallas_call` computes softmax(QKᵀ·scale)·V
+without ever materializing the (L, L) score matrix in HBM — scores live
+in VMEM one (block_q, block_k) tile at a time, folded into running
+(max, denominator, output) accumulators in f32 scratch. This is the
+single-device form of the SAME online-softmax fold the ring schedule runs
+across chips (parallel/ring_attention.py::_block_fold): ring = flash with
+the KV loop distributed over ICI.
+
+Grid: (batch·heads, q-blocks, kv-blocks); the kv axis is the innermost
+(sequential) dimension, accumulating into scratch and writing the
+normalized output tile on its last step — the accumulator discipline of
+ops/matmul.py. Causal masking compares global row/column indices built
+from the program ids; padded tail rows/columns are masked the same way.
+
+Backward: Pallas calls carry no JVP; the custom VJP differentiates the
+XLA reference (O(L²) memory — fine at the L this kernel targets for
+training on one chip; gradient-heavy long-context training should use the
+ring form, whose backward is blockwise by construction).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lua_mapreduce_tpu.ops import resolve_backend
+
+_NEG_INF = -1e30
+
+
+def _attn_reference_xla(q, k, v, causal: bool, scale: float):
+    s = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((lq, lk), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhlm,bmhd->blhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, causal: bool, seq_len: int,
+                  block_q: int, block_k: int, n_kv: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def fold():
+        q = q_ref[0].astype(jnp.float32)                # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+
+        # global positions: mask padded tail columns always, the upper
+        # triangle when causal (padded q rows give garbage, sliced off)
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < seq_len
+        if causal:
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_scr[:]                               # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip kv blocks entirely above the diagonal — their scores are
+        # wholly masked, so folding them is pure wasted MXU time (~2x for
+        # long sequences)
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(fold)
+    else:
+        fold()
+
+    @pl.when(ki == n_kv - 1)
+    def _():
+        o_ref[0] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
+                  interpret=False):
+    b, l, h, d = q.shape
+    scale = 1.0 / float(d) ** 0.5
+    # (B, L, H, D) → (B·H, L, D): one grid row per (batch, head)
+    def to_bh(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    block_q = min(block_q, max(8, -(-l // 8) * 8))
+    block_k = min(block_k, max(128, -(-l // 128) * 128))
+    pl_q = -l % block_q
+    pl_k = -l % block_k
+    if pl_q:
+        qb = jnp.pad(qb, ((0, 0), (0, pl_q), (0, 0)))
+    if pl_k:
+        kb = jnp.pad(kb, ((0, 0), (0, pl_k), (0, 0)))
+        vb = jnp.pad(vb, ((0, 0), (0, pl_k), (0, 0)))
+    n_q = qb.shape[1] // block_q
+    n_kv = kb.shape[1] // block_k
+
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, causal=causal,
+                          seq_len=l, block_q=block_q, block_k=block_k,
+                          n_kv=n_kv),
+        grid=(b * h, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),      # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),      # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),      # running output
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+
+    out = out[:, :l, :].reshape(b, h, l, d)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_p(q, k, v, cfg):
+    causal, block_q, block_k, interpret = cfg
+    return _flash_pallas(q, k, v, causal, block_q=block_q,
+                         block_k=block_k, interpret=interpret)
+
+
+def _flash_fwd(q, k, v, cfg):
+    return _flash_p(q, k, v, cfg), (q, k, v)
+
+
+def _flash_bwd(cfg, res, g):
+    causal = cfg[0]
+    q, k, v = res
+    scale = 1.0 / float(q.shape[-1]) ** 0.5
+    _, vjp = jax.vjp(
+        lambda q, k, v: _attn_reference_xla(q, k, v, causal, scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_p.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    backend: str = "auto", block_q: int = 128,
+                    block_k: int = 128):
+    """Exact softmax attention, (B, L, H, D) → (B, L, H, D).
+
+    ``backend="pallas"``/``"pallas_interpret"`` runs the fused VMEM
+    kernel; ``"xla"`` is the reference composition (correctness oracle,
+    non-TPU platforms)."""
+    backend = resolve_backend(backend)
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} "
+                         f"{v.shape}")
+    if backend == "xla":
+        scale = 1.0 / float(q.shape[-1]) ** 0.5
+        return _attn_reference_xla(q, k, v, causal, scale)
+    return _flash_p(q, k, v,
+                    (causal, block_q, block_k,
+                     backend == "pallas_interpret"))

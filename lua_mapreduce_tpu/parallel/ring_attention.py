@@ -40,21 +40,16 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from lua_mapreduce_tpu.ops.attention import flash_attention
+
 _NEG_INF = -1e30      # finite mask fill: -inf breaks the m-subtraction
 
 
 def attention_reference(q, k, v, *, causal: bool = False):
-    """Single-device softmax attention oracle, (B, L, H, D) layout."""
-    scale = 1.0 / jnp.sqrt(q.shape[-1])
-    s = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    if causal:
-        lq, lk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((lq, lk), bool))
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhlm,bmhd->blhd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    """Single-device softmax attention oracle, (B, L, H, D) layout —
+    ONE oracle for the whole framework (delegates to the kernel
+    library's XLA reference so the two can never diverge)."""
+    return flash_attention(q, k, v, causal=causal, backend="xla")
 
 
 def _block_fold(o, m, l, q, k, v, mask, scale):
@@ -166,7 +161,10 @@ def _ulysses_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = attention_reference(qh, kh, vh, causal=causal)
+    # the device-local full-sequence attention is where the fused Pallas
+    # kernel applies (backend="auto": flash kernel on TPU, the identical
+    # XLA composition elsewhere)
+    out = flash_attention(qh, kh, vh, causal=causal, backend="auto")
     return heads_to_seq(out)
 
 
