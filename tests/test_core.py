@@ -70,3 +70,16 @@ def test_merge_many_files_interleaved():
            {k: sorted(v) for k, v in expected.items()}
     # keys come out in sorted order
     assert list(merged) == sorted(merged)
+
+
+def test_sorted_keys_fast_path_matches_key_lt():
+    """The canonical-form sort must equal an exact key_lt comparator
+    sort for every key shape, including bool-vs-int inside tuples."""
+    import functools
+    from lua_mapreduce_tpu.core.serialize import key_lt, sorted_keys
+    keys = [3, 1.5, "b", "a", True, False, None, (1, "a"), ("b",), (True, 2),
+            (0, "x"), (2, 1), (1, "a", 0), 2, -7, "z", (False,), (),
+            b"b", b"a"]      # rank-5 keys drive the exact-comparator fallback
+    want = sorted(keys, key=functools.cmp_to_key(
+        lambda a, b: -1 if key_lt(a, b) else (1 if key_lt(b, a) else 0)))
+    assert sorted_keys(keys) == want
