@@ -119,11 +119,14 @@ def _check_seq(global_len: int, cfg: TransformerConfig) -> None:
 
 
 def _forward(params: Params, tokens, pos, cfg: TransformerConfig,
-             attn_fn):
-    """Shared body: tokens (B, L) int32, pos (L,) global positions."""
+             attn_fn, block=None):
+    """Shared body: tokens (B, L) int32, pos (L,) global positions;
+    ``block`` swaps the decoder-block implementation (the 3-D form
+    passes its tensor-parallel block) — one forward for every path."""
+    block = block or _block
     x = params["tok_emb"][tokens] + params["pos_emb"][pos]
     for i in range(cfg.n_layers):
-        x = _block(params, i, x, cfg, attn_fn)
+        x = block(params, i, x, cfg, attn_fn)
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
     return x @ params["tok_emb"].T                      # tied head
 
@@ -139,18 +142,21 @@ def transformer_apply(params: Params, tokens, *,
 
 
 def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int,
-                   cfg: TransformerConfig):
+                   cfg: TransformerConfig, n_heads: Optional[int] = None):
     """Resolve the sequence-parallel attention body; strict — a typo'd
     name or an infeasible head split must fail at factory time, never as
-    a shape error deep inside a collective."""
+    a shape error deep inside a collective. ``n_heads`` overrides the
+    head count the divisibility check sees (the 3-D form passes its
+    per-tp-slice count)."""
+    n_heads = cfg.n_heads if n_heads is None else n_heads
     if attn == "ring":
         return functools.partial(_ring_shard, axis=sp_axis,
                                  n_shards=n_sp, causal=True)
     if attn == "ulysses":
-        if cfg.n_heads % n_sp:
+        if n_heads % n_sp:
             raise ValueError(
                 f"ulysses needs n_heads divisible by the {sp_axis} axis: "
-                f"{cfg.n_heads} heads over {n_sp} devices")
+                f"{n_heads} heads over {n_sp} devices")
         return functools.partial(_ulysses_shard, axis=sp_axis,
                                  n_shards=n_sp, causal=True)
     raise ValueError(f"unknown attn {attn!r} (want 'ring' or 'ulysses')")
@@ -177,11 +183,11 @@ def make_sharded_apply(cfg: TransformerConfig, mesh, *,
     return jax.jit(fn)
 
 
-def lm_loss_local(params, tokens, targets, cfg, attn_fn, pos):
+def lm_loss_local(params, tokens, targets, cfg, attn_fn, pos, block=None):
     """Mean next-token NLL on this device's tile (targets pre-shifted by
     the caller — with a sharded sequence the shift crosses shard edges,
     so it happens host-side before sharding)."""
-    logits = _forward(params, tokens, pos, cfg, attn_fn)
+    logits = _forward(params, tokens, pos, cfg, attn_fn, block=block)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
@@ -228,3 +234,137 @@ def shard_batch(mesh, tokens, targets, dp_axis="dp", sp_axis="sp"):
     sharding = NamedSharding(mesh, P(dp_axis, sp_axis))
     return (jax.device_put(tokens, sharding),
             jax.device_put(targets, sharding))
+
+
+# ---------------------------------------------------------------------------
+# 3-D parallel form: data x sequence x tensor (Megatron-style tp)
+# ---------------------------------------------------------------------------
+#
+# Attention heads and MLP hidden units shard over ``mp``; activations stay
+# replicated across mp at block boundaries via one psum after the
+# attention out-projection and one after the second MLP matmul (the
+# Megatron pattern). Composes with the sp ring: each mp slice runs the
+# ring over ITS heads. Gradient flow needs no hand-written collectives —
+# the loss is pmean'd over the data axes (dp, sp) ONLY; shard_map's
+# transpose machinery then psums replicated-param cotangents over every
+# axis they were broadcast to (including mp), while mp-sharded params
+# keep their local slice gradients.
+#
+# tp weights use head-structured layouts so a PartitionSpec can split
+# them per head rather than per raw column: qkv (d, 3, H, hd) sharded on
+# H; out-proj (H, hd, d) sharded on H; MLP (d, ff)/(ff, d) sharded on ff.
+
+def param_specs_3d(mp_axis: str = "mp") -> Dict[str, object]:
+    """PartitionSpec per parameter-name PATTERN (suffix match)."""
+    return {
+        "_qkv_W": P(None, None, mp_axis, None),
+        "_out_W": P(mp_axis, None, None),
+        "_ff1_W": P(None, mp_axis),
+        "_ff1_b": P(mp_axis),
+        "_ff2_W": P(mp_axis, None),
+    }
+
+
+def _spec_for(name: str, specs: Dict[str, object]):
+    for suffix, spec in specs.items():
+        if name.endswith(suffix):
+            return spec
+    return P()
+
+
+def shard_params_3d(params: Params, mesh, cfg: TransformerConfig, *,
+                    mp_axis: str = "mp") -> Params:
+    """Reshape tp weights to head-structured layouts and device_put every
+    param with its 3-D sharding (inverse: :func:`unshard_params_3d`)."""
+    d, h = cfg.d_model, cfg.n_heads
+    hd = d // h
+    specs = param_specs_3d(mp_axis)
+    out: Params = {}
+    for name, w in params.items():
+        if name.endswith("_qkv_W"):
+            w = w.reshape(d, 3, h, hd)
+        elif name.endswith("_out_W"):
+            w = w.reshape(h, hd, d)
+        out[name] = jax.device_put(
+            w, NamedSharding(mesh, _spec_for(name, specs)))
+    return out
+
+
+def unshard_params_3d(params: Params, cfg: TransformerConfig) -> Params:
+    """Back to the canonical 2-D layouts (for checkpoints / the oracle)."""
+    d = cfg.d_model
+    out: Params = {}
+    for name, w in params.items():
+        if name.endswith("_qkv_W"):
+            w = jnp.asarray(w).reshape(d, 3 * d)
+        elif name.endswith("_out_W"):
+            w = jnp.asarray(w).reshape(d, d)
+        out[name] = w
+    return out
+
+
+def _block_tp(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
+              mp_axis: str):
+    """One decoder block on LOCAL tp slices; x enters and leaves
+    replicated across mp."""
+    p = f"L{i}"
+    y = _layer_norm(x, params[f"{p}_ln1_g"], params[f"{p}_ln1_b"])
+    w_qkv = params[f"{p}_qkv_W"]                # (d, 3, H/mp, hd) local
+    q, k, v = (jnp.einsum("bld,dhk->blhk", y, w_qkv[:, t])
+               for t in range(3))               # (B, L, H/mp, hd)
+    a = attn_fn(q, k, v)                        # this mp slice's heads
+    partial = jnp.einsum("blhk,hkd->bld", a, params[f"{p}_out_W"])
+    x = x + lax.psum(partial, mp_axis)          # Megatron sync point 1
+    y = _layer_norm(x, params[f"{p}_ln2_g"], params[f"{p}_ln2_b"])
+    y = jax.nn.gelu(y @ params[f"{p}_ff1_W"] + params[f"{p}_ff1_b"])
+    partial = y @ params[f"{p}_ff2_W"]
+    return x + lax.psum(partial, mp_axis) + params[f"{p}_ff2_b"]
+
+
+def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
+                       attn: str = "ring", dp_axis: str = "dp",
+                       sp_axis: str = "sp", mp_axis: str = "mp"):
+    """Jitted LM train step over a (dp, sp, mp) mesh. ``params`` must
+    come from :func:`shard_params_3d`; tokens/targets are P(dp, sp)."""
+    n_sp = mesh.shape[sp_axis]
+    n_mp = mesh.shape[mp_axis]
+    if cfg.n_heads % n_mp:
+        raise ValueError(f"n_heads={cfg.n_heads} not divisible by "
+                         f"{mp_axis}={n_mp}")
+    # the ulysses divisibility check sees the PER-TP-SLICE head count
+    attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg,
+                                n_heads=cfg.n_heads // n_mp)
+    tp_block = functools.partial(_block_tp, mp_axis=mp_axis)
+    specs = param_specs_3d(mp_axis)
+
+    def shard_step(params, tokens, targets):
+        l_loc = tokens.shape[1]
+        _check_seq(l_loc * n_sp, cfg)
+        pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
+
+        def global_loss(p):
+            local = lm_loss_local(p, tokens, targets, cfg, attn_shard,
+                                  pos, block=tp_block)
+            # pmean over the DATA axes only: the mp axis carries the
+            # same loss replicated, and omitting it keeps the
+            # backward-pass psum of replicated-param cotangents at the
+            # right scale (sum of per-slice contributions, unscaled)
+            return lax.pmean(lax.pmean(local, sp_axis), dp_axis)
+
+        return jax.value_and_grad(global_loss)(params)
+
+    def specs_tree(params_like):
+        return {k: _spec_for(k, specs) for k in params_like}
+
+    def step(params, opt_state, tokens, targets):
+        mapped = jax.shard_map(
+            shard_step, mesh=mesh,
+            in_specs=(specs_tree(params), P(dp_axis, sp_axis),
+                      P(dp_axis, sp_axis)),
+            out_specs=(P(), specs_tree(params)))
+        loss, grads = mapped(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))

@@ -101,3 +101,70 @@ def test_unknown_attn_rejected_at_factory_time(mesh, cfg):
         tfm.make_train_step(cfg, mesh, optax.sgd(0.1), attn="rign")
     with pytest.raises(ValueError, match="unknown attn"):
         tfm.make_sharded_apply(cfg, mesh, attn="flash")
+
+
+class Test3D:
+    """dp x sp x mp (tensor-parallel) form vs the 2-D and oracle paths."""
+
+    @pytest.fixture(scope="class")
+    def mesh3(self):
+        return jax.sharding.Mesh(
+            np.array(jax.devices("cpu")[:8]).reshape(2, 2, 2),
+            ("dp", "sp", "mp"))
+
+    def test_one_step_matches_2d_path(self, mesh3, cfg):
+        """Same data, same init: one SGD step through the 3-D tp form
+        must produce the same params as the 2-D (dp, sp) form."""
+        rng = np.random.RandomState(0)
+        b, l = 4, 32
+        seq = rng.randint(0, cfg.vocab, (b, l + 1))
+        tokens = jnp.asarray(seq[:, :-1], jnp.int32)
+        targets = jnp.asarray(seq[:, 1:], jnp.int32)
+
+        mesh2 = make_mesh(dp=4, mp=2, devices=jax.devices("cpu")[:8],
+                          axis_names=("dp", "sp"))
+        opt = optax.sgd(0.1)
+        params0 = tfm.init_transformer(jax.random.PRNGKey(7), cfg)
+
+        step2 = tfm.make_train_step(cfg, mesh2, opt, attn="ring")
+        p2 = jax.tree.map(lambda x: jnp.array(x, copy=True), params0)
+        p2, _, loss2 = step2(p2, opt.init(p2),
+                             *tfm.shard_batch(mesh2, tokens, targets))
+
+        step3 = tfm.make_train_step_3d(cfg, mesh3, opt, attn="ring")
+        p3 = tfm.shard_params_3d(params0, mesh3, cfg)
+        p3, _, loss3 = step3(p3, opt.init(p3),
+                             *tfm.shard_batch(mesh3, tokens, targets))
+        p3 = tfm.unshard_params_3d(p3, cfg)
+
+        np.testing.assert_allclose(float(loss3), float(loss2), rtol=1e-5)
+        for k in p2:
+            np.testing.assert_allclose(
+                np.asarray(p3[k]), np.asarray(p2[k]), rtol=2e-4,
+                atol=2e-4, err_msg=k)
+
+    def test_3d_training_learns(self, mesh3, cfg):
+        rng = np.random.RandomState(1)
+        b, l = 8, 32
+        start = rng.randint(0, cfg.vocab, (b, 1))
+        seq = (start + np.arange(l + 1)) % cfg.vocab
+        tokens = jnp.asarray(seq[:, :-1], jnp.int32)
+        targets = jnp.asarray(seq[:, 1:], jnp.int32)
+        opt = optax.adam(3e-3)
+        params = tfm.shard_params_3d(
+            tfm.init_transformer(jax.random.PRNGKey(2), cfg), mesh3, cfg)
+        step = tfm.make_train_step_3d(cfg, mesh3, opt, attn="ring")
+        st = opt.init(params)
+        td = tfm.shard_batch(mesh3, tokens, targets)
+        first = None
+        for _ in range(50):
+            params, st, loss = step(params, st, *td)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first / 3, (first, float(loss))
+
+    def test_rejects_indivisible_heads(self, mesh3):
+        bad = tfm.TransformerConfig(vocab=32, d_model=32, n_heads=3,
+                                    n_layers=1, d_ff=32, max_seq=64)
+        with pytest.raises(ValueError, match="not divisible"):
+            tfm.make_train_step_3d(bad, mesh3, optax.sgd(0.1))
