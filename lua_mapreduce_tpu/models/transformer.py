@@ -34,6 +34,7 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from lua_mapreduce_tpu.parallel import moe as _moe
 from lua_mapreduce_tpu.parallel.ring_attention import (
     _ring_shard, _ulysses_shard, attention_reference)
 
@@ -48,6 +49,14 @@ class TransformerConfig:
     n_layers: int = 2
     d_ff: int = 512
     max_seq: int = 512
+    # mixture-of-experts: >0 replaces every block's dense FFN with a
+    # switch-routed expert FFN (parallel/moe.py); 0 = dense. capacity is
+    # REQUIRED with experts and is per routing group (the device tile in
+    # sharded runs, the whole batch in the oracle) — an auto-derived
+    # default would differ between the two and break their golden-diff.
+    moe_experts: int = 0
+    moe_capacity: int = 0
+    moe_aux_weight: float = 0.01
 
     @staticmethod
     def tiny() -> "TransformerConfig":
@@ -55,11 +64,22 @@ class TransformerConfig:
                                  n_layers=2, d_ff=64, max_seq=128)
 
 
+def _check_moe(cfg: TransformerConfig, n_ep: Optional[int] = None) -> None:
+    if cfg.moe_experts and cfg.moe_capacity <= 0:
+        raise ValueError(
+            "moe_experts > 0 requires an explicit moe_capacity (it is "
+            "per routing group; see TransformerConfig)")
+    if n_ep is not None and cfg.moe_experts % n_ep:
+        raise ValueError(f"moe_experts={cfg.moe_experts} not divisible "
+                         f"by the expert-parallel axis size {n_ep}")
+
+
 def init_transformer(key, cfg: TransformerConfig = TransformerConfig(),
                      dtype=jnp.float32) -> Params:
     """Flat params: tok/pos embeddings, per layer fused qkv + out proj +
     2-layer MLP + 2 layernorms, final layernorm; the LM head is tied to
     the token embedding (standard weight tying)."""
+    _check_moe(cfg)
     d, ff = cfg.d_model, cfg.d_ff
     params: Params = {}
     keys = iter(jax.random.split(key, 2 + 4 * cfg.n_layers))
@@ -73,12 +93,17 @@ def init_transformer(key, cfg: TransformerConfig = TransformerConfig(),
             next(keys), (d, 3 * d), dtype) / np.sqrt(d)
         params[f"{p}_out_W"] = jax.random.normal(
             next(keys), (d, d), dtype) / np.sqrt(d)
-        params[f"{p}_ff1_W"] = jax.random.normal(
-            next(keys), (d, ff), dtype) / np.sqrt(d)
-        params[f"{p}_ff1_b"] = jnp.zeros((ff,), dtype)
-        params[f"{p}_ff2_W"] = jax.random.normal(
-            next(keys), (ff, d), dtype) / np.sqrt(ff)
-        params[f"{p}_ff2_b"] = jnp.zeros((d,), dtype)
+        if cfg.moe_experts:
+            params.update(_moe.init_moe(
+                next(keys), d, ff, cfg.moe_experts, dtype,
+                prefix=f"{p}_moe"))
+        else:
+            params[f"{p}_ff1_W"] = jax.random.normal(
+                next(keys), (d, ff), dtype) / np.sqrt(d)
+            params[f"{p}_ff1_b"] = jnp.zeros((ff,), dtype)
+            params[f"{p}_ff2_W"] = jax.random.normal(
+                next(keys), (ff, d), dtype) / np.sqrt(ff)
+            params[f"{p}_ff2_b"] = jnp.zeros((d,), dtype)
         for ln in ("ln1", "ln2"):
             params[f"{p}_{ln}_g"] = jnp.ones((d,), dtype)
             params[f"{p}_{ln}_b"] = jnp.zeros((d,), dtype)
@@ -93,9 +118,32 @@ def _layer_norm(x, g, b, eps=1e-5):
     return (x - mu) * lax.rsqrt(var + eps) * g + b
 
 
-def _block(params: Params, i: int, x, cfg: TransformerConfig, attn_fn):
+def _ffn(params: Params, p: str, y, cfg: TransformerConfig,
+         moe_axis: Optional[str]):
+    """The block's FFN: dense, or switch-MoE when cfg.moe_experts > 0
+    (expert-parallel over ``moe_axis`` inside shard_map, single-device
+    reference routing when ``moe_axis`` is None). Returns (out, aux)."""
+    if not cfg.moe_experts:
+        h = jax.nn.gelu(y @ params[f"{p}_ff1_W"] + params[f"{p}_ff1_b"])
+        return h @ params[f"{p}_ff2_W"] + params[f"{p}_ff2_b"], 0.0
+    b, l, d = y.shape
+    t = b * l
+    cap = cfg.moe_capacity
+    flat = y.reshape(t, d)
+    if moe_axis is None:
+        out, aux = _moe.moe_ffn_reference(params, flat, capacity=cap,
+                                          prefix=f"{p}_moe")
+    else:
+        out, aux = _moe.moe_ffn_shard(params, flat, capacity=cap,
+                                      ep_axis=moe_axis,
+                                      prefix=f"{p}_moe")
+    return out.reshape(b, l, d), aux
+
+
+def _block(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
+           moe_axis: Optional[str] = None):
     """One pre-LN decoder block; ``attn_fn(q, k, v) -> out`` supplies the
-    (possibly sequence-parallel) attention."""
+    (possibly sequence-parallel) attention. Returns (x, moe_aux)."""
     p = f"L{i}"
     b, l, d = x.shape
     h, hd = cfg.n_heads, d // cfg.n_heads
@@ -106,8 +154,8 @@ def _block(params: Params, i: int, x, cfg: TransformerConfig, attn_fn):
     a = attn_fn(q, k, v).reshape(b, l, d)
     x = x + a @ params[f"{p}_out_W"]
     y = _layer_norm(x, params[f"{p}_ln2_g"], params[f"{p}_ln2_b"])
-    y = jax.nn.gelu(y @ params[f"{p}_ff1_W"] + params[f"{p}_ff1_b"])
-    return x + y @ params[f"{p}_ff2_W"] + params[f"{p}_ff2_b"]
+    out, aux = _ffn(params, p, y, cfg, moe_axis)
+    return x + out, aux
 
 
 def _check_seq(global_len: int, cfg: TransformerConfig) -> None:
@@ -122,13 +170,16 @@ def _forward(params: Params, tokens, pos, cfg: TransformerConfig,
              attn_fn, block=None):
     """Shared body: tokens (B, L) int32, pos (L,) global positions;
     ``block`` swaps the decoder-block implementation (the 3-D form
-    passes its tensor-parallel block) — one forward for every path."""
+    passes its tensor-parallel block) — one forward for every path.
+    Returns (logits, summed moe aux loss; 0.0 for dense blocks)."""
     block = block or _block
     x = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    aux_total = 0.0
     for i in range(cfg.n_layers):
-        x = block(params, i, x, cfg, attn_fn)
+        x, aux = block(params, i, x, cfg, attn_fn)
+        aux_total = aux_total + aux
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
-    return x @ params["tok_emb"].T                      # tied head
+    return x @ params["tok_emb"].T, aux_total           # tied head
 
 
 def transformer_apply(params: Params, tokens, *,
@@ -137,8 +188,10 @@ def transformer_apply(params: Params, tokens, *,
     """Single-device oracle: (B, L) tokens → (B, L, vocab) logits."""
     _check_seq(tokens.shape[1], cfg)
     pos = jnp.arange(tokens.shape[1])
-    return _forward(params, tokens, pos, cfg,
-                    functools.partial(attention_reference, causal=True))
+    logits, _ = _forward(params, tokens, pos, cfg,
+                         functools.partial(attention_reference,
+                                           causal=True))
+    return logits
 
 
 def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int,
@@ -165,32 +218,64 @@ def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int,
 def make_sharded_apply(cfg: TransformerConfig, mesh, *,
                        attn: str = "ring", dp_axis: str = "dp",
                        sp_axis: str = "sp"):
-    """Jitted forward over the mesh: tokens P(dp, sp), params replicated,
-    attention sequence-parallel over ``sp``."""
+    """Jitted forward over the mesh: tokens P(dp, sp), attention
+    sequence-parallel over ``sp``. Dense params are replicated; with
+    ``cfg.moe_experts`` > 0 the expert stacks shard over dp and params
+    must come from :func:`shard_params_moe`."""
     n_sp = mesh.shape[sp_axis]
-
     attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
+    moe_axis = dp_axis if cfg.moe_experts else None
+    if cfg.moe_experts:
+        _check_moe(cfg, mesh.shape[dp_axis])
+    block = functools.partial(_block, moe_axis=moe_axis)
+    suffix = param_specs_moe(dp_axis)
 
     def shard_fwd(params, tokens):
         l_loc = tokens.shape[1]
         _check_seq(l_loc * n_sp, cfg)
         pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
-        return _forward(params, tokens, pos, cfg, attn_shard)
+        return _forward(params, tokens, pos, cfg, attn_shard,
+                        block=block)[0]
 
-    fn = jax.shard_map(shard_fwd, mesh=mesh,
-                       in_specs=(P(), P(dp_axis, sp_axis)),
-                       out_specs=P(dp_axis, sp_axis))
-    return jax.jit(fn)
+    def apply(params, tokens):
+        # specs derive from the ACTUAL param keys so the tree can never
+        # drift from init_transformer's key set
+        specs = {k: _spec_for(k, suffix) for k in params} \
+            if cfg.moe_experts else P()
+        fn = jax.shard_map(shard_fwd, mesh=mesh,
+                           in_specs=(specs, P(dp_axis, sp_axis)),
+                           out_specs=P(dp_axis, sp_axis))
+        return fn(params, tokens)
+
+    return jax.jit(apply)
 
 
 def lm_loss_local(params, tokens, targets, cfg, attn_fn, pos, block=None):
-    """Mean next-token NLL on this device's tile (targets pre-shifted by
-    the caller — with a sharded sequence the shift crosses shard edges,
-    so it happens host-side before sharding)."""
-    logits = _forward(params, tokens, pos, cfg, attn_fn, block=block)
+    """Mean next-token NLL (+ weighted MoE aux loss) on this device's
+    tile (targets pre-shifted by the caller — with a sharded sequence
+    the shift crosses shard edges, so it happens host-side before
+    sharding)."""
+    logits, aux = _forward(params, tokens, pos, cfg, attn_fn, block=block)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+
+
+def param_specs_moe(ep_axis: str = "dp") -> Dict[str, object]:
+    """Suffix→PartitionSpec for expert-parallel params: expert FFN
+    stacks shard on their leading experts axis; the router replicates."""
+    return {
+        "_moe_w1": P(ep_axis), "_moe_b1": P(ep_axis),
+        "_moe_w2": P(ep_axis), "_moe_b2": P(ep_axis),
+    }
+
+
+def shard_params_moe(params: Params, mesh, *, ep_axis: str = "dp"
+                     ) -> Params:
+    """device_put params with expert stacks sharded over ``ep_axis``."""
+    specs = param_specs_moe(ep_axis)
+    return {k: jax.device_put(v, NamedSharding(mesh, _spec_for(k, specs)))
+            for k, v in params.items()}
 
 
 def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
@@ -199,9 +284,20 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
     """Jitted SPMD LM train step: ``step(params, opt_state, tokens,
     targets) -> (params, opt_state, loss)`` with tokens/targets sharded
     P(dp, sp) and the gradient all-reduce (pmean over dp AND sp) fused
-    into the backward pass."""
+    into the backward pass.
+
+    With ``cfg.moe_experts`` > 0 the block FFNs are switch-MoE with
+    experts sharded over the dp axis (the standard ep ≡ dp grouping:
+    expert buckets ride all_to_all between data-parallel peers); params
+    must then come from :func:`shard_params_moe`."""
     n_sp = mesh.shape[sp_axis]
     attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
+    moe_axis = None
+    if cfg.moe_experts:
+        _check_moe(cfg, mesh.shape[dp_axis])
+        moe_axis = dp_axis
+    block = functools.partial(_block, moe_axis=moe_axis)
+    suffix = param_specs_moe(dp_axis)
 
     def shard_step(params, tokens, targets):
         l_loc = tokens.shape[1]
@@ -210,17 +306,20 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
 
         def global_loss(p):
             local = lm_loss_local(p, tokens, targets, cfg, attn_shard,
-                                  pos)
+                                  pos, block=block)
             return lax.pmean(lax.pmean(local, sp_axis), dp_axis)
 
         return jax.value_and_grad(global_loss)(params)
 
-    mapped = jax.shard_map(
-        shard_step, mesh=mesh,
-        in_specs=(P(), P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
-        out_specs=(P(), P()))
-
     def step(params, opt_state, tokens, targets):
+        # specs derive from the ACTUAL param keys (cannot drift from
+        # init_transformer; same pattern as the 3-D step)
+        specs = {k: _spec_for(k, suffix) for k in params} \
+            if cfg.moe_experts else P()
+        mapped = jax.shard_map(
+            shard_step, mesh=mesh,
+            in_specs=(specs, P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
+            out_specs=(P(), specs))
         loss, grads = mapped(params, tokens, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -318,7 +417,7 @@ def _block_tp(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
     y = _layer_norm(x, params[f"{p}_ln2_g"], params[f"{p}_ln2_b"])
     y = jax.nn.gelu(y @ params[f"{p}_ff1_W"] + params[f"{p}_ff1_b"])
     partial = y @ params[f"{p}_ff2_W"]
-    return x + lax.psum(partial, mp_axis) + params[f"{p}_ff2_b"]
+    return x + lax.psum(partial, mp_axis) + params[f"{p}_ff2_b"], 0.0
 
 
 def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
@@ -331,6 +430,9 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
     if cfg.n_heads % n_mp:
         raise ValueError(f"n_heads={cfg.n_heads} not divisible by "
                          f"{mp_axis}={n_mp}")
+    if cfg.moe_experts:
+        raise ValueError("MoE blocks are not supported on the 3-D tp "
+                         "path; use make_train_step (experts over dp)")
     # the ulysses divisibility check sees the PER-TP-SLICE head count
     attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg,
                                 n_heads=cfg.n_heads // n_mp)

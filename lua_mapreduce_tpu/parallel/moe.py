@@ -1,0 +1,134 @@
+"""Expert parallelism: switch-style mixture-of-experts with all_to_all
+token routing.
+
+The third shuffle topology of the framework (after the keyed psum and the
+partitionfn-bucketed all_to_all of parallel/tpu_engine.py): the ROUTER is
+a learned partitionfn — each token picks an expert, tokens are bucketed
+per expert under a fixed capacity (static shapes: XLA cannot trace
+data-dependent bucket sizes), and one ``all_to_all`` over the ``ep`` mesh
+axis carries every device's buckets to the devices owning those experts,
+exactly how the reference's map outputs travel to their partition's
+reducer (SURVEY.md §2.6). A second all_to_all brings expert outputs home,
+where the gate's combine weights merge them.
+
+Capacity semantics are the standard switch-transformer ones: per device
+tile, expert e keeps the first ``capacity`` tokens routed to it (position
+by cumulative count in token order); overflow tokens are DROPPED — their
+combine weight is zero, so they pass through the residual connection
+unchanged. The load-balancing auxiliary loss (fraction-routed ×
+mean-gate-probability, scaled by E) keeps the router from collapsing onto
+few experts.
+
+Two forms, golden-diffed in tests: :func:`moe_ffn_reference` (one device,
+all experts local) and :func:`moe_ffn_shard` (inside shard_map, experts
+sharded over ``ep``) — identical routing, identical outputs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, jnp.ndarray]
+
+
+def init_moe(key, d_model: int, d_ff: int, n_experts: int,
+             dtype=jnp.float32, prefix: str = "moe") -> Params:
+    """Router + per-expert FFN weights (E stacked), flat name→array."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = 1.0 / jnp.sqrt(jnp.asarray(d_model, jnp.float32))
+    s2 = 1.0 / jnp.sqrt(jnp.asarray(d_ff, jnp.float32))
+    return {
+        f"{prefix}_router_W": s1 * jax.random.normal(
+            k1, (d_model, n_experts), dtype),
+        f"{prefix}_w1": s1 * jax.random.normal(
+            k2, (n_experts, d_model, d_ff), dtype),
+        f"{prefix}_b1": jnp.zeros((n_experts, d_ff), dtype),
+        f"{prefix}_w2": s2 * jax.random.normal(
+            k3, (n_experts, d_ff, d_model), dtype),
+        f"{prefix}_b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def _route(x, router_w, n_experts: int, capacity: int):
+    """Top-1 routing with capacity: returns (dispatch (T,E,C) one-hot,
+    combine (T,E,C) gate-weighted, aux_loss scalar). x is the flat
+    (T, d) token tile of ONE device."""
+    gates = jax.nn.softmax(x.astype(jnp.float32) @ router_w.astype(
+        jnp.float32), axis=-1)                          # (T, E)
+    expert = jnp.argmax(gates, axis=-1)                 # (T,)
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)
+    # position of each token within its expert's bucket (token order)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # (T, E)
+    kept = onehot * (pos < capacity)                    # drop overflow
+    pos_c = jax.nn.one_hot(jnp.sum(pos, axis=-1).astype(jnp.int32),
+                           capacity, dtype=jnp.float32)  # (T, C)
+    dispatch = kept[:, :, None] * pos_c[:, None, :]     # (T, E, C)
+    gate = jnp.sum(gates * kept, axis=-1)               # (T,) kept gate
+    combine = dispatch * gate[:, None, None]
+    # switch aux loss: E * Σ_e fraction_routed_e * mean_prob_e
+    frac = jnp.mean(onehot, axis=0)
+    prob = jnp.mean(gates, axis=0)
+    aux = n_experts * jnp.sum(frac * prob)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(w1, b1, w2, b2, x):
+    """Batched expert FFN: x (E, C, d) → (E, C, d), one einsum pair on
+    the MXU per layer."""
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", x, w1) + b1[:, None, :])
+    return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+
+
+def moe_ffn_reference(params: Params, x, *, capacity: int,
+                      prefix: str = "moe") -> Tuple[jnp.ndarray,
+                                                    jnp.ndarray]:
+    """Single-device oracle: (T, d) tokens → ((T, d) out, aux loss)."""
+    w = {k[len(prefix) + 1:]: v for k, v in params.items()
+         if k.startswith(prefix + "_")}
+    n_experts = w["router_W"].shape[1]
+    dispatch, combine, aux = _route(x, w["router_W"], n_experts, capacity)
+    xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    ye = _expert_ffn(w["w1"].astype(jnp.float32),
+                     w["b1"].astype(jnp.float32),
+                     w["w2"].astype(jnp.float32),
+                     w["b2"].astype(jnp.float32), xe)
+    out = jnp.einsum("tec,ecd->td", combine, ye)
+    return out.astype(x.dtype), aux
+
+
+def moe_ffn_shard(params: Params, x, *, capacity: int, ep_axis: str,
+                  prefix: str = "moe") -> Tuple[jnp.ndarray,
+                                                jnp.ndarray]:
+    """Expert-parallel body (inside shard_map): router weights are
+    replicated, expert weights are LOCAL slices (E/ep experts per
+    device); two all_to_alls move token buckets out and back.
+
+    Equivalent to the reference with the same capacity per (device,
+    expert) bucket: each device's tile routes independently, so a
+    reference run over the concatenated tiles with per-tile routing
+    produces identical outputs (the golden-diff in tests).
+    """
+    w = {k[len(prefix) + 1:]: v for k, v in params.items()
+         if k.startswith(prefix + "_")}
+    n_experts = w["router_W"].shape[1]          # GLOBAL expert count
+    dispatch, combine, aux = _route(x, w["router_W"], n_experts, capacity)
+    xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # (E, C, d) → (E/ep, ep·C, d): device p receives every peer's bucket
+    # for its local experts — the shuffle
+    xe = lax.all_to_all(xe, ep_axis, split_axis=0, concat_axis=1,
+                        tiled=True)
+    ye = _expert_ffn(w["w1"].astype(jnp.float32),
+                     w["b1"].astype(jnp.float32),
+                     w["w2"].astype(jnp.float32),
+                     w["b2"].astype(jnp.float32), xe)
+    # inverse shuffle: outputs return to their source devices
+    ye = lax.all_to_all(ye, ep_axis, split_axis=1, concat_axis=0,
+                        tiled=True)
+    out = jnp.einsum("tec,ecd->td", combine, ye)
+    # aux is per-tile; average across the ep group so every device
+    # carries the same scalar (replicated, ready for the loss)
+    return out.astype(x.dtype), lax.pmean(aux, ep_axis)
