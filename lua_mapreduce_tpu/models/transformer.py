@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from lua_mapreduce_tpu.parallel import moe as _moe
+from lua_mapreduce_tpu.parallel.pipeline import pipeline_apply
 from lua_mapreduce_tpu.parallel.ring_attention import (
     _ring_shard, _ulysses_shard, attention_reference)
 
@@ -464,6 +465,134 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
             in_specs=(specs_tree(params), P(dp_axis, sp_axis),
                       P(dp_axis, sp_axis)),
             out_specs=(P(), specs_tree(params)))
+        loss, grads = mapped(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel form: layer groups over a ``pp`` axis (GPipe schedule)
+# ---------------------------------------------------------------------------
+#
+# The decoder blocks are homogeneous, so their weights stack on a leading
+# layer axis and shard over ``pp`` — each stage owns n_layers/pp
+# consecutive layers and scans over its local slice. Embedding + LM head
+# (the tied tok_emb) and the final layernorm are replicated: every device
+# embeds the microbatches identically and scores the (psum-broadcast)
+# last-stage outputs identically, so only the block stack actually rides
+# the pipeline (parallel/pipeline.py). Dense FFN blocks only — tp/MoE
+# compose with dp/sp, not with this axis, in the current build.
+
+_STACKED = ("qkv_W", "out_W", "ff1_W", "ff1_b", "ff2_W", "ff2_b",
+            "ln1_g", "ln1_b", "ln2_g", "ln2_b")
+
+
+def stack_params_pp(params: Params, cfg: TransformerConfig) -> Params:
+    """Per-layer weights → one leading-layer-axis stack per weight name
+    (``layers_<name>``); embeddings/final-ln keys pass through."""
+    if cfg.moe_experts:
+        raise ValueError("pipeline form supports dense blocks only")
+    out: Params = {k: v for k, v in params.items()
+                   if not k.startswith("L")}
+    for name in _STACKED:
+        out[f"layers_{name}"] = jnp.stack(
+            [params[f"L{i}_{name}"] for i in range(cfg.n_layers)])
+    return out
+
+
+def unstack_params_pp(stacked: Params, cfg: TransformerConfig) -> Params:
+    """Inverse of :func:`stack_params_pp` (canonical per-layer names)."""
+    out: Params = {k: jnp.asarray(v) for k, v in stacked.items()
+                   if not k.startswith("layers_")}
+    for name in _STACKED:
+        w = jnp.asarray(stacked[f"layers_{name}"])
+        for i in range(cfg.n_layers):
+            out[f"L{i}_{name}"] = w[i]
+    return out
+
+
+def shard_params_pp(params: Params, mesh, cfg: TransformerConfig, *,
+                    pp_axis: str = "pp") -> Params:
+    """Stack and device_put: layer stacks split over ``pp``, rest
+    replicated."""
+    stacked = stack_params_pp(params, cfg)
+    return {k: jax.device_put(
+        v, NamedSharding(mesh, P(pp_axis) if k.startswith("layers_")
+                         else P()))
+        for k, v in stacked.items()}
+
+
+def _block_stacked(w: Params, x, cfg: TransformerConfig):
+    """One dense decoder block from a single layer's weight dict (no
+    name prefixes) with full local attention — the pipeline stage body.
+    Delegates to _block so the pipeline computes EXACTLY the model the
+    oracle it is golden-diffed against computes."""
+    prefixed = {f"L0_{k}": v for k, v in w.items()}
+    out, _aux = _block(prefixed, 0, x, cfg,
+                       functools.partial(attention_reference,
+                                         causal=True))
+    return out
+
+
+def make_train_step_pp(cfg: TransformerConfig, mesh, optimizer, *,
+                       n_micro: int, pp_axis: str = "pp"):
+    """Jitted pipeline-parallel LM train step over a 1-D (pp,) mesh:
+    ``step(params, opt_state, tokens, targets)`` with params from
+    :func:`shard_params_pp` and tokens/targets replicated (B must divide
+    by ``n_micro``). Reverse-mode AD transposes the GPipe scan into the
+    backward pipeline — no hand-written schedule."""
+    n_pp = mesh.shape[pp_axis]
+    if cfg.n_layers % n_pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
+                         f"{pp_axis}={n_pp}")
+    if cfg.moe_experts:
+        raise ValueError("pipeline form supports dense blocks only")
+
+    def shard_step(params, tokens, targets):
+        _check_seq(tokens.shape[1], cfg)
+        b, l = tokens.shape
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by "
+                             f"n_micro={n_micro}")
+        mb = b // n_micro
+        tok_m = tokens.reshape(n_micro, mb, l)
+        tgt_m = targets.reshape(n_micro, mb, l)
+
+        def global_loss(p):
+            local_layers = {name: p[f"layers_{name}"]
+                            for name in _STACKED}
+            pos = jnp.arange(l)
+            x_micro = (p["tok_emb"][tok_m] + p["pos_emb"][pos])
+
+            def stage(x):
+                def body(x, w):
+                    return _block_stacked(w, x, cfg), None
+                x, _ = lax.scan(body, x, local_layers)
+                return x
+
+            outs = pipeline_apply(stage, x_micro, pp_axis=pp_axis,
+                                  n_stages=n_pp)       # (M, mb, l, d)
+            x = _layer_norm(outs, p["lnf_g"], p["lnf_b"])
+            logits = x @ p["tok_emb"].T
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, tgt_m[..., None],
+                                       axis=-1)[..., 0]
+            return jnp.mean(nll)
+
+        return jax.value_and_grad(global_loss)(params)
+
+    def specs_for(params):
+        return {k: (P(pp_axis) if k.startswith("layers_") else P())
+                for k in params}
+
+    def step(params, opt_state, tokens, targets):
+        specs = specs_for(params)
+        mapped = jax.shard_map(
+            shard_step, mesh=mesh, in_specs=(specs, P(), P()),
+            out_specs=(P(), specs))
         loss, grads = mapped(params, tokens, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

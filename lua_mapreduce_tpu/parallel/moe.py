@@ -83,27 +83,49 @@ def _expert_ffn(w1, b1, w2, b2, x):
     return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
 
 
-def moe_ffn_reference(params: Params, x, *, capacity: int,
-                      prefix: str = "moe") -> Tuple[jnp.ndarray,
-                                                    jnp.ndarray]:
-    """Single-device oracle: (T, d) tokens → ((T, d) out, aux loss)."""
+def _moe_ffn(params: Params, x, capacity: int, prefix: str,
+             ep_axis) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One body for both forms — ``ep_axis=None`` keeps everything local
+    (the oracle); a mesh axis inserts the two all_to_all shuffles. The
+    two forms are contractually golden-diffed, so they MUST share this
+    routing/compute path."""
     w = {k[len(prefix) + 1:]: v for k, v in params.items()
          if k.startswith(prefix + "_")}
-    n_experts = w["router_W"].shape[1]
+    n_experts = w["router_W"].shape[1]          # GLOBAL expert count
     dispatch, combine, aux = _route(x, w["router_W"], n_experts, capacity)
     xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    if ep_axis is not None:
+        # (E, C, d) → (E/ep, ep·C, d): device p receives every peer's
+        # bucket for its local experts — the shuffle
+        xe = lax.all_to_all(xe, ep_axis, split_axis=0, concat_axis=1,
+                            tiled=True)
     ye = _expert_ffn(w["w1"].astype(jnp.float32),
                      w["b1"].astype(jnp.float32),
                      w["w2"].astype(jnp.float32),
                      w["b2"].astype(jnp.float32), xe)
+    if ep_axis is not None:
+        # inverse shuffle: outputs return to their source devices
+        ye = lax.all_to_all(ye, ep_axis, split_axis=1, concat_axis=0,
+                            tiled=True)
     out = jnp.einsum("tec,ecd->td", combine, ye)
+    if ep_axis is not None:
+        # aux is per-tile; average across the ep group so every device
+        # carries the same scalar (replicated, ready for the loss)
+        aux = lax.pmean(aux, ep_axis)
     return out.astype(x.dtype), aux
+
+
+def moe_ffn_reference(params: Params, x, *, capacity: int,
+                      prefix: str = "moe") -> Tuple[jnp.ndarray,
+                                                    jnp.ndarray]:
+    """Single-device oracle: (T, d) tokens → ((T, d) out, aux loss)."""
+    return _moe_ffn(params, x, capacity, prefix, None)
 
 
 def moe_ffn_shard(params: Params, x, *, capacity: int, ep_axis: str,
                   prefix: str = "moe") -> Tuple[jnp.ndarray,
                                                 jnp.ndarray]:
-    """Expert-parallel body (inside shard_map): router weights are
+    """Expert-parallel form (inside shard_map): router weights are
     replicated, expert weights are LOCAL slices (E/ep experts per
     device); two all_to_alls move token buckets out and back.
 
@@ -112,23 +134,4 @@ def moe_ffn_shard(params: Params, x, *, capacity: int, ep_axis: str,
     reference run over the concatenated tiles with per-tile routing
     produces identical outputs (the golden-diff in tests).
     """
-    w = {k[len(prefix) + 1:]: v for k, v in params.items()
-         if k.startswith(prefix + "_")}
-    n_experts = w["router_W"].shape[1]          # GLOBAL expert count
-    dispatch, combine, aux = _route(x, w["router_W"], n_experts, capacity)
-    xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
-    # (E, C, d) → (E/ep, ep·C, d): device p receives every peer's bucket
-    # for its local experts — the shuffle
-    xe = lax.all_to_all(xe, ep_axis, split_axis=0, concat_axis=1,
-                        tiled=True)
-    ye = _expert_ffn(w["w1"].astype(jnp.float32),
-                     w["b1"].astype(jnp.float32),
-                     w["w2"].astype(jnp.float32),
-                     w["b2"].astype(jnp.float32), xe)
-    # inverse shuffle: outputs return to their source devices
-    ye = lax.all_to_all(ye, ep_axis, split_axis=1, concat_axis=0,
-                        tiled=True)
-    out = jnp.einsum("tec,ecd->td", combine, ye)
-    # aux is per-tile; average across the ep group so every device
-    # carries the same scalar (replicated, ready for the loss)
-    return out.astype(x.dtype), lax.pmean(aux, ep_axis)
+    return _moe_ffn(params, x, capacity, prefix, ep_axis)
