@@ -1,0 +1,80 @@
+"""Europarl-scale wordcount benchmark — the reference's headline numbers.
+
+Reference (README.md:43-113, one 4-core machine): 47.37s cluster /
+49.23s server wall with 4 workers; 26.1s single-core naive Lua; 141.3s
+shell pipeline. This script reproduces the same experiment on the
+synthetic corpus of examples/wordcount_big (same shape: 197 splits,
+49.25M words) against this framework's true multi-process pool.
+
+Usage: python benchmarks/wordcount_bench.py [n_workers] [corpus_dir]
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run(n_workers: int = 4, corpus_dir: str = "/tmp/wc_corpus") -> dict:
+    from examples.wordcount_big import corpus
+    from lua_mapreduce_tpu.coord.filestore import FileJobStore
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.server import Server
+
+    corpus.build(corpus_dir, log=lambda m: print(m, flush=True))
+    coord = tempfile.mkdtemp(prefix="wcb-coord")
+    spill = tempfile.mkdtemp(prefix="wcb-spill")
+
+    worker_code = (
+        "import sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from lua_mapreduce_tpu.coord.filestore import FileJobStore\n"
+        "from lua_mapreduce_tpu.engine.worker import Worker\n"
+        f"w = Worker(FileJobStore({coord!r})).configure(\n"
+        "    max_iter=100000, max_sleep=0.05, max_tasks=100000)\n"
+        "w.execute()\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    t0 = time.perf_counter()
+    procs = [subprocess.Popen([sys.executable, "-c", worker_code], env=env)
+             for _ in range(n_workers)]
+    try:
+        mod = "examples.wordcount_big.bigtask"
+        spec = TaskSpec(taskfn=mod, mapfn=mod, partitionfn=mod,
+                        reducefn=mod,
+                        init_args={"corpus_dir": corpus_dir},
+                        storage=f"shared:{spill}")
+        server = Server(FileJobStore(coord),
+                        poll_interval=0.1).configure(spec)
+        stats = server.loop()
+        wall = time.perf_counter() - t0
+    finally:
+        # never leave orphaned worker processes polling the store
+        for p in procs:
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+            except Exception:
+                p.kill()
+    it = stats.iterations[-1]
+    return {
+        "server_wall_s": round(wall, 1),
+        "map_cluster_s": round(it.map.cluster_time, 1),
+        "reduce_cluster_s": round(it.reduce.cluster_time, 1),
+        "cluster_s": round(it.cluster_time, 1),
+        "failed": it.map.failed + it.reduce.failed,
+        "n_workers": n_workers,
+        "reference_4core_4worker": {"cluster_s": 47.37, "wall_s": 49.23},
+    }
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    d = sys.argv[2] if len(sys.argv) > 2 else "/tmp/wc_corpus"
+    print(run(n, d))

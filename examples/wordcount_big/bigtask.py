@@ -1,0 +1,56 @@
+"""WordCountBig — the Europarl-scale task module (single-module form).
+
+Analog of reference mapreduce/examples/WordCountBig/taskfn.lua:5-13 (taskfn
+lists the 197 corpus splits from disk) reusing WordCount's
+map/partition/reduce, as execute_BIG_server.sh:3-9 wires them. The map
+side pre-folds counts with a Counter (the in-map combiner role,
+job.lua:92-96) so each split emits one record per distinct word.
+"""
+
+import os
+from collections import Counter
+
+from examples.wordcount_big import corpus
+
+NUM_REDUCERS = 15       # reference partitionfn.lua:2
+
+_corpus_dir = None
+_n_splits = corpus.N_SPLITS
+
+
+def init(args):
+    global _corpus_dir, _n_splits
+    _corpus_dir = args["corpus_dir"]
+    _n_splits = int(args.get("n_splits", corpus.N_SPLITS))
+    if args.get("build", True):
+        corpus.build(_corpus_dir, n_splits=_n_splits)
+
+
+def taskfn(emit):
+    # emit exactly the configured splits — globbing would silently count
+    # extra splits present in a shared corpus dir
+    for i in range(_n_splits):
+        path = corpus.split_path(_corpus_dir, i)
+        emit(os.path.basename(path), path)
+
+
+def mapfn(key, value, emit):
+    counts = Counter()
+    with open(value) as f:
+        for line in f:
+            counts.update(line.split())
+    for word, n in counts.items():
+        emit(word, n)
+
+
+def partitionfn(key):
+    return sum(key[:4].encode()) % NUM_REDUCERS
+
+
+def reducefn(key, values):
+    return sum(values)
+
+
+reducefn.associative_reducer = True
+reducefn.commutative_reducer = True
+reducefn.idempotent_reducer = False

@@ -251,15 +251,21 @@ def make_sharded_apply(cfg: TransformerConfig, mesh, *,
     return jax.jit(apply)
 
 
+def _mean_nll(logits, targets):
+    """Mean next-token NLL — the ONE loss tail every execution form
+    shares (a loss change here reaches dp/sp/tp/ep/pp alike)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
 def lm_loss_local(params, tokens, targets, cfg, attn_fn, pos, block=None):
     """Mean next-token NLL (+ weighted MoE aux loss) on this device's
     tile (targets pre-shifted by the caller — with a sharded sequence
     the shift crosses shard edges, so it happens host-side before
     sharding)."""
     logits, aux = _forward(params, tokens, pos, cfg, attn_fn, block=block)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+    return _mean_nll(logits, targets) + cfg.moe_aux_weight * aux
 
 
 def param_specs_moe(ep_axis: str = "dp") -> Dict[str, object]:
@@ -577,10 +583,7 @@ def make_train_step_pp(cfg: TransformerConfig, mesh, optimizer, *,
                                   n_stages=n_pp)       # (M, mb, l, d)
             x = _layer_norm(outs, p["lnf_g"], p["lnf_b"])
             logits = x @ p["tok_emb"].T
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(logp, tgt_m[..., None],
-                                       axis=-1)[..., 0]
-            return jnp.mean(nll)
+            return _mean_nll(logits, tgt_m)
 
         return jax.value_and_grad(global_loss)(params)
 

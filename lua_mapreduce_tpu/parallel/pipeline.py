@@ -60,12 +60,15 @@ def pipeline_apply(stage_fn: Callable, x_micro, *, pp_axis: str,
         inject = jnp.where(stage == 0,
                            x_micro[micro_idx].astype(recv.dtype), recv)
         out = stage_fn(inject)
-        # last stage's output this tick, broadcast to every device
-        last = lax.psum(
-            jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out)),
-            pp_axis)
-        return out, last
+        # scan out this device's MASKED contribution; the psum broadcast
+        # is linear, so one post-scan collective over the stacked ticks
+        # replaces (M+S-1) per-tick latency-bound all-reduces
+        masked = jnp.where(stage == n_stages - 1, out,
+                           jnp.zeros_like(out))
+        return out, masked
 
-    _, lasts = lax.scan(tick, buf0, jnp.arange(n_ticks))
-    # microbatch m exits the last stage at tick m + n_stages - 1
-    return lasts[n_stages - 1:]
+    _, masked = lax.scan(tick, buf0, jnp.arange(n_ticks))
+    # microbatch m exits the last stage at tick m + n_stages - 1;
+    # bubble ticks are dropped BEFORE the collective so it moves exactly
+    # the meaningful activations once
+    return lax.psum(masked[n_stages - 1:], pp_axis)
