@@ -218,3 +218,61 @@ def test_worker_config_rejects_unknown_keys():
     w = Worker(MemJobStore())
     with pytest.raises(KeyError, match="unknown worker config"):
         w.configure(bogus=1)
+
+
+def test_sigkilled_worker_job_is_requeued(tmp_path):
+    """Chaos e2e: SIGKILL a worker process mid-map (no exception handler
+    runs, its RUNNING job just goes silent) — the server's stale-requeue
+    must hand the job to the surviving worker and the run must still
+    golden-diff (SURVEY.md §5 elastic recovery, beyond the reference:
+    its RUNNING jobs of dead workers stay stuck forever)."""
+    golden = naive_wordcount(CORPUS)
+    root = str(tmp_path / "coord")
+    spill = str(tmp_path / "spill")
+    store = FileJobStore(root)
+
+    # victim worker: claims one map job, then hangs forever
+    victim_code = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import examples.wordcount.mapfn as m\n"
+        "orig = m.mapfn\n"
+        "def stall(k, v, emit):\n"
+        "    print('CLAIMED', flush=True)\n"
+        "    time.sleep(3600)\n"
+        "m.mapfn = stall\n"
+        "from lua_mapreduce_tpu import FileJobStore, Worker\n"
+        f"w = Worker(FileJobStore({root!r})).configure(\n"
+        "    max_iter=400, max_sleep=0.05)\n"
+        "w.execute()\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    victim = subprocess.Popen([sys.executable, "-c", victim_code], env=env,
+                              stdout=subprocess.PIPE, text=True)
+
+    server = Server(store, poll_interval=0.05,
+                    stale_timeout_s=1.0).configure(_spec(f"shared:{spill}"))
+
+    killed = {}
+
+    def chaos():
+        line = victim.stdout.readline()     # wait until a job is claimed
+        killed["claimed"] = line.strip()
+        time.sleep(0.2)
+        victim.kill()                        # SIGKILL: no cleanup runs
+
+    t = threading.Thread(target=chaos, daemon=True)
+    t.start()
+    # a healthy worker thread completes everything the victim abandons
+    healthy = Worker(store).configure(max_iter=800, max_sleep=0.05)
+    ht = threading.Thread(target=healthy.execute, daemon=True)
+    ht.start()
+    stats = server.loop()
+    ht.join(timeout=30)
+    victim.wait(timeout=10)
+    t.join(timeout=10)
+
+    assert killed.get("claimed") == "CLAIMED", "victim never claimed a job"
+    import examples.wordcount.finalfn as finalfn
+    assert dict(finalfn.counts) == golden
+    it = stats.iterations[-1]
+    assert it.map.failed == 0 and it.reduce.failed == 0
