@@ -245,7 +245,8 @@ def test_sigkilled_worker_job_is_requeued(tmp_path):
         f"w = Worker(FileJobStore({root!r})).configure(\n"
         "    max_iter=400, max_sleep=0.05)\n"
         "w.execute()\n")
-    env = dict(os.environ, PYTHONPATH=REPO)
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
     victim = subprocess.Popen([sys.executable, "-c", victim_code], env=env,
                               stdout=subprocess.PIPE, text=True)
 
@@ -253,19 +254,34 @@ def test_sigkilled_worker_job_is_requeued(tmp_path):
                     stale_timeout_s=1.0).configure(_spec(f"shared:{spill}"))
 
     killed = {}
+    # a healthy worker thread completes everything the victim abandons; it
+    # must NOT start until the victim has claimed a job, or (on a 1-core
+    # box) it drains every map while the victim is still booting Python
+    healthy = Worker(store).configure(max_iter=800, max_sleep=0.05)
+    ht = threading.Thread(target=healthy.execute, daemon=True)
+    once = threading.Lock()
+
+    def start_healthy():
+        if once.acquire(blocking=False):
+            ht.start()
 
     def chaos():
         line = victim.stdout.readline()     # wait until a job is claimed
         killed["claimed"] = line.strip()
         time.sleep(0.2)
         victim.kill()                        # SIGKILL: no cleanup runs
+        # start the healthy worker even if the victim died claimless, so
+        # the server loop still terminates and the assert reports it
+        start_healthy()
 
     t = threading.Thread(target=chaos, daemon=True)
     t.start()
-    # a healthy worker thread completes everything the victim abandons
-    healthy = Worker(store).configure(max_iter=800, max_sleep=0.05)
-    ht = threading.Thread(target=healthy.execute, daemon=True)
-    ht.start()
+    # watchdog: if the victim wedges before printing CLAIMED, readline
+    # blocks forever — start the healthy worker anyway so server.loop()
+    # terminates and the CLAIMED assert reports the real problem
+    watchdog = threading.Timer(30, start_healthy)
+    watchdog.daemon = True
+    watchdog.start()
     stats = server.loop()
     ht.join(timeout=30)
     victim.wait(timeout=10)
