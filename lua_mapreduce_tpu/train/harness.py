@@ -76,6 +76,7 @@ class DataParallelTrainer:
             self.optimizer.init(self.params), NamedSharding(mesh, P()))
         self._step = self._build_step()
         self._epoch = self._build_epoch()
+        self._steps_cache: Dict[int, Callable] = {}
 
     # -- jitted single step -------------------------------------------------
 
@@ -128,6 +129,36 @@ class DataParallelTrainer:
             return params, opt_state, losses
 
         return jax.jit(epoch, donate_argnums=(0, 1))
+
+    def _build_steps_on_batch(self, n_steps: int):
+        step = self._step
+
+        def steps(params, opt_state, x, y):
+            def body(carry, _):
+                params, opt_state = carry
+                params, opt_state, loss = step(params, opt_state, x, y)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = lax.scan(
+                body, (params, opt_state), None, length=n_steps)
+            return params, opt_state, losses
+
+        return jax.jit(steps, donate_argnums=(0, 1))
+
+    def run_steps(self, x, y, n_steps: int):
+        """``n_steps`` optimizer steps on ONE fixed batch inside a single
+        jitted scan. The batch stays device-resident across steps, so this
+        is the pure compute hot loop — what MFU measurement needs (and the
+        extreme case of the zero-coordination north star: not even data
+        loading between steps). Returns the per-step losses."""
+        x, y = self._shard_batch(x, y)
+        fn = self._steps_cache.get(n_steps)
+        if fn is None:
+            fn = self._steps_cache[n_steps] = \
+                self._build_steps_on_batch(n_steps)
+        self.params, self.opt_state, losses = fn(
+            self.params, self.opt_state, x, y)
+        return losses
 
     def run_epoch(self, x: np.ndarray, y: np.ndarray,
                   rng: np.random.RandomState) -> float:

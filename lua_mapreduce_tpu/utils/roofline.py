@@ -1,0 +1,93 @@
+"""Roofline accounting: chip peak FLOP/s and MFU.
+
+The reference ships no MFU notion — its perf story is wall-clock tables
+(/root/reference/README.md:43-113). The build's north star is stated as
+an MFU target (BASELINE.md: "≥50% MFU on the digits model"), so model
+FLOP helpers (``models/*/flops_per_example``) need a denominator: the
+chip's peak matmul FLOP/s. Known TPU generations are in a table (public
+per-chip bf16 figures, e.g. jax-ml.github.io/scaling-book); anything
+unknown falls back to a measured big-matmul probe so MFU stays defined
+(if optimistically scaled) on CPU test boxes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Per-chip peak dense bf16 matmul FLOP/s, keyed by jax Device.device_kind.
+PEAK_BF16_FLOPS = {
+    "TPU v2": 46e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,     # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,          # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,     # Trillium / v6e
+    "TPU v6e": 918e12,
+}
+
+_probe_cache: dict = {}
+
+
+def peak_flops_per_s(device=None) -> float:
+    """Peak dense bf16 FLOP/s for one chip.
+
+    Resolution order: ``LMR_PEAK_FLOPS`` env override → known-generation
+    table → measured probe (timed 4096³ bf16 matmul — a floor on peak,
+    so MFU against it is an upper bound; fine for CPU test boxes).
+    """
+    env = os.environ.get("LMR_PEAK_FLOPS")
+    if env:
+        return float(env)
+    import jax
+    if device is None:
+        device = jax.devices()[0]
+    kind = device.device_kind
+    if kind in PEAK_BF16_FLOPS:
+        return PEAK_BF16_FLOPS[kind]
+    # smaller probe off-accelerator: a 4096³ matmul takes ~10s on the
+    # single-core CPU test box and resolution doesn't need it
+    return _measured_peak(device, n=1024 if device.platform == "cpu"
+                          else 4096)
+
+
+def best_time(fn, reps: int = 3) -> float:
+    """Best wall time of ``fn()`` over ``reps`` calls. ``fn`` must force
+    completion itself (fetch a result device→host with ``np.asarray`` —
+    under a tunneled backend ``block_until_ready`` can return before
+    execution finishes, yielding impossible throughputs)."""
+    import time
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _measured_peak(device, n: int = 4096) -> float:
+    """Best achieved FLOP/s over a few timed n³ bf16 matmuls."""
+    if device in _probe_cache:
+        return _probe_cache[device]
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    a = jax.device_put(jax.random.normal(k1, (n, n), jnp.bfloat16), device)
+    b = jax.device_put(jax.random.normal(k2, (n, n), jnp.bfloat16), device)
+    f = jax.jit(lambda a, b: a @ b)
+    np.asarray(f(a, b))          # compile + warm
+    peak = 2 * n**3 / best_time(lambda: np.asarray(f(a, b)))
+    _probe_cache[device] = peak
+    return peak
+
+
+def mfu(model_flops: float, seconds: float, n_chips: int = 1,
+        device=None) -> float:
+    """Model FLOP utilization in [0,1]: counted model FLOPs per second
+    as a fraction of ``n_chips`` × chip peak."""
+    return model_flops / seconds / (n_chips * peak_flops_per_s(device))

@@ -26,6 +26,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CORPUS = sorted(glob.glob(os.path.join(REPO, "examples", "wordcount", "*.py")))
 
 
+def _subprocess_env():
+    """Env for worker subprocesses: REPO importable, ambient PYTHONPATH
+    preserved (it registers the axon TPU plugin), and no trailing empty
+    entry (an empty PYTHONPATH element means cwd and can shadow packages)."""
+    ambient = os.environ.get("PYTHONPATH", "")
+    path = REPO + os.pathsep + ambient if ambient else REPO
+    return dict(os.environ, PYTHONPATH=path)
+
+
 def _spec(storage, init_args=None):
     return TaskSpec(
         taskfn="examples.wordcount.taskfn",
@@ -144,7 +153,7 @@ def test_multiprocess_pool(tmp_path, engine):
         "w = Worker(store).configure(max_iter=300, max_sleep=0.05)\n"
         "w.execute()\n"
     )
-    env = dict(os.environ, PYTHONPATH=REPO)
+    env = _subprocess_env()
     procs = [subprocess.Popen([sys.executable, "-c", worker_code], env=env)
              for _ in range(2)]
     try:
@@ -245,9 +254,8 @@ def test_sigkilled_worker_job_is_requeued(tmp_path):
         f"w = Worker(FileJobStore({root!r})).configure(\n"
         "    max_iter=400, max_sleep=0.05)\n"
         "w.execute()\n")
-    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep +
-               os.environ.get("PYTHONPATH", ""))
-    victim = subprocess.Popen([sys.executable, "-c", victim_code], env=env,
+    victim = subprocess.Popen([sys.executable, "-c", victim_code],
+                              env=_subprocess_env(),
                               stdout=subprocess.PIPE, text=True)
 
     server = Server(store, poll_interval=0.05,
@@ -263,6 +271,9 @@ def test_sigkilled_worker_job_is_requeued(tmp_path):
 
     def start_healthy():
         if once.acquire(blocking=False):
+            # the victim may still be wedged alive (watchdog path): kill it
+            # so victim.wait() below returns and the CLAIMED assert reports
+            victim.kill()
             ht.start()
 
     def chaos():
