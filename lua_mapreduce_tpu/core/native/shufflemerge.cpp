@@ -21,6 +21,7 @@
 // owns tmp+rename atomicity (the fs.lua:80-115 discipline).
 
 #include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -184,6 +185,42 @@ bool parse_key(const char*& p, Key& k) {
     return false;
 }
 
+// Exact compare of an arbitrary-precision integer key (neg, |digits|)
+// against a double key parsed from a non-integral literal. Python
+// compares int-vs-float exactly, so rounding the int through double
+// (lossy past 2^53) would silently merge keys Python keeps distinct.
+int int_vs_double_cmp(bool neg, const std::string& digits, double d) {
+    if (d == HUGE_VAL) return -1;               // any int < +inf
+    if (d == -HUGE_VAL) return 1;               // any int > -inf
+    static const char* TWO53 = "9007199254740992";  // 2^53, 16 digits
+    if (digits.size() < 16 ||
+        (digits.size() == 16 && digits.compare(TWO53) <= 0)) {
+        // |int| <= 2^53: double holds it exactly
+        double iv = strtod(digits.c_str(), nullptr);
+        if (neg) iv = -iv;
+        return iv < d ? -1 : (iv > d ? 1 : 0);
+    }
+    bool dneg = std::signbit(d);
+    double ad = dneg ? -d : d;
+    if (ad < 9007199254740992.0)
+        // |d| < 2^53 < |int| → the int's magnitude wins; sign decides
+        return neg ? -1 : 1;
+    // |d| >= 2^53: d is integral-valued; %.0f prints its exact decimal
+    // (binary→decimal of an integer-valued double is exact, <= 309 digits)
+    char buf[352];
+    snprintf(buf, sizeof buf, "%.0f", ad);
+    if (neg != dneg) return neg ? -1 : 1;
+    size_t blen = strlen(buf);
+    int mag;
+    if (digits.size() != blen) {
+        mag = digits.size() < blen ? -1 : 1;
+    } else {
+        int c = digits.compare(buf);
+        mag = c < 0 ? -1 : (c > 0 ? 1 : 0);
+    }
+    return neg ? -mag : mag;
+}
+
 // key_lt: -1 / 0 / +1 matching serialize.key_lt's total order
 int key_cmp(const Key& a, const Key& b) {
     if (a.rank != b.rank) return a.rank < b.rank ? -1 : 1;
@@ -203,6 +240,8 @@ int key_cmp(const Key& a, const Key& b) {
                 }
                 return a.neg ? -mag : mag;
             }
+            if (a.is_int) return int_vs_double_cmp(a.neg, a.digits, b.dval);
+            if (b.is_int) return -int_vs_double_cmp(b.neg, b.digits, a.dval);
             return a.dval < b.dval ? -1 : (a.dval > b.dval ? 1 : 0);
         case 2: {
             int c = a.sval.compare(b.sval);  // UTF-8 bytes == code points

@@ -95,17 +95,20 @@ def native_merge_records(store, filenames: Sequence[str]
             pass
         return None
 
+    # Unlink eagerly: POSIX keeps the open fd readable, and the
+    # partition-sized temp must not leak into the spill dir if the reduce
+    # fold raises (or the worker dies) before exhausting the stream.
+    f = open(out)
+    try:
+        os.unlink(out)
+    except OSError:
+        pass
+
     def stream() -> Iterator[Tuple[object, List[object]]]:
-        try:
-            with open(out) as f:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        yield load_record(line)
-        finally:
-            try:
-                os.unlink(out)
-            except OSError:
-                pass
+        with f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield load_record(line)
 
     return stream()

@@ -16,13 +16,39 @@ from __future__ import annotations
 
 import functools
 import json
+import re
 from typing import Any, Iterable, List, Tuple as PyTuple
 
 from lua_mapreduce_tpu.core import tuples
 
+# chars a JSON string can't carry raw (ensure_ascii=False keeps unicode raw)
+_NEEDS_ESCAPE = re.compile(r'[\\"\x00-\x1f]')
+
 
 def dump_record(key: Any, values: Iterable[Any]) -> str:
-    """One record as a single JSON line (no trailing newline)."""
+    """One record as a single JSON line (no trailing newline).
+
+    Fast path: escape-free str key + int/escape-free-str values formats
+    the line directly — json.dumps per record was the top cost of a
+    wordcount map job (~1/3 of its wall time). Byte-identical to the
+    json.dumps output for the covered shapes (type checks are exact, so
+    bool — a JSON-incompatible repr — never slips through as int).
+    """
+    # fast path requires a re-iterable container: a half-consumed generator
+    # could not fall back to json.dumps without losing values
+    if (type(key) is str and isinstance(values, (list, tuple))
+            and not _NEEDS_ESCAPE.search(key)):
+        parts = []
+        for v in values:
+            tv = type(v)
+            if tv is int:
+                parts.append(str(v))
+            elif tv is str and not _NEEDS_ESCAPE.search(v):
+                parts.append(f'"{v}"')
+            else:
+                break
+        else:
+            return f'["{key}",[{",".join(parts)}]]'
     return json.dumps([_plain(key), [_plain(v) for v in values]],
                       separators=(",", ":"), ensure_ascii=False)
 
@@ -97,6 +123,8 @@ def sorted_keys(keys: Iterable[Any]) -> List[Any]:
     produced by the record format) fall back to the exact comparator.
     """
     keys = list(keys)
+    if all(type(k) is str for k in keys):
+        return sorted(keys)    # single-rank: native order == key_lt order
     try:
         return sorted(keys, key=_canon_key)
     except TypeError:

@@ -32,20 +32,46 @@ from lua_mapreduce_tpu.store.router import get_storage_from
 from lua_mapreduce_tpu.utils.stats import IterationStats, TaskStats
 
 
+class PhaseFailed(RuntimeError):
+    """A phase completed with FAILED jobs while the server ran in strict
+    mode. The reference proceeds to finalfn on partial results
+    (server.lua:192-205 scavenges then carries on); for workloads whose
+    finalfn drives optimizer steps, a silent partial gradient sum is a
+    correctness hazard — strict mode aborts the iteration instead.
+    Carries the retained worker errors for diagnosis."""
+
+    def __init__(self, phase: str, failed: int, total: int,
+                 errors: List[dict]):
+        self.phase = phase
+        self.failed = failed
+        self.total = total
+        self.errors = list(errors)
+        msg = (f"{phase} phase: {failed}/{total} job(s) FAILED after "
+               f"{MAX_JOB_RETRIES} retries")
+        if self.errors:
+            msg += f"; last worker error:\n{self.errors[-1]['msg']}"
+        super().__init__(msg)
+
+
 class Server:
     """Orchestrate one task over an elastic worker pool.
 
     ``stale_timeout_s`` (None disables) requeues RUNNING jobs whose worker
     silently died — see JobStore.requeue_stale.
+
+    ``strict`` raises :class:`PhaseFailed` the moment a phase ends with
+    FAILED jobs instead of feeding finalfn partial results (the default
+    stays reference-compatible: warn on stderr and proceed).
     """
 
     def __init__(self, store: JobStore, poll_interval: float = DEFAULT_SLEEP,
                  stale_timeout_s: Optional[float] = 600.0,
-                 verbose: bool = False):
+                 verbose: bool = False, strict: bool = False):
         self.store = store
         self.poll_interval = poll_interval
         self.stale_timeout_s = stale_timeout_s
         self.verbose = verbose
+        self.strict = strict
         self.spec: Optional[TaskSpec] = None
         self.stats = TaskStats()
         self.finished_value: Any = None
@@ -89,14 +115,21 @@ class Server:
 
     # -- main loop ----------------------------------------------------------
 
-    def loop(self, progress: Optional[Callable[[str, float], None]] = None) -> TaskStats:
+    def loop(self, progress: Optional[Callable[[str, float], None]] = None,
+             strict: Optional[bool] = None) -> TaskStats:
         """Run the task to completion; returns aggregate stats.
+
+        ``strict`` (when not None) overrides the constructor's strict
+        flag for this run — ``loop(strict=True)`` aborts with
+        :class:`PhaseFailed` on any FAILED job.
 
         Resume semantics (server.lua:470-492): FINISHED task doc → drop
         state, start fresh; REDUCE → skip the map phase and restore the
         spec recorded in the task doc; WAIT/MAP → resume the iteration in
         place, keeping WRITTEN jobs.
         """
+        if strict is not None:
+            self.strict = strict
         t0 = time.time()
         skip_map = False
         iteration = 1
@@ -240,6 +273,9 @@ class Server:
             if progress is not None:
                 progress(phase, done / max(total, 1))
             if done >= total:
+                if counts[Status.FAILED] and self.strict:
+                    raise PhaseFailed(phase, counts[Status.FAILED], total,
+                                      self.errors)
                 if counts[Status.FAILED]:
                     import sys
                     print(f"[server] {phase}: {counts[Status.FAILED]} job(s) "

@@ -136,6 +136,66 @@ def test_failed_jobs_surface_in_stats(tmp_path):
     assert store.counts(MAP_NS)[Status.FAILED] == 1
 
 
+def test_strict_mode_raises_instead_of_partial_final(tmp_path):
+    """strict=True: an iterative (training-style) task whose map shard
+    keeps failing must abort with PhaseFailed BEFORE finalfn consumes the
+    partial result — a silent partial gradient sum is the hazard
+    (VERDICT r1 item 8). Default mode (tested above) stays
+    reference-compatible: warn and proceed."""
+    from lua_mapreduce_tpu import PhaseFailed
+
+    count_file = str(tmp_path / "mapcalls")
+    import examples.wordcount.finalfn as finalfn
+    spec = TaskSpec(
+        taskfn="examples.wordcount.taskfn",
+        mapfn="examples.wordcount.instrumented",
+        partitionfn="examples.wordcount.partitionfn",
+        reducefn="examples.wordcount.reducefn",
+        finalfn="examples.wordcount.finalfn",
+        init_args={"files": CORPUS, "count_file": count_file,
+                   "fail_times": 10_000},
+        storage="mem:dist-strict",
+    )
+    store = MemJobStore()
+    server = Server(store, poll_interval=0.02, strict=True).configure(spec)
+    finalfn.counts.clear()
+    stop = threading.Event()
+
+    def pool():
+        while not stop.is_set():
+            w = Worker(store).configure(max_iter=50, max_sleep=0.05)
+            try:
+                w.execute()
+            except RuntimeError:
+                continue
+
+    t = threading.Thread(target=pool, daemon=True)
+    t.start()
+    with pytest.raises(PhaseFailed) as exc:
+        server.loop()
+    stop.set()
+    assert exc.value.phase == "map"
+    assert exc.value.failed >= 1
+    assert exc.value.errors, "retained worker errors must ride the exception"
+    # finalfn never stepped on the partial result
+    assert dict(finalfn.counts) == {}
+
+
+def test_loop_strict_kwarg_overrides_constructor():
+    """loop(strict=True) is the per-run override form (VERDICT r1)."""
+    spec = _spec("mem:dist-strict-kwarg")
+    store = MemJobStore()
+    server = Server(store, poll_interval=0.02).configure(spec)
+    assert server.strict is False
+    threads = [threading.Thread(
+        target=Worker(store).configure(max_iter=400, max_sleep=0.05).execute,
+        daemon=True) for _ in range(2)]
+    for t in threads:
+        t.start()
+    server.loop(strict=True)     # healthy run: strict changes nothing
+    assert server.strict is True
+
+
 @pytest.mark.parametrize("engine", ["python", "auto"])
 def test_multiprocess_pool(tmp_path, engine):
     """True multi-process elastic pool over a FileJobStore + shared-dir

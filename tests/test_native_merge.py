@@ -125,6 +125,26 @@ def test_bigint_keys_stay_distinct(tmp_path):
     assert len(got) == 3
 
 
+def test_mixed_int_float_keys_beyond_2p53(tmp_path):
+    """Int 2**53+1 vs float 9007199254740992.0 round to the same double;
+    Python compares exactly and keeps them distinct — the native compare
+    must too (ADVICE r1: silent native/Python divergence)."""
+    store = SharedStore(str(tmp_path))
+    runs = {
+        "a": _sorted_run([(2 ** 53 + 1, [1]), (2 ** 53, [7])]),
+        "b": _sorted_run([(float(2 ** 53 + 2), [3]), (-(2 ** 53) - 1, [4]),
+                          (float(2 ** 53), [2]), (0.5, [6])]),
+        "c": _sorted_run([(10 ** 40, [8]), (1e40, [9]),
+                          (-float(2 ** 53), [5])]),
+    }
+    for name, recs in runs.items():
+        _write_run(store, name, recs)
+    names = sorted(runs)
+    want = list(merge_iterator(store, names))
+    got = list(native_merge.native_merge_records(store, names))
+    assert got == want
+
+
 def test_unparseable_records_fall_back(tmp_path):
     """NaN keys parse on the Python path but not in C++ — the native
     wrapper must return None (fallback), not raise mid-reduce."""
