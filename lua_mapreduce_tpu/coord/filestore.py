@@ -15,6 +15,7 @@ flock or the index CAS.
 
 from __future__ import annotations
 
+import copy
 import fcntl
 import hashlib
 import json
@@ -23,7 +24,8 @@ import tempfile
 import time
 from typing import Dict, List, Optional, Sequence
 
-from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES, Status
+from lua_mapreduce_tpu.core.constants import (MAX_JOB_RETRIES,
+                                              MAX_PENDING_INSERTS, Status)
 from lua_mapreduce_tpu.coord.idx import open_index
 from lua_mapreduce_tpu.coord.jobstore import CLAIMABLE, JobStore
 
@@ -70,6 +72,11 @@ class FileJobStore(JobStore):
         os.makedirs(root, exist_ok=True)
         os.makedirs(os.path.join(root, "locks"), exist_ok=True)
         os.makedirs(os.path.join(root, "pt"), exist_ok=True)
+        # per-namespace payload-batch cache: ns -> [(base, docs), ...].
+        # Batches are immutable once written, so the cache never goes
+        # stale except when the ns is dropped (invalidated there) or a
+        # new batch lands (rescan on miss).
+        self._batches: Dict[str, List] = {}
 
     # -- paths -------------------------------------------------------------
 
@@ -80,9 +87,6 @@ class FileJobStore(JobStore):
         d = os.path.join(self.root, f"{ns}.d")
         os.makedirs(d, exist_ok=True)
         return d
-
-    def _payload(self, ns: str, job_id: int) -> str:
-        return os.path.join(self._ns_dir(ns), f"j{job_id}.json")
 
     def _times(self, ns: str, job_id: int) -> str:
         return os.path.join(self._ns_dir(ns), f"t{job_id}.json")
@@ -121,16 +125,116 @@ class FileJobStore(JobStore):
     # -- jobs --------------------------------------------------------------
 
     def insert_jobs(self, ns: str, docs: Sequence[dict]) -> List[int]:
+        """Insert a batch of job payloads, then make them claimable.
+
+        Payloads are written as ONE manifest file per batch of up to
+        MAX_PENDING_INSERTS jobs (the reference buffers control-plane
+        inserts the same way, cnn.lua:80-111) — at reference fan-in scale
+        (~2,000 map jobs, README.md:59) the former file-per-job scheme
+        meant thousands of sequential ``os.replace`` round trips per
+        phase. Manifests land before ``idx.insert`` flips the records
+        claimable, so a winning worker always finds its payload.
+        """
         idx = self._idx(ns)
         base = idx.count()
-        for i, doc in enumerate(docs):
-            _atomic_write_json(self._payload(ns, base + i), doc)
+        docs = list(docs)
+        # clear manifests left by a crash between a previous manifest
+        # write and its idx.insert — a duplicate-base survivor would
+        # shadow this insert's payloads for readers
+        d = self._ns_dir(ns)
+        fresh = {os.path.basename(self._batch_path(
+            ns, base + off, len(docs[off:off + MAX_PENDING_INSERTS])))
+            for off in range(0, len(docs), MAX_PENDING_INSERTS)}
+        for name in os.listdir(d):
+            if (name.startswith("b") and name.endswith(".json")
+                    and name not in fresh):
+                try:
+                    stale_base = int(name[1:-5].split("_")[0])
+                except ValueError:
+                    continue
+                if stale_base >= base:
+                    try:
+                        os.remove(os.path.join(d, name))
+                    except FileNotFoundError:
+                        pass
+        for off in range(0, len(docs), MAX_PENDING_INSERTS):
+            chunk = docs[off:off + MAX_PENDING_INSERTS]
+            _atomic_write_json(self._batch_path(ns, base + off, len(chunk)),
+                               chunk)
+        # new generation AFTER the manifests land, BEFORE records become
+        # claimable: a worker that wins a claim always sees fresh payloads
+        fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".tmp.gen.")
+        with os.fdopen(fd, "w") as f:
+            f.write(f"{time.time_ns()}.{base}.{len(docs)}")
+        os.replace(tmp, self._gen_path(ns))
         got = idx.insert(len(docs))
         if got != base:
             raise RuntimeError(
                 f"concurrent insert into {ns!r}: expected base {base}, got "
                 f"{got} — a namespace has exactly one inserter (the server)")
         return list(range(base, base + len(docs)))
+
+    def _batch_path(self, ns: str, base: int, count: int) -> str:
+        return os.path.join(self._ns_dir(ns), f"b{base}_{count}.json")
+
+    def _gen_path(self, ns: str) -> str:
+        return os.path.join(self.root, f"{ns}.gen")
+
+    def _read_gen(self, ns: str) -> Optional[str]:
+        """Payload generation token. insert_jobs rewrites it after its
+        batch manifests land, so OTHER processes' caches (a worker that
+        outlives a ``"loop"``-protocol drop_ns + re-insert) detect the
+        recreated namespace; their own drop_ns only invalidates locally."""
+        return _read_json_text(self._gen_path(ns))
+
+    def _resolve_batches(self, ns: str) -> list:
+        """The namespace's batch list [(base, docs), ...], cached against
+        the generation token. The token is read BEFORE the rescan, so a
+        token raced by a concurrent insert merely forces one extra rescan
+        later — batch manifests are immutable, never wrong. Duplicate
+        bases (a crash-orphaned manifest that raced insert-time cleanup)
+        resolve to the newest file."""
+        stamp = self._read_gen(ns)
+        cached = self._batches.get(ns)
+        if cached is not None and cached[0] == stamp:
+            return cached[1]
+        entries: Dict[int, tuple] = {}
+        d = self._ns_dir(ns)
+        for name in os.listdir(d):
+            if name.startswith("b") and name.endswith(".json"):
+                try:
+                    b = int(name[1:-5].split("_")[0])
+                except ValueError:
+                    continue
+                path = os.path.join(d, name)
+                loaded = _read_json(path)
+                if loaded is None:
+                    continue
+                try:
+                    mtime = os.stat(path).st_mtime_ns
+                except OSError:
+                    mtime = 0
+                prev = entries.get(b)
+                if prev is None or mtime >= prev[0]:
+                    entries[b] = (mtime, loaded)
+        batches = sorted((b, docs) for b, (_, docs) in entries.items())
+        self._batches[ns] = (stamp, batches)
+        return batches
+
+    @staticmethod
+    def _lookup_payload(batches: list, jid: int) -> Optional[dict]:
+        for base, docs in batches:
+            if base <= jid < base + len(docs):
+                return docs[jid - base]
+        return None
+
+    def _payload_doc(self, ns: str, jid: int) -> dict:
+        """One job's payload, DEEP-copied: the cache must stay pristine
+        when a caller (user mapfn mutating its value in place) edits the
+        returned doc — the old file-per-job scheme re-parsed JSON per
+        read, and retries depend on that isolation."""
+        doc = self._lookup_payload(self._resolve_batches(ns), jid)
+        return copy.deepcopy(doc) if doc is not None else {}
 
     def claim(self, ns, worker, preferred_ids=None, steal=True):
         idx = self._idx(ns)
@@ -162,11 +266,12 @@ class FileJobStore(JobStore):
     def jobs(self, ns):
         idx = self._idx(ns)
         docs = []
-        # one locked pass over the index; payload/times are per-job files
-        # but immutable/single-writer, so they need no lock
+        # one locked pass over the index, ONE batch resolution for the
+        # whole snapshot (per-jid resolution would re-read the gen file
+        # n times); times/worker sidecars are single-writer, no lock
+        batches = self._resolve_batches(ns)
         for jid, (status, reps, whash, started) in enumerate(idx.snapshot()):
-            payload = _read_json(self._payload(ns, jid)) or {}
-            doc = dict(payload)
+            doc = copy.deepcopy(self._lookup_payload(batches, jid)) or {}
             doc.update(_id=jid, status=Status(status), repetitions=reps,
                        worker=whash or None, started_time=started or None,
                        times=_read_json(self._times(ns, jid)))
@@ -178,9 +283,8 @@ class FileJobStore(JobStore):
 
     def _job_doc(self, ns, jid, idx) -> dict:
         state = idx.get(jid)
-        payload = _read_json(self._payload(ns, jid)) or {}
         status, reps, whash, started = state
-        doc = dict(payload)
+        doc = dict(self._payload_doc(ns, jid))
         doc.update(_id=jid, status=Status(status), repetitions=reps,
                    worker=whash or None,
                    started_time=started or None,
@@ -203,10 +307,12 @@ class FileJobStore(JobStore):
         return self._idx(ns).requeue_stale(time.time() - older_than_s)
 
     def drop_ns(self, ns):
-        try:
-            os.remove(os.path.join(self.root, f"{ns}.idx"))
-        except FileNotFoundError:
-            pass
+        self._batches.pop(ns, None)
+        for stale in (f"{ns}.idx", f"{ns}.gen"):
+            try:
+                os.remove(os.path.join(self.root, stale))
+            except FileNotFoundError:
+                pass
         d = os.path.join(self.root, f"{ns}.d")
         if os.path.isdir(d):
             for f in os.listdir(d):

@@ -237,15 +237,33 @@ class Server:
 
     def _prepare_reduce(self, store) -> int:
         """Discover map-output partitions and insert one reduce job per
-        non-empty partition (server_prepare_reduce, server.lua:279-329)."""
+        non-empty partition (server_prepare_reduce, server.lua:279-329).
+
+        Each reduce job records the PRODUCERS of its run files — the
+        reference queries map jobs for worker hostnames and embeds them so
+        pull-style storage knows where to fetch from (server.lua:286-289,
+        fs.lua:143-160). Here the object store is the transport, so the
+        list drives diagnostics: a reduce that can't see a run can name
+        the host that produced it."""
         self.store.drop_ns(RED_NS)
         parts = discover_partitions(store, self.spec.result_ns)
+        producer_by_id = {}
+        for doc in self.store.jobs(MAP_NS):
+            if isinstance(doc.get("worker"), str):
+                producer_by_id[str(doc["_id"])] = doc["worker"]
         docs = []
         for part, files in sorted(parts.items()):
+            mappers = set()
+            for f in files:
+                # run-file name is "<ns>.P<part>.M<map_job_id>"
+                producer = producer_by_id.get(f.rsplit(".M", 1)[-1])
+                if producer is not None:
+                    mappers.add(producer)
             docs.append(make_job(part, {
                 "part": part,
                 "files": files,
                 "result": result_file_name(self.spec.result_ns, part),
+                "mappers": sorted(mappers),
             }))
         if docs:
             self.store.insert_jobs(RED_NS, docs)

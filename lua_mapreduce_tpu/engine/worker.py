@@ -29,7 +29,7 @@ from lua_mapreduce_tpu.store.router import get_storage_from
 MAP_NS = "map_jobs"
 RED_NS = "red_jobs"
 
-_CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks")
+_CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "phases")
 
 
 class Worker:
@@ -43,6 +43,11 @@ class Worker:
         self.max_iter = 20
         self.max_sleep = 20.0
         self.max_tasks = 1
+        # which phases this worker claims — ("map",) / ("reduce",) build
+        # heterogeneous pools (the sshfs pull model's distinct mapper
+        # hosts, fs.lua:143-160); default runs everything like the
+        # reference's workers
+        self.phases = ("map", "reduce")
         self._spec_cache: Dict[str, TaskSpec] = {}
         self._affinity: list = []       # map-job ids this worker ran before
         self._idle_count = 0
@@ -74,6 +79,8 @@ class Worker:
         iteration = int(task.get("iteration", 1))
 
         if task["status"] == TaskStatus.MAP.value:
+            if "map" not in self.phases:
+                return "idle"
             preferred = self._affinity if iteration > 1 else None
             steal = not preferred or self._idle_count >= MAX_IDLE_COUNT
             job = self.store.claim(MAP_NS, self.name, preferred, steal=steal)
@@ -85,6 +92,8 @@ class Worker:
             return "executed"
 
         if task["status"] == TaskStatus.REDUCE.value:
+            if "reduce" not in self.phases:
+                return "idle"
             job = self.store.claim(RED_NS, self.name)
             if job is None:
                 return "idle"
@@ -116,6 +125,18 @@ class Worker:
             result_store = (get_storage_from(spec.result_storage)
                             if spec.result_storage else store)
             v = job["value"]
+            # pull-integrity check: every producer's run must be visible
+            # through the storage backend BEFORE the merge starts. A
+            # missing run fails loudly and names its producer (the sshfs
+            # scp-from-mapper failure mode, fs.lua:148-157) instead of
+            # silently reducing fewer runs.
+            missing = [f for f in v["files"] if not store.exists(f)]
+            if missing:
+                raise RuntimeError(
+                    f"reduce {v['part']}: {len(missing)} run file(s) not "
+                    f"visible in storage (producers: "
+                    f"{v.get('mappers') or 'unknown'}): {missing[:3]} — "
+                    "cross-host pools need a backend every host can reach")
             times = run_reduce_job(spec, store, result_store, str(v["part"]),
                                    v["files"], v["result"])
             if self._finish(ns, jid, times):

@@ -197,3 +197,113 @@ def test_concurrent_claims_are_exclusive(tmp_path):
     for t in threads:
         t.join()
     assert sorted(claimed) == list(range(n_jobs))  # no dup, no loss
+
+
+def test_batched_inserts_reference_fanin_scale(tmp_path):
+    """Reference fan-in scale (README.md:59: ~2,000 map jobs / 1,970 run
+    files -> 10 reduces): inserting 2,000 jobs must write O(batches)
+    control-plane files, not one payload file per job (cnn.lua:80-111
+    batched-insert analog), and claims must read the right payloads back
+    across a fresh store instance (another process's view)."""
+    import os
+    import time
+
+    store = FileJobStore(str(tmp_path))
+    n = 2000
+    t0 = time.perf_counter()
+    ids = store.insert_jobs("map_jobs", [make_job(f"k{i}", {"split": i})
+                                         for i in range(n)])
+    insert_dt = time.perf_counter() - t0
+    assert ids == list(range(n))
+
+    ns_dir = os.path.join(str(tmp_path), "map_jobs.d")
+    batch_files = [f for f in os.listdir(ns_dir) if f.startswith("b")]
+    assert len(batch_files) == 1, batch_files  # 2,000 < MAX_PENDING_INSERTS
+    assert insert_dt < 5.0, f"2,000-job insert took {insert_dt:.2f}s"
+
+    # another process's store: payloads resolve through the manifest
+    store2 = FileJobStore(str(tmp_path))
+    t0 = time.perf_counter()
+    seen = set()
+    for _ in range(n):
+        doc = store2.claim("map_jobs", "w1")
+        assert doc is not None
+        assert doc["value"] == {"split": doc["_id"]}
+        seen.add(doc["_id"])
+    claim_dt = time.perf_counter() - t0
+    assert seen == set(range(n))
+    assert store2.claim("map_jobs", "w1") is None
+    # claims stay cheap: amortized well under a millisecond of payload
+    # overhead each (the index CAS dominates)
+    assert claim_dt < 30.0, f"2,000 claims took {claim_dt:.2f}s"
+
+
+def test_batch_cache_not_stale_across_loop_reinsert(tmp_path):
+    """The "loop" protocol drops and re-inserts a namespace each
+    iteration; a long-lived worker-side store instance must see the NEW
+    payloads, not its cached previous-iteration batch."""
+    server_store = FileJobStore(str(tmp_path))
+    worker_store = FileJobStore(str(tmp_path))
+
+    server_store.insert_jobs("map_jobs", [make_job("a", {"it": 1})])
+    doc = worker_store.claim("map_jobs", "w")
+    assert doc["value"] == {"it": 1}
+
+    server_store.drop_ns("map_jobs")
+    server_store.insert_jobs("map_jobs", [make_job("a", {"it": 2})])
+    doc = worker_store.claim("map_jobs", "w")
+    assert doc["value"] == {"it": 2}, "stale payload from dropped iteration"
+
+
+def test_multi_batch_chunking(tmp_path, monkeypatch):
+    """Inserts above MAX_PENDING_INSERTS split into multiple manifests
+    (flush threshold, cnn.lua:80-96)."""
+    import os
+
+    from lua_mapreduce_tpu.coord import filestore
+    monkeypatch.setattr(filestore, "MAX_PENDING_INSERTS", 64)
+    store = FileJobStore(str(tmp_path))
+    store.insert_jobs("map_jobs", [make_job(i, i) for i in range(200)])
+    ns_dir = os.path.join(str(tmp_path), "map_jobs.d")
+    batches = sorted(f for f in os.listdir(ns_dir) if f.startswith("b"))
+    assert len(batches) == 4       # 64+64+64+8
+    fresh = FileJobStore(str(tmp_path))
+    assert fresh.get_job("map_jobs", 170)["value"] == 170
+    assert fresh.get_job("map_jobs", 0)["value"] == 0
+
+
+def test_payload_cache_isolated_from_caller_mutation(tmp_path):
+    """A claimant mutating job['value'] in place must not poison the
+    process-wide payload cache — the retry path depends on re-reading the
+    original payload (code-review r2 finding)."""
+    store = FileJobStore(str(tmp_path))
+    store.insert_jobs("map_jobs", [make_job("k", {"split": 7, "xs": [1]})])
+    doc = store.claim("map_jobs", "w1")
+    doc["value"].pop("split")
+    doc["value"]["xs"].append(2)
+    again = store.get_job("map_jobs", 0)
+    assert again["value"] == {"split": 7, "xs": [1]}
+
+
+def test_crash_orphaned_manifest_is_superseded(tmp_path):
+    """A manifest written by a crashed insert (no idx.insert committed)
+    must not shadow a later insert's payloads, and duplicate bases must
+    not break payload resolution (code-review r2 finding)."""
+    import json
+    import os
+
+    store = FileJobStore(str(tmp_path))
+    ns_dir = os.path.join(str(tmp_path), "map_jobs.d")
+    os.makedirs(ns_dir, exist_ok=True)
+    # simulate: crash landed b0_3.json but never inserted index records
+    with open(os.path.join(ns_dir, "b0_3.json"), "w") as f:
+        json.dump([{"key": "stale", "value": i} for i in range(3)], f)
+
+    store.insert_jobs("map_jobs", [make_job("fresh", {"n": i})
+                                   for i in range(2)])
+    fresh = FileJobStore(str(tmp_path))
+    got = fresh.claim("map_jobs", "w")
+    assert got["key"] == "fresh"
+    assert got["value"] in ({"n": 0}, {"n": 1})
+    names = sorted(f for f in os.listdir(ns_dir) if f.startswith("b"))
+    assert names == ["b0_2.json"], names

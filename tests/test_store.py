@@ -72,3 +72,134 @@ def test_router_spec_parsing(tmp_path):
     s1 = get_storage_from("mem:tagA")
     s2 = get_storage_from("mem:tagA")
     assert s1 is s2  # process-wide shared instance per tag
+
+
+class _FakeBlob:
+    def __init__(self, bucket, name):
+        self._bucket, self._name = bucket, name
+
+    def upload_from_string(self, data):
+        if isinstance(data, str):
+            data = data.encode()
+        self._bucket._objects[self._name] = bytes(data)
+
+    def download_as_bytes(self):
+        return self._bucket._objects[self._name]
+
+    def exists(self):
+        return self._name in self._bucket._objects
+
+    def delete(self):
+        del self._bucket._objects[self._name]
+
+
+class _FakeBucket:
+    def __init__(self):
+        self._objects = {}
+
+    def blob(self, key):
+        return _FakeBlob(self, key)
+
+    def list_blobs(self, prefix=None):
+        import types as _t
+        names = sorted(self._objects)
+        if prefix:
+            names = [n for n in names if n.startswith(prefix)]
+        return [_t.SimpleNamespace(name=n) for n in names]
+
+
+class _FakeClient:
+    _buckets = {}
+
+    def bucket(self, name):
+        return _FakeClient._buckets.setdefault(name, _FakeBucket())
+
+
+@pytest.fixture
+def fake_gcs(monkeypatch):
+    """Inject a google.cloud.storage lookalike so ObjectStore's gs://
+    branch (whole-object PUT/GET over a Client().bucket()) runs without
+    network (VERDICT r1 item 6: the real-GCS path had zero tests)."""
+    import sys
+    import types
+
+    _FakeClient._buckets = {}
+    storage_mod = types.ModuleType("google.cloud.storage")
+    storage_mod.Client = _FakeClient
+    cloud_mod = types.ModuleType("google.cloud")
+    cloud_mod.storage = storage_mod
+    google_mod = types.ModuleType("google")
+    google_mod.cloud = cloud_mod
+    monkeypatch.setitem(sys.modules, "google", google_mod)
+    monkeypatch.setitem(sys.modules, "google.cloud", cloud_mod)
+    monkeypatch.setitem(sys.modules, "google.cloud.storage", storage_mod)
+    return _FakeClient
+
+
+def test_gcs_branch_roundtrip(fake_gcs):
+    from lua_mapreduce_tpu.store.objectfs import ObjectStore
+
+    store = ObjectStore("gs://testbkt/spill")
+    b = store.builder()
+    b.write("line1\n")
+    b.write("line2\n")
+    b.build("runs.P0.M1")
+    assert store.exists("runs.P0.M1")
+    assert list(store.lines("runs.P0.M1")) == ["line1\n", "line2\n"]
+    # objects live under the prefix in the (fake) bucket
+    bucket = fake_gcs._buckets["testbkt"]
+    assert "spill/runs.P0.M1" in bucket._objects
+    assert store.list("runs.P0.*") == ["runs.P0.M1"]
+    store.remove("runs.P0.M1")
+    assert not store.exists("runs.P0.M1")
+    assert store.list("*") == []
+
+
+def test_gcs_branch_end_to_end_wordcount(fake_gcs):
+    """Whole engine run with intermediate spill through the mocked
+    gs:// bucket — fails if the object path silently degrades to local
+    filesystem assumptions (rename, append, local_path)."""
+    import sys
+    import types
+
+    mod = types.ModuleType("gcs_wc")
+    corpus = {"d1": "a b a c", "d2": "b a"}
+    mod.taskfn = lambda emit: [emit(k, v) for k, v in corpus.items()]
+    def mapfn(key, value, emit):
+        for w in value.split():
+            emit(w, 1)
+    mod.mapfn = mapfn
+    mod.partitionfn = lambda key: sum(key.encode()) % 3
+    mod.reducefn = lambda key, values: sum(values)
+    sys.modules["gcs_wc"] = mod
+
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.local import LocalExecutor
+    spec = TaskSpec(taskfn="gcs_wc", mapfn="gcs_wc", partitionfn="gcs_wc",
+                    reducefn="gcs_wc", storage="object:gs://wcbkt/inter")
+    ex = LocalExecutor(spec)
+    ex.run()
+    out = {k: v[0] for k, v in ex.results()}
+    assert out == {"a": 3, "b": 2, "c": 1}
+    # the shuffle really flowed through the bucket
+    assert "wcbkt" in fake_gcs._buckets
+
+
+def test_gcs_missing_dependency_error_message(monkeypatch):
+    """Without google-cloud-storage importable, gs:// must fail with the
+    actionable message, not an AttributeError later."""
+    import builtins
+    import sys
+
+    for m in ("google", "google.cloud", "google.cloud.storage"):
+        monkeypatch.delitem(sys.modules, m, raising=False)
+    real_import = builtins.__import__
+
+    def no_gcs(name, *a, **k):
+        if name.startswith("google"):
+            raise ImportError(name)
+        return real_import(name, *a, **k)
+    monkeypatch.setattr(builtins, "__import__", no_gcs)
+    from lua_mapreduce_tpu.store.objectfs import ObjectStore
+    with pytest.raises(RuntimeError, match="google-cloud-storage"):
+        ObjectStore("gs://nope/x")
