@@ -1,0 +1,248 @@
+"""Kernel perf regression bench: Pallas vs XLA on the real chip.
+
+The reference's native-kernel story lives in the external APRIL-ANN
+CUDA toolkit (SURVEY.md §2.4); this framework's equivalents are the
+Pallas ops (ops/) plus the C++ shuffle merge (core/native/). Their
+claimed wins must reproduce from a committed artifact, not commit
+messages (VERDICT r1 item 7) — this script times every hot op across
+BASELINE.json-relevant shapes and writes
+benchmarks/results/kernels.json.
+
+Usage: python benchmarks/kernel_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RESULTS = os.path.join(REPO, "benchmarks", "results", "kernels.json")
+
+
+def best_of(fn, reps: int = 5) -> float:
+    """Best wall time of ``fn`` (which must block on completion)."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _bench_pair(make, inner: int = 8) -> dict:
+    """Time one op both ways; returns {pallas_ms, xla_ms, speedup}.
+
+    Measurement discipline for the tunneled backend:
+    - operands are jit ARGUMENTS, never closed over — a closed-over array
+      bakes into the HLO as a constant and the axon remote-compile proxy
+      rejects multi-MB bodies (HTTP 413);
+    - ``block_until_ready`` does NOT synchronize through the tunnel
+      (utils/roofline.best_time doc), so each measurement runs the op
+      ``inner`` times under ``lax.scan`` with a scalar data dependency
+      and fetches ONE float — per-op time = dt/inner, with the tunnel
+      round trip amortized across the scan.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    run_pallas, run_xla, args, flops = make()
+    stacked = tuple(jnp.stack([a] * inner) for a in args)
+    out = {}
+    for name, run in (("pallas", run_pallas), ("xla", run_xla)):
+        def loop(*stk, _run=run):
+            def body(acc, xs):
+                r = _run(*xs)
+                return acc + r.ravel()[0].astype(jnp.float32), None
+            return lax.scan(body, jnp.float32(0), stk)[0]
+
+        jitted = jax.jit(loop)
+        float(jitted(*stacked))                       # compile + warm
+        dt = best_of(lambda: float(jitted(*stacked))) / inner
+        out[f"{name}_ms"] = round(dt * 1e3, 3)
+        if flops:
+            out[f"{name}_tflops"] = round(flops / dt / 1e12, 2)
+    out["speedup_pallas_vs_xla"] = round(out["xla_ms"] / out["pallas_ms"], 3)
+    return out
+
+
+def bench_matmul(m, k, n, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    from lua_mapreduce_tpu import ops
+
+    def make():
+        a = jax.random.normal(jax.random.PRNGKey(0), (m, k), dtype)
+        b = jax.random.normal(jax.random.PRNGKey(1), (k, n), dtype)
+        return (lambda a, b: ops.matmul(a, b, backend="pallas"),
+                lambda a, b: ops.matmul(a, b, backend="xla"),
+                (a, b), 2.0 * m * k * n)
+    return _bench_pair(make)
+
+
+def bench_conv2d(n, h, w, cin, cout, kh, stride, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    from lua_mapreduce_tpu import ops
+
+    def make():
+        x = jax.random.normal(jax.random.PRNGKey(0), (n, h, w, cin), dtype)
+        wt = jax.random.normal(jax.random.PRNGKey(1), (kh, kh, cin, cout),
+                               dtype)
+        ho = wo = (h - kh) // stride + 1
+        flops = 2.0 * n * ho * wo * kh * kh * cin * cout
+        return (lambda x, wt: ops.conv2d(x, wt, stride=stride,
+                                         backend="pallas"),
+                lambda x, wt: ops.conv2d(x, wt, stride=stride,
+                                         backend="xla"),
+                (x, wt), flops)
+    return _bench_pair(make)
+
+
+def bench_flash(b, heads, seq, d, causal, dtype):
+    import jax
+
+    from lua_mapreduce_tpu import ops
+
+    def make():
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, heads, seq, d),
+                              dtype)
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, heads, seq, d),
+                              dtype)
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, heads, seq, d),
+                              dtype)
+        flops = 4.0 * b * heads * seq * seq * d * (0.5 if causal else 1.0)
+        return (lambda q, k, v: ops.flash_attention(q, k, v, causal=causal,
+                                                    backend="pallas"),
+                lambda q, k, v: ops.flash_attention(q, k, v, causal=causal,
+                                                    backend="xla"),
+                (q, k, v), flops)
+    return _bench_pair(make)
+
+
+def bench_softmax(rows, cols, dtype, block_rows=256):
+    # block_rows * cols * dtype must fit scoped VMEM (16MB on v5e);
+    # vocab-wide rows (32k) need a shorter block
+    import jax
+
+    from lua_mapreduce_tpu import ops
+
+    def make():
+        x = jax.random.normal(jax.random.PRNGKey(0), (rows, cols), dtype)
+        return (lambda x: ops.log_softmax(x, backend="pallas",
+                                          block_rows=block_rows),
+                lambda x: ops.log_softmax(x, backend="xla"),
+                (x,), None)
+    return _bench_pair(make)
+
+
+def bench_pool(n, h, w, c, dtype):
+    import jax
+
+    from lua_mapreduce_tpu import ops
+
+    def make():
+        x = jax.random.normal(jax.random.PRNGKey(0), (n, h, w, c), dtype)
+        return (lambda x: ops.maxpool2d(x, 2, backend="pallas"),
+                lambda x: ops.maxpool2d(x, 2, backend="xla"),
+                (x,), None)
+    return _bench_pair(make)
+
+
+def bench_native_merge(n_runs=16, keys_per_run=50_000) -> dict:
+    """C++ single-pass shuffle merge vs the Python heap merge (the
+    luamongo/mongo-cxx role, SURVEY.md §2.4)."""
+    import tempfile
+
+    from lua_mapreduce_tpu.core import native_merge
+    from lua_mapreduce_tpu.core.merge import merge_iterator
+    from lua_mapreduce_tpu.core.serialize import dump_record
+    from lua_mapreduce_tpu.store.sharedfs import SharedStore
+
+    if not native_merge.native_available():
+        return {"skipped": "native merge unavailable (no g++?)"}
+    d = tempfile.mkdtemp(prefix="kbench-merge")
+    store = SharedStore(d)
+    names = []
+    for r in range(n_runs):
+        b = store.builder()
+        for i in range(keys_per_run):
+            b.write(dump_record(f"w{r:02d}{i:06d}", [1]) + "\n")
+        b.build(f"run.{r}")
+        names.append(f"run.{r}")
+
+    t0 = time.perf_counter()
+    n_py = sum(1 for _ in merge_iterator(store, names))
+    py_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    n_nat = sum(1 for _ in native_merge.native_merge_records(store, names))
+    nat_s = time.perf_counter() - t0
+    assert n_py == n_nat == n_runs * keys_per_run
+    return {"python_s": round(py_s, 3), "native_s": round(nat_s, 3),
+            "speedup_native_vs_python": round(py_s / nat_s, 2),
+            "records": n_py}
+
+
+def main() -> None:
+    from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
+    force_cpu_if_unavailable()
+
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = jax.default_backend() == "tpu"
+    results = {
+        "device_kind": jax.devices()[0].device_kind,
+        "on_tpu": on_tpu,
+        "native_merge_16x50k": bench_native_merge(),
+    }
+    if on_tpu:
+        bf16 = jnp.bfloat16
+        cases = {
+            # MXU-scale matmuls (the APRIL-ANN axpy/matrix role)
+            "matmul_1024_bf16": lambda: bench_matmul(1024, 1024, 1024, bf16),
+            "matmul_4096_bf16": lambda: bench_matmul(4096, 4096, 4096, bf16),
+            "matmul_8192_bf16": lambda: bench_matmul(8192, 8192, 8192, bf16),
+            # LeNet-5/CIFAR-10 body conv (BASELINE.json config 3)
+            "conv_lenet_c1_b256": lambda: bench_conv2d(256, 32, 32, 3, 32,
+                                                       5, 1, bf16),
+            # ResNet-18 block conv at 56x56 (BASELINE.json config 4)
+            "conv_resnet_56_b64": lambda: bench_conv2d(64, 56, 56, 64, 64,
+                                                       3, 1, bf16),
+            # transformer attention (long-context path)
+            "flash_s2048_h8_d128_causal": lambda: bench_flash(
+                4, 8, 2048, 128, True, bf16),
+            "flash_s4096_h8_d128_causal": lambda: bench_flash(
+                2, 8, 4096, 128, True, bf16),
+            # vocab-wide rows need short blocks to fit scoped VMEM
+            "log_softmax_8192x32768": lambda: bench_softmax(
+                8192, 32768, bf16, block_rows=64),
+            "maxpool_b256_64x64x32": lambda: bench_pool(256, 64, 64, 32,
+                                                        bf16),
+        }
+        for name, fn in cases.items():
+            try:
+                results[name] = fn()
+            except Exception as e:   # record, keep benching the rest
+                results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            print(f"{name}: {results[name]}", file=sys.stderr)
+    else:
+        results["note"] = ("no TPU visible: Pallas kernels only lower on "
+                          "TPU; op benches skipped (interpreter timings "
+                          "would be meaningless)")
+    print(json.dumps(results, indent=1))
+    os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+    with open(RESULTS, "w") as f:
+        json.dump(results, f, indent=1)
+        f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-sleep", type=float, default=20.0)
     p.add_argument("--max-tasks", type=int, default=1)
     p.add_argument("--name")
+    p.add_argument("--phases", default="map,reduce",
+                   help="comma list of phases this worker claims "
+                        "(heterogeneous pools: dedicated mapper hosts "
+                        "pass 'map', reducer hosts 'reduce')")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -35,10 +39,14 @@ def main(argv=None) -> int:
     from lua_mapreduce_tpu.coord.filestore import FileJobStore
     from lua_mapreduce_tpu.engine.worker import Worker
 
+    phases = tuple(s.strip() for s in args.phases.split(",") if s.strip())
+    for ph in phases:
+        if ph not in ("map", "reduce"):
+            raise SystemExit(f"--phases: unknown phase {ph!r}")
     store = FileJobStore(args.coord)
     worker = Worker(store, name=args.name, verbose=args.verbose).configure(
         max_iter=args.max_iter, max_sleep=args.max_sleep,
-        max_tasks=args.max_tasks)
+        max_tasks=args.max_tasks, phases=phases)
     worker.execute()
     return 0
 

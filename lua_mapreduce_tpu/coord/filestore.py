@@ -294,6 +294,17 @@ class FileJobStore(JobStore):
             doc["worker"] = wname
         return doc
 
+    def job_workers(self, ns):
+        """id → worker from the w-sidecars alone — no payload reads, no
+        deep copies (the server calls this once per reduce prepare)."""
+        out = {}
+        idx = self._idx(ns)
+        for jid in range(idx.count()):
+            wname = _read_json_text(self._wname(ns, jid))
+            if wname:
+                out[jid] = wname
+        return out
+
     def set_job_times(self, ns, job_id, times):
         _atomic_write_json(self._times(ns, job_id), dict(times))
 

@@ -84,6 +84,18 @@ class JobStore(abc.ABC):
     @abc.abstractmethod
     def jobs(self, ns: str) -> List[dict]: ...
 
+    def job_workers(self, ns: str) -> Dict[int, str]:
+        """job id → claiming worker name, for jobs a worker has touched.
+        Lightweight producer lookup (server.lua:286-289 queries map jobs
+        for hostnames): the default walks jobs(); file-backed stores
+        override to read just the worker sidecars, skipping the payload
+        deep-copies."""
+        out = {}
+        for doc in self.jobs(ns):
+            if isinstance(doc.get("worker"), str):
+                out[int(doc["_id"])] = doc["worker"]
+        return out
+
     @abc.abstractmethod
     def set_job_times(self, ns: str, job_id: int, times: dict) -> None:
         """Record per-job timing for stats (job.lua:117-152)."""

@@ -247,10 +247,8 @@ class Server:
         the host that produced it."""
         self.store.drop_ns(RED_NS)
         parts = discover_partitions(store, self.spec.result_ns)
-        producer_by_id = {}
-        for doc in self.store.jobs(MAP_NS):
-            if isinstance(doc.get("worker"), str):
-                producer_by_id[str(doc["_id"])] = doc["worker"]
+        producer_by_id = {str(jid): w
+                         for jid, w in self.store.job_workers(MAP_NS).items()}
         docs = []
         for part, files in sorted(parts.items()):
             mappers = set()

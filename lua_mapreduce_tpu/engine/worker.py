@@ -67,8 +67,9 @@ class Worker:
 
     def poll_once(self) -> str:
         """One discovery+claim+execute round. Returns what happened:
-        "wait" (no task yet), "idle" (nothing claimable), "executed",
-        or "finished" (task is done)."""
+        "wait" (no task yet), "idle" (nothing claimable), "out-of-phase"
+        (a phase this worker doesn't claim — phase-restricted pools),
+        "executed", or "finished" (task is done)."""
         task = self.store.get_task()
         if task is None or task.get("status") == TaskStatus.WAIT.value:
             return "wait"
@@ -80,7 +81,7 @@ class Worker:
 
         if task["status"] == TaskStatus.MAP.value:
             if "map" not in self.phases:
-                return "idle"
+                return "out-of-phase"
             preferred = self._affinity if iteration > 1 else None
             steal = not preferred or self._idle_count >= MAX_IDLE_COUNT
             job = self.store.claim(MAP_NS, self.name, preferred, steal=steal)
@@ -93,7 +94,7 @@ class Worker:
 
         if task["status"] == TaskStatus.REDUCE.value:
             if "reduce" not in self.phases:
-                return "idle"
+                return "out-of-phase"
             job = self.store.claim(RED_NS, self.name)
             if job is None:
                 return "idle"
@@ -129,8 +130,12 @@ class Worker:
             # through the storage backend BEFORE the merge starts. A
             # missing run fails loudly and names its producer (the sshfs
             # scp-from-mapper failure mode, fs.lua:148-157) instead of
-            # silently reducing fewer runs.
-            missing = [f for f in v["files"] if not store.exists(f)]
+            # silently reducing fewer runs. One LIST round trip — a
+            # per-file exists() would serialize object-store latency
+            # across the whole fan-in.
+            visible = set(store.list(
+                f"{spec.result_ns}.P{v['part']}.M*"))
+            missing = [f for f in v["files"] if f not in visible]
             if missing:
                 raise RuntimeError(
                     f"reduce {v['part']}: {len(missing)} run file(s) not "
@@ -200,6 +205,12 @@ class Worker:
             elif outcome == "finished" and saw_work:
                 tasks_done += 1
                 saw_work = False
+            elif outcome == "out-of-phase":
+                # a phase-restricted worker waiting out the other phase
+                # (a dedicated reducer during a long map) must NOT burn
+                # its idle budget and die before its phase ever opens
+                time.sleep(sleep)
+                sleep = min(sleep * 1.5, self.max_sleep)
             else:
                 idle_iters += 1
                 time.sleep(sleep)

@@ -44,6 +44,12 @@ def _rowwise_pallas(x, kernel, block_rows=256, interpret=False):
     m = x2.shape[0]
 
     block_rows = min(block_rows, max(8, -(-m // 8) * 8))
+    # VMEM guard: the kernel holds the block in f32 plus temps (~7 B/elem
+    # with a ~11MB fixed overhead against the 16MB scoped budget, measured
+    # on v5e at widths 8k-32k) — clamp rows so vocab-wide inputs (32k
+    # logits) compile instead of OOMing scoped vmem
+    fit = (5_000_000 // (7 * n)) // 8 * 8
+    block_rows = max(8, min(block_rows, fit))
     pm, pn = -m % block_rows, -n % 128
     # column padding must not perturb the row max/sum → pad with -inf
     if pm or pn:

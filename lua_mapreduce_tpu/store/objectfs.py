@@ -93,8 +93,11 @@ class ObjectStore(Store):
 
     def list(self, pattern: str) -> List[str]:
         if self._gcs is not None:
-            names = [b.name[len(self._prefix) + 1 if self._prefix else 0:]
-                     for b in self._gcs.list_blobs(prefix=self._prefix)]
+            # prefix must include the separator: a bare "inter" would
+            # also match sibling "inter2/..." blobs and mangle their names
+            pfx = f"{self._prefix}/" if self._prefix else ""
+            names = [b.name[len(pfx):]
+                     for b in self._gcs.list_blobs(prefix=pfx or None)]
         else:
             names = [_decode(f) for f in os.listdir(self._dir)
                      if not f.startswith(".put.")]

@@ -155,6 +155,21 @@ def test_gcs_branch_roundtrip(fake_gcs):
     assert store.list("*") == []
 
 
+def test_gcs_sibling_prefixes_do_not_leak(fake_gcs):
+    """list() under prefix "inter" must not surface blobs of sibling
+    prefix "inter2" with mangled names (code-review r2 finding: the raw
+    string prefix matched both)."""
+    from lua_mapreduce_tpu.store.objectfs import ObjectStore
+
+    s1 = ObjectStore("gs://bkt/inter")
+    s2 = ObjectStore("gs://bkt/inter2")
+    b = s1.builder(); b.write("one\n"); b.build("a.P0.M0")
+    b = s2.builder(); b.write("two\n"); b.build("a.P0.M1")
+    assert s1.list("*") == ["a.P0.M0"]
+    assert s2.list("*") == ["a.P0.M1"]
+    assert list(s1.lines("a.P0.M0")) == ["one\n"]
+
+
 def test_gcs_branch_end_to_end_wordcount(fake_gcs):
     """Whole engine run with intermediate spill through the mocked
     gs:// bucket — fails if the object path silently degrades to local
