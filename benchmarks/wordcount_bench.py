@@ -39,6 +39,24 @@ def corpus_hash(corpus_dir: str, n_splits: int) -> str:
     return h.hexdigest()[:16]
 
 
+def _native_map_active(corpus_dir: str) -> bool:
+    """True only if the native kernel ACTUALLY serves this corpus: run
+    one real native map over split 0 into a scratch store (the runtime
+    gate also checks store type, input presence, and ASCII content —
+    availability alone would mislabel the artifact's provenance)."""
+    from examples.wordcount_big import bigtask, corpus
+    from lua_mapreduce_tpu.core import native_wcmap
+    from lua_mapreduce_tpu.store.sharedfs import SharedStore
+
+    tag = getattr(bigtask.mapfn, "native_map", None)
+    if tag is None or not native_wcmap.native_available():
+        return False
+    scratch = tempfile.mkdtemp(prefix="wcb-nmprobe")
+    return native_wcmap.run_native_map(
+        SharedStore(scratch), tag, corpus.split_path(corpus_dir, 0),
+        "probe", "0")
+
+
 def run(n_workers: int = 4, corpus_dir: str = "/tmp/wc_corpus") -> dict:
     from examples.wordcount_big import corpus
     from lua_mapreduce_tpu.coord.filestore import FileJobStore
@@ -102,6 +120,7 @@ def run(n_workers: int = 4, corpus_dir: str = "/tmp/wc_corpus") -> dict:
         "num_reducers": bigtask.NUM_REDUCERS,
         "combiner": "map-side Counter fold (one record per distinct word)",
         "native_merge": native_merge.native_available(),
+        "native_map": _native_map_active(corpus_dir),
         "corpus_hash": corpus_hash(corpus_dir, corpus.N_SPLITS),
         "corpus": {"splits": corpus.N_SPLITS,
                    "words": corpus.total_words()},

@@ -43,6 +43,12 @@ def mapfn(key, value, emit):
         emit(word, n)
 
 
+# declared-intent native fast path (core/native_wcmap.py): one C++ pass
+# computing exactly mapfn+partitionfn below; engine golden-diffs the two
+mapfn.native_map = {"kind": "wordcount_file",
+                    "num_reducers": NUM_REDUCERS, "hash_prefix": 4}
+
+
 def partitionfn(key):
     return sum(key[:4].encode()) % NUM_REDUCERS
 

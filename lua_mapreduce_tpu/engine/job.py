@@ -79,6 +79,19 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
     times = JobTimes(started=time.time())
     cpu0 = time.process_time()
 
+    # declared-intent native fast path: a mapfn tagged ``native_map``
+    # promises the C++ kernel computes exactly what mapfn+partitionfn
+    # would (core/native_wcmap.py); golden-diffed against the Python
+    # path, which remains the semantic truth and the fallback
+    native = getattr(spec.mapfn, "native_map", None)
+    if native is not None and native.get("kind") == "wordcount_file":
+        from lua_mapreduce_tpu.core.native_wcmap import run_native_map
+        if run_native_map(store, native, str(map_value), spec.result_ns,
+                          job_id):
+            times.cpu = time.process_time() - cpu0
+            times.finished = times.written = time.time()
+            return times
+
     result: Dict[Any, List[Any]] = {}
     combiner = spec.combiner_for_map
     emit = make_map_emit(result, combiner)
