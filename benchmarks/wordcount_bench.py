@@ -52,9 +52,14 @@ def _native_map_active(corpus_dir: str) -> bool:
     if tag is None or not native_wcmap.native_available():
         return False
     scratch = tempfile.mkdtemp(prefix="wcb-nmprobe")
-    return native_wcmap.run_native_map(
-        SharedStore(scratch), tag, corpus.split_path(corpus_dir, 0),
-        "probe", "0")
+    try:
+        return native_wcmap.run_native_map(
+            SharedStore(scratch), tag, corpus.split_path(corpus_dir, 0),
+            "probe", "0")
+    except OSError:
+        # probe trouble must not discard the already-measured run —
+        # label provenance unconfirmed instead
+        return False
 
 
 def run(n_workers: int = 4, corpus_dir: str = "/tmp/wc_corpus") -> dict:

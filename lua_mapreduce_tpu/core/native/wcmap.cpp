@@ -26,6 +26,9 @@
 // C ABI: wc_map_file(input, out_tmp_paths, out_final_paths, n_reducers,
 // hash_prefix) -> 0 ok, 1 I/O error, 2 fall back to Python.
 
+#include <fcntl.h>
+#include <unistd.h>
+
 #include <algorithm>
 #include <cstdint>
 #include <cstdio>
@@ -136,6 +139,14 @@ extern "C" int wc_map_file(const char* input_path,
         out.flush();
         if (!out.good()) return 1;
         out.close();
+        // fsync before rename — the Python builder's durability
+        // discipline (store/sharedfs.py flush+fsync+replace): without it
+        // a crash can durably publish a truncated run under its final
+        // name and the reducer would silently merge it
+        int fd = ::open(out_tmp_paths[p], O_RDONLY);
+        if (fd < 0) return 1;
+        if (::fsync(fd) != 0) { ::close(fd); return 1; }
+        ::close(fd);
         if (std::rename(out_tmp_paths[p], out_final_paths[p]) != 0) return 1;
     }
     return 0;

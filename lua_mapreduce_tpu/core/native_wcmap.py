@@ -65,6 +65,9 @@ def run_native_map(store, spec_native: dict, input_path: str,
         return False
     n_red = int(spec_native["num_reducers"])
     prefix = int(spec_native.get("hash_prefix", 4))
+    if n_red <= 0 or prefix < 0:
+        # C++ would SIGFPE on % 0 — let the Python path raise cleanly
+        return False
 
     # Publish discipline mirrors the Python path exactly: UNIQUE tmp
     # names (a stale-requeued twin of this job running concurrently must

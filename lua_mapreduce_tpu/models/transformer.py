@@ -58,6 +58,13 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_capacity: int = 0
     moe_aux_weight: float = 0.01
+    # rematerialization: recompute each block in the backward pass
+    # instead of saving its activations — trades ~1/3 more FLOPs for
+    # O(n_layers) less activation HBM, the standard long-context lever
+    # (activations dominate HBM at large L; the MXU has FLOPs to spare).
+    # Applies to every execution form (oracle, sp, 3-D) since they share
+    # _forward.
+    remat: bool = False
 
     @staticmethod
     def tiny() -> "TransformerConfig":
@@ -177,7 +184,15 @@ def _forward(params: Params, tokens, pos, cfg: TransformerConfig,
     x = params["tok_emb"][tokens] + params["pos_emb"][pos]
     aux_total = 0.0
     for i in range(cfg.n_layers):
-        x, aux = block(params, i, x, cfg, attn_fn)
+        if cfg.remat:
+            # checkpoint boundary = one decoder block (collectives inside
+            # sp/tp blocks are re-executed in the backward — the usual
+            # ring-attention remat shape)
+            def run_block(p, xx, _i=i):
+                return block(p, _i, xx, cfg, attn_fn)
+            x, aux = jax.checkpoint(run_block)(params, x)
+        else:
+            x, aux = block(params, i, x, cfg, attn_fn)
         aux_total = aux_total + aux
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
     return x @ params["tok_emb"].T, aux_total           # tied head

@@ -46,6 +46,11 @@ class TrainConfig:
     max_epochs: int = 40            # init.lua max epochs
     patience: int = 10              # train_holdout_validation analog
     seed: int = 1234
+    # device-side tracing (the SURVEY §5 tracing subsystem's hot-path
+    # half — JobTimes covers the host engine): when set, the SECOND
+    # run_epoch call (the first is compile-skewed) is captured with
+    # jax.profiler.trace into this directory, viewable in XProf
+    profile_dir: Optional[str] = None
 
 
 class DataParallelTrainer:
@@ -77,6 +82,7 @@ class DataParallelTrainer:
         self._step = self._build_step()
         self._epoch = self._build_epoch()
         self._steps_cache: Dict[int, Callable] = {}
+        self._epoch_calls = 0
 
     # -- jitted single step -------------------------------------------------
 
@@ -169,6 +175,13 @@ class DataParallelTrainer:
         xs = x[order].reshape(-1, c.batch_size, *x.shape[1:])
         ys = y[order].reshape(-1, c.batch_size, *y.shape[1:])
         xs, ys = self._shard_batch(xs, ys, batched=True)
+        self._epoch_calls += 1
+        if c.profile_dir is not None and self._epoch_calls == 2:
+            with jax.profiler.trace(c.profile_dir):
+                self.params, self.opt_state, losses = self._epoch(
+                    self.params, self.opt_state, xs, ys)
+                loss = float(jnp.mean(losses))   # force inside the trace
+            return loss
         self.params, self.opt_state, losses = self._epoch(
             self.params, self.opt_state, xs, ys)
         return float(jnp.mean(losses))
