@@ -16,6 +16,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -60,6 +61,8 @@ def _native_map_active(corpus_dir: str) -> bool:
         # probe trouble must not discard the already-measured run —
         # label provenance unconfirmed instead
         return False
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
 
 
 def run(n_workers: int = 4, corpus_dir: str = "/tmp/wc_corpus") -> dict:

@@ -14,7 +14,12 @@ _files = None
 
 def init(args):
     global _files
-    _files = args.get("files")
+    files = args.get("files")
+    if isinstance(files, str):
+        # CLI --init-arg values are strings; accept a pathsep-joined
+        # list (the execute_example_server.sh role, SURVEY.md §2.2)
+        files = [f for f in files.split(os.pathsep) if f]
+    _files = files
 
 
 def taskfn(emit):

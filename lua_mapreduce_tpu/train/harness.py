@@ -18,6 +18,7 @@ Mapping to the reference example:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -176,15 +177,13 @@ class DataParallelTrainer:
         ys = y[order].reshape(-1, c.batch_size, *y.shape[1:])
         xs, ys = self._shard_batch(xs, ys, batched=True)
         self._epoch_calls += 1
-        if c.profile_dir is not None and self._epoch_calls == 2:
-            with jax.profiler.trace(c.profile_dir):
-                self.params, self.opt_state, losses = self._epoch(
-                    self.params, self.opt_state, xs, ys)
-                loss = float(jnp.mean(losses))   # force inside the trace
-            return loss
-        self.params, self.opt_state, losses = self._epoch(
-            self.params, self.opt_state, xs, ys)
-        return float(jnp.mean(losses))
+        trace = (jax.profiler.trace(c.profile_dir)
+                 if c.profile_dir is not None and self._epoch_calls == 2
+                 else contextlib.nullcontext())
+        with trace:
+            self.params, self.opt_state, losses = self._epoch(
+                self.params, self.opt_state, xs, ys)
+            return float(jnp.mean(losses))   # forced inside the trace
 
     def _shard_batch(self, x, y, batched: bool = False):
         dim = 1 if batched else 0

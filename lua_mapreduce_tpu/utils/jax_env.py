@@ -95,6 +95,14 @@ def force_cpu_if_unavailable(timeout_s: float = 120.0) -> str:
     killable subprocess), pin this process to CPU. Returns the platform
     chosen. Safe whether or not jax is already imported, as long as no
     backend has been initialized yet in this process."""
+    # already pinned to CPU (test conftest, an earlier fallback, or the
+    # environment)? — nothing to probe, and probing would burn the full
+    # subprocess timeout against a wedged tunnel for no decision
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return "cpu"
+    j = sys.modules.get("jax")
+    if j is not None and getattr(j.config, "jax_platforms", None) == "cpu":
+        return "cpu"
     if probe_backend(timeout_s):
         return "accelerator"
     print("[jax_env] accelerator backend unreachable; running on CPU",

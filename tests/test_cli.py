@@ -1,0 +1,85 @@
+"""CLI launchers (cli/execute_server, cli/execute_worker,
+cli/remove_results): the reference's L7 layer (execute_server.lua,
+execute_worker.lua, remove_results.sh — SURVEY.md §2.2) driven
+end-to-end in-process."""
+
+import glob
+import os
+
+import pytest
+
+from examples.wordcount.naive import naive_wordcount
+from lua_mapreduce_tpu.cli import (execute_server, execute_worker,
+                                   remove_results)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = sorted(glob.glob(os.path.join(REPO, "examples", "wordcount",
+                                       "[a-z]*.py")))
+
+
+def test_execute_server_inline_workers(tmp_path, capsys):
+    """Full wordcount through the server CLI with an in-process pool,
+    slash-path module normalization included (execute_server.lua:37-39)."""
+    import examples.wordcount.finalfn as finalfn
+    finalfn.counts.clear()
+    # taskfn reads files from init args
+    rc = execute_server.main([
+        "mem",
+        "examples/wordcount/taskfn.py",
+        "examples/wordcount/mapfn",
+        "examples.wordcount.partitionfn",
+        "examples.wordcount.reducefn",
+        "--finalfn", "examples.wordcount.finalfn",
+        "--inline-workers", "2",
+        "--poll", "0.02",
+        "--init-arg", f"files={os.pathsep.join(CORPUS)}",
+        "--quiet",
+    ])
+    assert rc == 0
+    golden = naive_wordcount(CORPUS)
+    assert dict(finalfn.counts) == golden
+
+
+def test_execute_worker_rejects_bad_phase():
+    with pytest.raises(SystemExit):
+        execute_worker.main(["/tmp/nowhere", "--phases", "bogus"])
+
+
+def test_execute_server_strict_flag_parses():
+    args = execute_server.build_parser().parse_args(
+        ["mem", "a", "b", "c", "d", "--strict"])
+    assert args.strict is True
+
+
+def test_remove_results_drops_store_and_files(tmp_path):
+    from lua_mapreduce_tpu.coord.filestore import FileJobStore
+    from lua_mapreduce_tpu.coord.jobstore import make_job
+    from lua_mapreduce_tpu.store.router import get_storage_from
+
+    coord = str(tmp_path / "coord")
+    spill = str(tmp_path / "spill")
+    store = FileJobStore(coord)
+    store.insert_jobs("map_jobs", [make_job("k", 1)])
+    store.put_task({"_id": "unique", "status": "MAP", "spec": {}})
+    data = get_storage_from(f"shared:{spill}")
+    b = data.builder()
+    b.write("x\n")
+    b.build("result.P0")
+
+    rc = remove_results.main([coord, "--storage", f"shared:{spill}",
+                              "--yes"])
+    assert rc == 0
+    assert store.get_task() is None
+    assert sum(store.counts("map_jobs").values()) == 0
+    assert data.list("result.P*") == []
+
+
+def test_remove_results_aborts_without_confirmation(tmp_path, monkeypatch):
+    monkeypatch.setattr("builtins.input", lambda *_: "n")
+    coord = str(tmp_path / "coord")
+    from lua_mapreduce_tpu.coord.filestore import FileJobStore
+    FileJobStore(coord).put_task({"_id": "unique", "status": "MAP",
+                                  "spec": {}})
+    rc = remove_results.main([coord])
+    assert rc == 1
+    assert FileJobStore(coord).get_task() is not None
