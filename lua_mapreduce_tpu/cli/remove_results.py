@@ -36,10 +36,13 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if not args.yes:
-        reply = input(f"drop task state in {args.coord!r}"
-                      + (f" and files under {args.storage!r}"
-                         if args.storage else "")
-                      + "? [y/N] ")
+        try:
+            reply = input(f"drop task state in {args.coord!r}"
+                          + (f" and files under {args.storage!r}"
+                             if args.storage else "")
+                          + "? [y/N] ")
+        except (EOFError, KeyboardInterrupt):
+            reply = ""          # no TTY (cron/CI without --yes) = no
         if reply.strip().lower() not in ("y", "yes"):
             print("aborted", file=sys.stderr)
             return 1
