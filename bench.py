@@ -117,8 +117,10 @@ def bench_mfu_wide(sizes=None, batch: int = None, steps: int = 20):
     best_dt = best_time(lambda: np.asarray(tr.run_steps(x, y, steps)))
 
     model_flops = steps * batch * n_chips * flops_per_example(sizes)
+    config = (f"mlp {'x'.join(str(s) for s in sizes)} bf16 "
+              f"batch={batch} {steps}-step fused scan")
     return (mfu(model_flops, best_dt, n_chips),
-            model_flops / best_dt / n_chips)
+            model_flops / best_dt / n_chips, config)
 
 
 def bench_mapreduce_path(iterations: int = 3) -> float:
@@ -161,7 +163,7 @@ def main() -> None:
     mr_total = bench_mapreduce_path()
     peak = peak_flops_per_s()
     mfu_digits = mfu(native_per_chip * flops_per_example(DIGITS_SIZES), 1.0)
-    mfu_wide, wide_flops = bench_mfu_wide()
+    mfu_wide, wide_flops, mfu_config = bench_mfu_wide()
     print(json.dumps({
         "metric": "digits_mlp_dp_training_images_per_sec_per_chip",
         "value": round(native_per_chip, 1),
@@ -175,8 +177,7 @@ def main() -> None:
         # array — its honest MFU is tiny; mfu is the same training hot
         # loop on an MXU-sized model (8192-square bf16 matmuls).
         "mfu": round(mfu_wide, 4),
-        "mfu_config": "mlp 8192x8192x8192x8192 bf16 batch=8192 "
-                      "20-step fused scan",
+        "mfu_config": mfu_config,
         "mfu_achieved_flops_per_s_per_chip": round(wide_flops, 1),
         "mfu_digits_mlp": round(mfu_digits, 6),
         "peak_bf16_flops_per_s": peak,

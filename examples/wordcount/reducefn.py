@@ -14,6 +14,10 @@ def reducefn(key, values):
     return sum(values)
 
 
+# declared intent: the fold is integer sum, so the engine may fuse the
+# reduce into the native merge pass (core/native_merge.py)
+reducefn.native_reduce = "sum"
+
 # the combiner is the same fold (reference uses reducefn as combinerfn in
 # the combiner config of test.sh)
 combinerfn = reducefn

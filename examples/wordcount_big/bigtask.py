@@ -57,6 +57,9 @@ def reducefn(key, values):
     return sum(values)
 
 
+# declared intent: this fold IS integer sum — the engine may fuse it
+# into the native merge pass (core/native_merge.native_merge_reduce_sum)
+reducefn.native_reduce = "sum"
 reducefn.associative_reducer = True
 reducefn.commutative_reducer = True
 reducefn.idempotent_reducer = False

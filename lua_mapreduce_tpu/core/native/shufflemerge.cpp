@@ -19,6 +19,13 @@
 // C ABI (ctypes): smerge_files(inputs, n, output) -> 0 ok, 1 I/O error,
 // 2 parse error. The output file is written directly; the Python caller
 // owns tmp+rename atomicity (the fs.lua:80-115 discipline).
+//
+// smerge_fold_sum(inputs, n, output) additionally FOLDS each merged
+// group: when a task's reducefn is declared ``native_reduce = "sum"``
+// (associative+commutative integer sum — the wordcount/grad-count
+// shape), the merge emits ["key",[<sum>]] directly, fusing the reduce
+// into the merge pass. Any non-integer value or int64 overflow returns
+// rc=2 so the Python reducefn (arbitrary precision) stays the truth.
 
 #include <cerrno>
 #include <cmath>
@@ -349,10 +356,53 @@ struct HeapCmp {
     }
 };
 
+// Accumulate every integer token inside a raw values span ("1,-2,3")
+// into total. Returns false (→ rc=2 fallback) on any non-integer token
+// or int64 overflow — Python's arbitrary-precision sum owns those.
+bool fold_span_sum(const std::string& span, long long& total) {
+    const char* p = span.c_str();
+    while (true) {
+        skip_ws(p);
+        if (!*p) return true;
+        bool neg = false;
+        if (*p == '-') { neg = true; ++p; }
+        if (*p < '0' || *p > '9') return false;
+        long long v = 0;
+        while (*p >= '0' && *p <= '9') {
+            if (__builtin_mul_overflow(v, 10LL, &v) ||
+                __builtin_add_overflow(v, (long long)(*p - '0'), &v))
+                return false;
+            ++p;
+        }
+        if (*p == '.' || *p == 'e' || *p == 'E') return false;  // float
+        if (neg) v = -v;
+        if (__builtin_add_overflow(total, v, &total)) return false;
+        skip_ws(p);
+        if (*p == ',') { ++p; continue; }
+        if (!*p) return true;
+        return false;                   // strings/arrays/objects
+    }
+}
+
+int smerge_core(const char** inputs, int n_inputs, const char* output,
+                int fold_sum);
+
 }  // namespace
 
 extern "C" int smerge_files(const char** inputs, int n_inputs,
                             const char* output) {
+    return smerge_core(inputs, n_inputs, output, 0);
+}
+
+extern "C" int smerge_fold_sum(const char** inputs, int n_inputs,
+                               const char* output) {
+    return smerge_core(inputs, n_inputs, output, 1);
+}
+
+namespace {
+
+int smerge_core(const char** inputs, int n_inputs, const char* output,
+                int fold_sum) {
     std::vector<Run*> runs;
     runs.reserve((size_t)n_inputs);
     for (int i = 0; i < n_inputs; ++i) {
@@ -388,14 +438,27 @@ extern "C" int smerge_files(const char** inputs, int n_inputs,
             // concatenate in run-file order (deterministic reduce
             // inputs, matching core/merge.py's contract)
             std::sort(drained.begin(), drained.end());
-            std::string merged;
-            for (int j : drained) {
-                if (runs[(size_t)j]->vals_raw.empty()) continue;
-                if (!merged.empty()) merged += ',';
-                merged += runs[(size_t)j]->vals_raw;
+            if (fold_sum) {
+                long long total = 0;
+                for (int j : drained) {
+                    if (!fold_span_sum(runs[(size_t)j]->vals_raw, total)) {
+                        rc = 2;
+                        break;
+                    }
+                }
+                if (rc) break;
+                out << '[' << runs[(size_t)first]->key_raw << ",["
+                    << total << "]]\n";
+            } else {
+                std::string merged;
+                for (int j : drained) {
+                    if (runs[(size_t)j]->vals_raw.empty()) continue;
+                    if (!merged.empty()) merged += ',';
+                    merged += runs[(size_t)j]->vals_raw;
+                }
+                out << '[' << runs[(size_t)first]->key_raw << ",["
+                    << merged << "]]\n";
             }
-            out << '[' << runs[(size_t)first]->key_raw << ",[" << merged
-                << "]]\n";
             for (int j : drained) {
                 int st = runs[(size_t)j]->advance();
                 if (st == 0) heap.push(j);
@@ -410,3 +473,5 @@ extern "C" int smerge_files(const char** inputs, int n_inputs,
     for (Run* r : runs) delete r;
     return rc;
 }
+
+}  // namespace

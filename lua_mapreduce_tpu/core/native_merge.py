@@ -37,9 +37,10 @@ _SO = os.path.join(_NATIVE_DIR, "libshufflemerge.so")
 def _load() -> Optional[ctypes.CDLL]:
     lib = load_native(_SRC, _SO)
     if lib is not None and not hasattr(lib.smerge_files, "_configured"):
-        lib.smerge_files.restype = ctypes.c_int
-        lib.smerge_files.argtypes = [
-            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_char_p]
+        for fn in (lib.smerge_files, lib.smerge_fold_sum):
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                           ctypes.c_char_p]
         lib.smerge_files._configured = True
     return lib
 
@@ -62,6 +63,55 @@ def merge_paths(paths: Sequence[str], out_path: str) -> None:
         raise OSError(f"native merge I/O error over {list(paths)}")
     if rc:
         raise ValueError(f"native merge parse error over {list(paths)}")
+
+
+def native_merge_reduce_sum(store, filenames: Sequence[str],
+                            result_store, result_file: str) -> bool:
+    """Fused merge+reduce: fold every merged group with an int64 sum IN
+    the C++ pass and publish the partition result file directly — the
+    whole reduce job in one native pass, for reducers declared
+    ``native_reduce = "sum"`` (run_reduce_job gates on the ACI flags
+    too). Returns False when the native path can't serve it (non-local
+    stores, toolchain, non-integer values, int64 overflow) — the caller
+    falls back to the Python merge+fold, which is the semantic truth.
+    """
+    src_path = getattr(store, "local_path", None)
+    dst_path = getattr(result_store, "local_path", None)
+    dst_dir = getattr(result_store, "path", None)
+    if src_path is None or dst_path is None or dst_dir is None \
+            or not native_available():
+        return False
+    paths = []
+    for name in filenames:
+        p = src_path(name)
+        if not os.path.exists(p):
+            return False
+        paths.append(p)
+
+    lib = _load()
+    fd, tmp = tempfile.mkstemp(prefix=".tmp.redsum.", suffix=".jsonl",
+                               dir=dst_dir)
+    os.close(fd)
+    arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+    rc = lib.smerge_fold_sum(arr, len(paths), tmp.encode())
+    if rc:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        # rc=1 I/O (e.g. a run deleted by a duplicate job between the
+        # exists() precheck and the C++ open) and rc=2 shape fallback
+        # both route to the Python fold — ANY native failure falls back,
+        # the module's contract
+        return False
+    # builder durability discipline: fsync before the atomic publish
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, dst_path(result_file))
+    return True
 
 
 def native_merge_records(store, filenames: Sequence[str]

@@ -57,18 +57,25 @@ def _load_fn(spec: Any, fname: str) -> _Loaded:
     if isinstance(spec, dict):
         if fname not in spec:
             raise TypeError(f"module dict for {fname!r} has no {fname!r} entry")
+        fn = spec[fname]
         return _Loaded(
-            fn=spec[fname], module=_DictKey(spec),
+            fn=fn, module=_DictKey(spec),
             init=spec.get("init"),
-            flags={f: bool(spec.get(f, False)) for f in _FLAGS})
+            flags={f: bool(spec.get(f, getattr(fn, f, False)))
+                   for f in _FLAGS})
     fn = getattr(spec, fname, None)
     if fn is None or not callable(fn):
         raise TypeError(
             f"module {getattr(spec, '__name__', spec)!r} does not define a "
             f"callable {fname!r} (reference contract server.lua:429-445)")
     init = getattr(spec, "init", None)
+    # flags may live on the module (the reference's module-table style,
+    # reducefn.lua:9-13) OR on the function itself (the natural Python
+    # idiom `reducefn.associative_reducer = True`) — honor both, module
+    # value winning when set
     return _Loaded(fn=fn, module=spec, init=init,
-                   flags={f: bool(getattr(spec, f, False)) for f in _FLAGS})
+                   flags={f: bool(getattr(spec, f, getattr(fn, f, False)))
+                          for f in _FLAGS})
 
 
 class _DictKey:

@@ -17,7 +17,8 @@ from typing import Any, Dict, List, Optional
 from lua_mapreduce_tpu.core import tuples
 from lua_mapreduce_tpu.core.constants import MAX_MAP_RESULT
 from lua_mapreduce_tpu.core.merge import merge_iterator
-from lua_mapreduce_tpu.core.native_merge import native_merge_records
+from lua_mapreduce_tpu.core.native_merge import (native_merge_records,
+                                                 native_merge_reduce_sum)
 from lua_mapreduce_tpu.core.serialize import (assert_serializable, dump_record,
                                               sorted_keys)
 from lua_mapreduce_tpu.engine.contract import TaskSpec
@@ -137,9 +138,26 @@ def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
     times = JobTimes(started=time.time())
     cpu0 = time.process_time()
 
-    builder = result_store.builder()
     fast = spec.fast_path
     reducefn = spec.reducefn
+
+    # fully-native reduce: reducers declared ``native_reduce = "sum"``
+    # AND flagged associative+commutative fold inside the C++ merge pass
+    # itself (one native pass for the whole reduce job). Idempotency is
+    # NOT required — unlike the singleton-skip fast path, the fused fold
+    # applies the sum to every value exactly once. The Python fold below
+    # stays the semantic truth and the fallback.
+    if (spec.associative and spec.commutative
+            and getattr(reducefn, "native_reduce", None) == "sum"
+            and native_merge_reduce_sum(store, run_files, result_store,
+                                        result_file)):
+        times.finished = times.written = time.time()
+        times.cpu = time.process_time() - cpu0
+        for name in run_files:
+            store.remove(name)
+        return times
+
+    builder = result_store.builder()
     # native C++ single-pass merge when the runs are local files (shared
     # backend); identical groups to the Python heap merge — golden-diffed
     # in tests/test_native_merge.py

@@ -145,6 +145,105 @@ def test_mixed_int_float_keys_beyond_2p53(tmp_path):
     assert got == want
 
 
+def _write_runs(store, runs):
+    for name, recs in runs.items():
+        _write_run(store, name, recs)
+    return sorted(runs)
+
+
+def test_fold_sum_matches_python_reduce(tmp_path):
+    """Fused merge+sum must publish a result file byte-identical to the
+    Python merge + sum fold + dump_record path."""
+    store = SharedStore(str(tmp_path / "runs"))
+    out_n = SharedStore(str(tmp_path / "out_native"))
+    out_p = SharedStore(str(tmp_path / "out_python"))
+    runs = {
+        "r.0": _sorted_run([("a", [1, 2]), ("b", [3]), ("z", [0]),
+                            (7, [10]), ((1, "k"), [4])]),
+        "r.1": _sorted_run([("a", [5]), ("c", [-2, 2]), (7, [1]),
+                            ((1, "k"), [6])]),
+    }
+    names = _write_runs(store, runs)
+
+    ok = native_merge.native_merge_reduce_sum(store, names, out_n, "res.P0")
+    assert ok
+
+    b = out_p.builder()
+    for k, vs in merge_iterator(store, names):
+        b.write(dump_record(k, [sum(vs)]) + "\n")
+    b.build("res.P0")
+    assert "".join(out_n.lines("res.P0")) == "".join(out_p.lines("res.P0"))
+
+
+@pytest.mark.parametrize("poison", [
+    [("a", [1.5])],                    # float value
+    [("a", ["x"])],                    # string value
+    [("a", [[1, 2]])],                 # nested value
+    [("a", [2 ** 64])],                # > int64
+    [("a", [2 ** 62]), ("a", [2 ** 62, 2 ** 62])],   # overflow on fold
+])
+def test_fold_sum_falls_back_on_non_int64(tmp_path, poison):
+    store = SharedStore(str(tmp_path / "runs"))
+    out = SharedStore(str(tmp_path / "out"))
+    runs = {"r.0": _sorted_run([("a", [1])]), "r.1": poison}
+    names = _write_runs(store, runs)
+    assert native_merge.native_merge_reduce_sum(
+        store, names, out, "res.P0") is False
+    assert out.list("*") == []         # no partial result published
+
+
+def test_fold_sum_reduce_job_end_to_end(tmp_path, monkeypatch):
+    """run_reduce_job routes a native_reduce='sum' + ACI reducer through
+    the fused pass — asserted with a spy, not assumed (a silent gate
+    regression must fail here, not pass vacuously via the Python
+    fallback) — and the result equals the Python engine's."""
+    import sys
+    import types
+
+    from lua_mapreduce_tpu.engine import job as job_mod
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.local import LocalExecutor
+
+    fused_hits = []
+    real = job_mod.native_merge_reduce_sum
+
+    def counting(*a, **k):
+        ok = real(*a, **k)
+        if ok:
+            fused_hits.append(1)
+        return ok
+    monkeypatch.setattr(job_mod, "native_merge_reduce_sum", counting)
+
+    corpus = {"d1": "a b a c a", "d2": "b a d"}
+    results = {}
+    for variant, tag in (("native", "sum"), ("python", None)):
+        mod = types.ModuleType(f"fold_{variant}")
+        mod.taskfn = lambda emit: [emit(k, v) for k, v in corpus.items()]
+        def mapfn(key, value, emit):
+            for w in value.split():
+                emit(w, 1)
+        mod.mapfn = mapfn
+        mod.partitionfn = lambda key: sum(key.encode()) % 3
+        def reducefn(key, values):
+            return sum(values)
+        reducefn.associative_reducer = True
+        reducefn.commutative_reducer = True
+        if tag:
+            reducefn.native_reduce = tag
+        mod.reducefn = reducefn
+        sys.modules[f"fold_{variant}"] = mod
+        spec = TaskSpec(taskfn=f"fold_{variant}", mapfn=f"fold_{variant}",
+                        partitionfn=f"fold_{variant}",
+                        reducefn=f"fold_{variant}",
+                        storage=f"shared:{tmp_path}/sp_{variant}")
+        ex = LocalExecutor(spec)
+        ex.run()
+        results[variant] = {k: v[0] for k, v in ex.results()}
+    assert results["native"] == results["python"] == \
+        {"a": 4, "b": 2, "c": 1, "d": 1}
+    assert fused_hits, "fused native reduce never fired for the tagged task"
+
+
 def test_unparseable_records_fall_back(tmp_path):
     """NaN keys parse on the Python path but not in C++ — the native
     wrapper must return None (fallback), not raise mid-reduce."""
