@@ -65,6 +65,23 @@ def merge_paths(paths: Sequence[str], out_path: str) -> None:
         raise ValueError(f"native merge parse error over {list(paths)}")
 
 
+def _local_run_paths(store, filenames: Sequence[str]
+                     ) -> Optional[List[str]]:
+    """Shared gate for both native entry points: every run must be a
+    local POSIX path and the toolchain must have built. None = caller
+    falls back to the Python path."""
+    local_path = getattr(store, "local_path", None)
+    if local_path is None or not native_available():
+        return None
+    paths = []
+    for name in filenames:
+        p = local_path(name)
+        if not os.path.exists(p):
+            return None
+        paths.append(p)
+    return paths
+
+
 def native_merge_reduce_sum(store, filenames: Sequence[str],
                             result_store, result_file: str) -> bool:
     """Fused merge+reduce: fold every merged group with an int64 sum IN
@@ -75,18 +92,11 @@ def native_merge_reduce_sum(store, filenames: Sequence[str],
     stores, toolchain, non-integer values, int64 overflow) — the caller
     falls back to the Python merge+fold, which is the semantic truth.
     """
-    src_path = getattr(store, "local_path", None)
     dst_path = getattr(result_store, "local_path", None)
     dst_dir = getattr(result_store, "path", None)
-    if src_path is None or dst_path is None or dst_dir is None \
-            or not native_available():
+    paths = _local_run_paths(store, filenames)
+    if paths is None or dst_path is None or dst_dir is None:
         return False
-    paths = []
-    for name in filenames:
-        p = src_path(name)
-        if not os.path.exists(p):
-            return False
-        paths.append(p)
 
     lib = _load()
     fd, tmp = tempfile.mkstemp(prefix=".tmp.redsum.", suffix=".jsonl",
@@ -122,15 +132,9 @@ def native_merge_records(store, filenames: Sequence[str]
     json.dumps emits as bare ``NaN``). The merge runs EAGERLY here so
     every failure mode surfaces as None (caller falls back) rather than
     as an exception mid-reduce."""
-    local_path = getattr(store, "local_path", None)
-    if local_path is None or not native_available():
+    paths = _local_run_paths(store, filenames)
+    if paths is None:
         return None
-    paths = []
-    for name in filenames:
-        p = local_path(name)
-        if not os.path.exists(p):
-            return None
-        paths.append(p)
 
     out_dir = getattr(store, "path", None) or tempfile.gettempdir()
     fd, out = tempfile.mkstemp(prefix=".tmp.merge.", suffix=".jsonl",

@@ -384,6 +384,73 @@ def test_server_resume_after_reduce_phase_restart(tmp_path):
     assert read_count(count_file) == maps_after_first
 
 
+def test_server_resume_mid_map_keeps_written_jobs(tmp_path):
+    """Resume matrix WAIT/MAP branch (server.lua:487-491): a server
+    restarted mid-map keeps WRITTEN map jobs — only the unfinished ones
+    run after the restart, and the result still golden-diffs."""
+    import examples.wordcount.finalfn as finalfn
+    golden = naive_wordcount(CORPUS)
+    count_file = str(tmp_path / "mapcalls")
+    finalfn.counts.clear()
+    spec = TaskSpec(
+        taskfn="examples.wordcount.taskfn",
+        mapfn="examples.wordcount.instrumented",
+        partitionfn="examples.wordcount.partitionfn",
+        reducefn="examples.wordcount.reducefn",
+        finalfn="examples.wordcount.finalfn",
+        init_args={"files": CORPUS, "count_file": count_file},
+        storage="mem:dist-resume-map",
+    )
+    store = MemJobStore()
+
+    # phase 1: the server CRASHES mid-map — its barrier-poll progress
+    # callback raises once half the maps are done, killing loop() for
+    # real (no zombie second controller at reduce time)
+    class _Crash(Exception):
+        pass
+
+    server1 = Server(store, poll_interval=0.02).configure(spec)
+
+    def crash_at_half(phase, frac):
+        if phase == "map" and frac >= 0.5:
+            raise _Crash()
+
+    crashed = threading.Event()
+
+    def run1():
+        try:
+            server1.loop(progress=crash_at_half)
+        except _Crash:
+            crashed.set()
+
+    t = threading.Thread(target=run1, daemon=True)
+    t.start()
+    w = Worker(store, name="early").configure(max_iter=200, max_sleep=0.02)
+    while not crashed.is_set():
+        w.poll_once()
+        time.sleep(0.005)
+        if not t.is_alive() and not crashed.is_set():
+            raise AssertionError("server finished before the crash point")
+    t.join(timeout=10)
+    ran_before_restart = read_count(count_file)
+    assert ran_before_restart >= len(CORPUS) // 2
+
+    # phase 2: restarted server resumes in place (same store = the task
+    # doc checkpoint); a fresh pool completes the remaining jobs
+    server2 = Server(store, poll_interval=0.02).configure(spec)
+    workers = [Worker(store).configure(max_iter=400, max_sleep=0.05)
+               for _ in range(2)]
+    threads = [threading.Thread(target=x.execute, daemon=True)
+               for x in workers]
+    for th in threads:
+        th.start()
+    server2.loop()
+
+    assert dict(finalfn.counts) == golden
+    # every map ran EXACTLY once across the crash boundary
+    assert read_count(count_file) == len(CORPUS)
+
+
 def test_server_rejects_unreachable_storage(tmp_path):
     """Regression: bare 'mem' (private per process) and mem:tag over a
     multi-process FileJobStore would silently produce empty results."""
