@@ -1,0 +1,11 @@
+#!/bin/sh
+# WordCount demo server (reference execute_example_server.sh:1-8 analog):
+# wires the WordCount modules into the generic server launcher; extra
+# args pass through (e.g. --storage shared:/tmp/spill --strict).
+#   usage: ./execute_example_server.sh COORD_DIR [extra args...]
+COORD="${1:?usage: execute_example_server.sh COORD_DIR [args...]}"; shift
+exec python -m lua_mapreduce_tpu.cli.execute_server "$COORD" \
+    examples/wordcount/taskfn examples/wordcount/mapfn \
+    examples/wordcount/partitionfn examples/wordcount/reducefn \
+    --combinerfn examples/wordcount/reducefn \
+    --finalfn examples/wordcount/finalfn "$@"

@@ -1,0 +1,5 @@
+#!/bin/sh
+# WordCount demo worker (reference execute_example_worker.sh:1-2 analog).
+#   usage: ./execute_example_worker.sh COORD_DIR [extra args...]
+COORD="${1:?usage: execute_example_worker.sh COORD_DIR [args...]}"; shift
+exec python -m lua_mapreduce_tpu.cli.execute_worker "$COORD" "$@"

@@ -1,0 +1,11 @@
+#!/bin/sh
+# Europarl-scale demo server (reference execute_BIG_server.sh:1-9 analog):
+# 197-split corpus, single-module task packaging, native map+reduce path.
+#   usage: ./execute_BIG_server.sh COORD_DIR CORPUS_DIR [extra args...]
+COORD="${1:?usage: execute_BIG_server.sh COORD_DIR CORPUS_DIR [args...]}"
+CORPUS="${2:?usage: execute_BIG_server.sh COORD_DIR CORPUS_DIR [args...]}"
+shift 2
+exec python -m lua_mapreduce_tpu.cli.execute_server "$COORD" \
+    examples/wordcount_big/bigtask examples/wordcount_big/bigtask \
+    examples/wordcount_big/bigtask examples/wordcount_big/bigtask \
+    --init-arg "corpus_dir=$CORPUS" "$@"

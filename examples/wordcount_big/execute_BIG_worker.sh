@@ -1,0 +1,6 @@
+#!/bin/sh
+# Europarl-scale demo worker (reference execute_BIG_worker.sh:1-3 analog).
+#   usage: ./execute_BIG_worker.sh COORD_DIR [extra args...]
+COORD="${1:?usage: execute_BIG_worker.sh COORD_DIR [args...]}"; shift
+exec python -m lua_mapreduce_tpu.cli.execute_worker "$COORD" \
+    --max-iter 100000 --max-tasks 100000 "$@"

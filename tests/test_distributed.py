@@ -437,14 +437,7 @@ def test_server_resume_mid_map_keeps_written_jobs(tmp_path):
 
     # phase 2: restarted server resumes in place (same store = the task
     # doc checkpoint); a fresh pool completes the remaining jobs
-    server2 = Server(store, poll_interval=0.02).configure(spec)
-    workers = [Worker(store).configure(max_iter=400, max_sleep=0.05)
-               for _ in range(2)]
-    threads = [threading.Thread(target=x.execute, daemon=True)
-               for x in workers]
-    for th in threads:
-        th.start()
-    server2.loop()
+    _run_pool(store, spec, n_workers=2)
 
     assert dict(finalfn.counts) == golden
     # every map ran EXACTLY once across the crash boundary
