@@ -106,6 +106,18 @@ def poswsum(a):
 want_pos = float(np.sum(gx * np.arange(8)[:, None]))
 assert np.allclose(float(poswsum(x)), want_pos, rtol=1e-6)
 
+# ring ppermute ACROSS the process boundary — the point-to-point
+# collective ring attention rides; shard i's rows must land on shard
+# i+1 (devices 1->2 and 3->0 cross processes here)
+ring = jax.jit(jax.shard_map(
+    lambda a: jax.lax.ppermute(a, "dp", [(i, (i + 1) % 4)
+                                         for i in range(4)]),
+    mesh=mesh, in_specs=P("dp"), out_specs=P("dp")))
+rolled = ring(x)
+want_roll = float(np.sum(np.roll(gx, 2, axis=0) *
+                         np.arange(8)[:, None]))
+assert np.allclose(float(poswsum(rolled)), want_roll, rtol=1e-6)
+
 params, opt_state, loss = step(params, opt_state, x, y)
 # single-process oracle on the full batch must match exactly
 op = init_mlp(jax.random.PRNGKey(0), (8, 6, 3))
@@ -133,41 +145,50 @@ def test_two_process_distributed_training_step(tmp_path):
     import subprocess
     import sys
 
-    s = socket.socket()
-    s.bind(("localhost", 0))
-    port = s.getsockname()[1]
-    s.close()
-
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = str(tmp_path / "mh_worker.py")
     with open(script, "w") as f:
         f.write(_WORKER.format(repo=repo))
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_NUM_CPU_DEVICES")}
-    procs = [subprocess.Popen([sys.executable, script, str(i), str(port)],
-                              env=env, stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT)
-             for i in range(2)]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=240)
-            outs.append(out.decode())
-    except subprocess.TimeoutExpired:
-        # one worker died → its peer blocks in a collective. Kill, REAP,
-        # and surface whatever the workers printed (the actual reason)
-        for p in procs:
-            p.kill()
-        for p in procs:
-            out, _ = p.communicate()
-            outs.append(out.decode())
-        raise AssertionError(
-            "multihost worker timeout; outputs:\n" + "\n---\n".join(outs))
-    finally:
-        for p in procs:
-            if p.poll() is None:
+
+    # bind/close free-port discovery is a TOCTOU race under parallel CI;
+    # retry the whole rendezvous on a fresh port if a worker fails fast
+    for attempt in range(3):
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+        procs = [subprocess.Popen(
+            [sys.executable, script, str(i), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out.decode())
+        except subprocess.TimeoutExpired:
+            # one worker died → its peer blocks in a collective. Kill,
+            # REAP, and surface what the workers printed (the reason)
+            for p in procs:
                 p.kill()
-            p.wait()
+            for p in procs:
+                out, _ = p.communicate()
+                outs.append(out.decode())
+            raise AssertionError(
+                "multihost worker timeout; outputs:\n"
+                + "\n---\n".join(outs))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait()
+        if (any(p.returncode != 0 for p in procs)
+                and any("bind" in o.lower() or "address" in o.lower()
+                        for o in outs) and attempt < 2):
+            continue                     # port stolen: fresh rendezvous
+        break
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out}"
         assert f"P{i}-OK" in out, out
