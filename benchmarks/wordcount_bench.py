@@ -142,6 +142,26 @@ if __name__ == "__main__":
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 4
     d = sys.argv[2] if len(sys.argv) > 2 else "/tmp/wc_corpus"
     result = run(n, d)
+    # second leg: same engine with the native layer killed
+    # (LMR_DISABLE_NATIVE=1) — the honest within-framework measure of
+    # what the C++ data path buys. Only meaningful when leg 1 actually
+    # ran native (a no-g++ box would just record two identical runs).
+    if (os.environ.get("LMR_SKIP_PYTHON_LEG") != "1"
+            and result["native_map"] and result["native_merge"]):
+        prev = os.environ.get("LMR_DISABLE_NATIVE")
+        os.environ["LMR_DISABLE_NATIVE"] = "1"
+        try:
+            py_leg = run(n, d)
+        finally:
+            if prev is None:
+                del os.environ["LMR_DISABLE_NATIVE"]
+            else:
+                os.environ["LMR_DISABLE_NATIVE"] = prev
+        result["python_engine_leg"] = {
+            k: py_leg[k] for k in ("cluster_s", "server_wall_s",
+                                   "map_cluster_s", "reduce_cluster_s")}
+        result["native_layer_speedup"] = round(
+            py_leg["cluster_s"] / result["cluster_s"], 2)
     print(json.dumps(result))
     os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
     with open(RESULTS, "w") as f:

@@ -52,7 +52,18 @@ def _build(src: str, so: str) -> Optional[str]:
 
 def load_native(src: str, so: str) -> Optional[ctypes.CDLL]:
     """Build (if stale/absent) and load ``src`` as ``so``; None on any
-    failure. Caches per-process: one compile attempt per .so path."""
+    failure. Caches per-process: one compile attempt per .so path.
+
+    ``LMR_DISABLE_NATIVE=1`` is the global kill switch: every native
+    fast path loads through here, so starting a process with it set
+    forces the pure-Python semantics — the first tool to reach for when
+    debugging a suspected native/Python divergence in production. NB:
+    components that cached a loaded library at construction (e.g. a
+    NativeJobIndex built earlier in this process) keep their handle;
+    the switch governs loads AFTER it is set, so set it at process
+    start."""
+    if os.environ.get("LMR_DISABLE_NATIVE") == "1":
+        return None
     with _lock:
         if so in _cache:
             return _cache[so]

@@ -252,3 +252,20 @@ def test_unparseable_records_fall_back(tmp_path):
     b.write('[NaN,[1]]\n')
     b.build("nan_run")
     assert native_merge.native_merge_records(store, ["nan_run"]) is None
+
+
+def test_global_native_kill_switch(tmp_path, monkeypatch):
+    """LMR_DISABLE_NATIVE=1 must force the pure-Python path everywhere
+    (single choke point: native_build.load_native) while results stay
+    identical — the production divergence-debugging switch."""
+    store = SharedStore(str(tmp_path))
+    _write_run(store, "a", [("k", [1, 2])])
+    monkeypatch.setenv("LMR_DISABLE_NATIVE", "1")
+    assert native_merge.native_available() is False
+    assert native_merge.native_merge_records(store, ["a"]) is None
+    assert native_merge.native_merge_reduce_sum(
+        store, ["a"], store, "res") is False
+    from lua_mapreduce_tpu.core import native_wcmap
+    assert native_wcmap.native_available() is False
+    monkeypatch.delenv("LMR_DISABLE_NATIVE")
+    assert native_merge.native_available() is True
