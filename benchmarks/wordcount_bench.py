@@ -152,16 +152,21 @@ if __name__ == "__main__":
         os.environ["LMR_DISABLE_NATIVE"] = "1"
         try:
             py_leg = run(n, d)
+            result["python_engine_leg"] = {
+                k: py_leg[k] for k in ("cluster_s", "server_wall_s",
+                                       "map_cluster_s",
+                                       "reduce_cluster_s")}
+            result["native_layer_speedup"] = round(
+                py_leg["cluster_s"] / result["cluster_s"], 2)
+        except Exception as e:
+            # leg-2 trouble must not discard leg 1's measurement
+            result["python_engine_leg"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
         finally:
             if prev is None:
                 del os.environ["LMR_DISABLE_NATIVE"]
             else:
                 os.environ["LMR_DISABLE_NATIVE"] = prev
-        result["python_engine_leg"] = {
-            k: py_leg[k] for k in ("cluster_s", "server_wall_s",
-                                   "map_cluster_s", "reduce_cluster_s")}
-        result["native_layer_speedup"] = round(
-            py_leg["cluster_s"] / result["cluster_s"], 2)
     print(json.dumps(result))
     os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
     with open(RESULTS, "w") as f:

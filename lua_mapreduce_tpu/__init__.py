@@ -61,7 +61,10 @@ __all__ = [
 def utest():
     """Run every module's self-test (reference mapreduce/test.lua:30-39)."""
     from lua_mapreduce_tpu.core import heap, merge, serialize
+    from lua_mapreduce_tpu.coord import jobstore
+    from lua_mapreduce_tpu.engine import contract
+    from lua_mapreduce_tpu.store import memfs
 
-    for mod in (tuples, heap, serialize, merge):
+    for mod in (tuples, heap, serialize, merge, jobstore, memfs, contract):
         if hasattr(mod, "utest"):
             mod.utest()

@@ -55,3 +55,21 @@ class MemStore(Store):
     def remove(self, name: str) -> None:
         with self._lock:
             self._files.pop(name, None)
+
+
+def utest() -> None:
+    """Self-test (reference fs.lua:213-251 utest role): build / lines /
+    list / exists / remove roundtrip with atomic publish semantics."""
+    s = MemStore()
+    b = s.builder()
+    b.write("x 1\n")
+    b.write("y 2\n")
+    assert not s.exists("f.P0")          # nothing visible before build
+    b.build("f.P0")
+    assert s.exists("f.P0")
+    assert list(s.lines("f.P0")) == ["x 1\n", "y 2\n"]
+    assert s.list("f.P*") == ["f.P0"]
+    assert s.list("g.*") == []
+    s.remove("f.P0")
+    assert not s.exists("f.P0")
+    s.remove("f.P0")                     # remove-if-exists, no raise
