@@ -160,7 +160,6 @@ def open_index(path: str, engine: str = "auto"):
         if lib is not None:
             return NativeJobIndex(path, lib)
         if engine == "native":
-            import os
             cause = ("LMR_DISABLE_NATIVE=1 is set"
                      if os.environ.get("LMR_DISABLE_NATIVE") == "1"
                      else "g++ build failed")

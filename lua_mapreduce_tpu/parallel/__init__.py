@@ -22,11 +22,12 @@ harness runs the same logical task on both paths (tests/test_tpu_engine.py).
 
 from lua_mapreduce_tpu.parallel.mesh import host_mesh, make_mesh
 from lua_mapreduce_tpu.parallel.array_task import ArrayTaskSpec
-from lua_mapreduce_tpu.parallel.tpu_engine import TpuExecutor
+from lua_mapreduce_tpu.parallel.tpu_engine import (TpuExecutor,
+                                                   differentiable_keyed)
 from lua_mapreduce_tpu.parallel.multihost import (global_batch_array,
                                                   initialize_multihost,
                                                   make_multihost_mesh)
 
 __all__ = ["make_mesh", "host_mesh", "ArrayTaskSpec", "TpuExecutor",
-           "initialize_multihost", "make_multihost_mesh",
-           "global_batch_array"]
+           "differentiable_keyed", "initialize_multihost",
+           "make_multihost_mesh", "global_batch_array"]

@@ -166,3 +166,39 @@ class TpuExecutor:
             return lax.scan(body, state, None, length=n_steps)
 
         return scan_all(init_state)
+
+
+def differentiable_keyed(mapfn, mesh, axis: str = "dp",
+                         reduce_op: str = "mean"):
+    """A DIFFERENTIABLE keyed MapReduce primitive (the DrJAX shape:
+    arXiv:2403.07128 exposes map/reduce as primitives grads flow
+    through).
+
+    ``mapfn(params, shard) -> pytree`` runs per device on its shard of
+    the batch; the returned ``f(params, batch) -> reduced`` replicates
+    the cross-device reduction's result and is traceable INSIDE user jit
+    / grad / vmap. The backward pass is automatic: psum/pmean transpose
+    to broadcast (+scale), so ``jax.grad(lambda p: loss(f(p, batch)))``
+    differentiates through both the map and the collective — this is
+    exactly how the DP trainer's gradient all-reduce arises, exposed as
+    a reusable primitive for custom aggregation programs (federated
+    means, per-key statistics, distributed EM steps).
+
+    Only ``sum`` and ``mean`` are permitted: pmax/pmin have no JAX
+    differentiation rule, which would break this primitive's one
+    advertised contract at grad time (use TpuExecutor for forward-only
+    max/min reductions).
+    """
+    if reduce_op not in ("sum", "mean"):
+        raise ValueError(
+            f"differentiable_keyed needs reduce_op 'sum' or 'mean', got "
+            f"{reduce_op!r} — pmax/pmin are not differentiable; use "
+            "TpuExecutor.run_keyed for forward-only max/min")
+    cross = _CROSS[reduce_op]
+
+    def per_shard(params, batch):
+        out = mapfn(params, batch)
+        return jax.tree.map(lambda x: cross(x, axis), out)
+
+    return jax.shard_map(per_shard, mesh=mesh,
+                         in_specs=(P(), P(axis)), out_specs=P())
