@@ -34,8 +34,30 @@ def best_of(fn, reps: int = 5) -> float:
     return best
 
 
-def _bench_pair(make, inner: int = 8) -> dict:
-    """Time one op both ways; returns {pallas_ms, xla_ms, speedup}.
+_overhead_cache: dict = {}
+
+
+def _call_overhead() -> float:
+    """Fixed cost of ONE jitted-call round trip (dispatch through the
+    axon tunnel + d2h fetch of one float), measured on a trivial op.
+    Through the tunnel this is tens of milliseconds — orders of magnitude
+    above most single-op times, so it must be measured and subtracted,
+    never amortized away by a fixed divisor (the first version of this
+    bench divided by inner=8 and reported an ~8.7 ms "time" for every
+    op regardless of FLOP count: pure overhead)."""
+    if "s" not in _overhead_cache:
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.zeros((8, 128), jnp.float32)
+        f = jax.jit(lambda x: x.sum())
+        float(f(x))                                   # compile + warm
+        _overhead_cache["s"] = best_of(lambda: float(f(x)), reps=9)
+    return _overhead_cache["s"]
+
+
+def _bench_pair(make, target_s: float = 0.35) -> dict:
+    """Time one op both ways; returns {pallas_ms, xla_ms, speedup, ...}.
 
     Measurement discipline for the tunneled backend:
     - operands are jit ARGUMENTS, never closed over — a closed-over array
@@ -43,32 +65,106 @@ def _bench_pair(make, inner: int = 8) -> dict:
       rejects multi-MB bodies (HTTP 413);
     - ``block_until_ready`` does NOT synchronize through the tunnel
       (utils/roofline.best_time doc), so each measurement runs the op
-      ``inner`` times under ``lax.scan`` with a scalar data dependency
-      and fetches ONE float — per-op time = dt/inner, with the tunnel
-      round trip amortized across the scan.
+      ``inner`` times under ``lax.scan`` and fetches ONE float;
+    - re-running the op on identical operands inside scan would let XLA
+      hoist it out of the loop, so the smallest operand is perturbed by a
+      loop-carried epsilon (``acc * 1e-30``, dynamically zero after the
+      cast but unprovable at compile time) — the op re-executes every
+      iteration at the cost of one tiny elementwise add;
+    - consuming a STATICALLY-indexed output element lets XLA dead-code-
+      eliminate the rest of the op (a conv whose only consumer is
+      ``r[0,0,0,0]`` compiles to one dot product — an earlier run of this
+      bench "measured" 16,461 TF/s for XLA conv that way, 83× over chip
+      peak), and even a DYNAMICALLY-indexed element can be pushed through
+      dots by the algebraic simplifier (observed: "347 TF/s" XLA flash
+      attention, 1.8× peak, vs 4.6 ms when fully consumed). So the body
+      consumes the dynamic element PLUS the full ``sum()`` scaled by an
+      un-foldable dynamic 1e-30 — every output element feeds the carry,
+      nothing can be sliced away (Pallas calls are opaque custom calls
+      XLA can't DCE into, so these flaws had inflated only the XLA side);
+    - ``inner`` is additionally capped so the call can't claim more than
+      ~2× peak-rate compute, and any per-op result implying > 1.1× chip
+      peak is flagged ``suspect_elided`` rather than trusted;
+    - ``inner`` is calibrated per op so net on-device time ≈ ``target_s``
+      (two-phase: probe at inner=8, rescale), and the measured fixed
+      call overhead is subtracted: per-op = (dt − overhead) / inner.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    run_pallas, run_xla, args, flops = make()
-    stacked = tuple(jnp.stack([a] * inner) for a in args)
-    out = {}
-    for name, run in (("pallas", run_pallas), ("xla", run_xla)):
-        def loop(*stk, _run=run):
-            def body(acc, xs):
-                r = _run(*xs)
-                return acc + r.ravel()[0].astype(jnp.float32), None
-            return lax.scan(body, jnp.float32(0), stk)[0]
+    from lua_mapreduce_tpu.utils.roofline import peak_flops_per_s
 
-        jitted = jax.jit(loop)
-        float(jitted(*stacked))                       # compile + warm
-        dt = best_of(lambda: float(jitted(*stacked))) / inner
-        out[f"{name}_ms"] = round(dt * 1e3, 3)
+    run_pallas, run_xla, args, flops = make()
+    overhead = _call_overhead()
+    peak = peak_flops_per_s()
+    i0 = min(range(len(args)), key=lambda i: args[i].nbytes)
+    # an op can't legitimately run faster than peak: bound the iteration
+    # count so a (mis-compiled-to-nothing) loop can't calibrate to
+    # absurd lengths, and anything still implying > 1.1× peak is flagged
+    inner_cap = 16384
+    if flops:
+        inner_cap = min(inner_cap,
+                        max(16, int(2.0 * target_s * peak / flops)))
+    out = {"call_overhead_ms": round(overhead * 1e3, 2)}
+    for name, run in (("pallas", run_pallas), ("xla", run_xla)):
+        per_op, inner = _measure_op(run, args, i0, inner_cap, target_s,
+                                    overhead)
+        out[f"{name}_ms"] = round(per_op * 1e3, 4)
+        out[f"{name}_inner_iters"] = inner
         if flops:
-            out[f"{name}_tflops"] = round(flops / dt / 1e12, 2)
-    out["speedup_pallas_vs_xla"] = round(out["xla_ms"] / out["pallas_ms"], 3)
+            out[f"{name}_tflops"] = round(flops / per_op / 1e12, 2)
+            if flops / per_op > 1.1 * peak:
+                out[f"{name}_suspect_elided"] = True
+    if out["pallas_ms"] and out["xla_ms"]:
+        out["speedup_pallas_vs_xla"] = round(
+            out["xla_ms"] / out["pallas_ms"], 3)
     return out
+
+
+def _measure_op(run, args, i0: int, inner_cap: int, target_s: float,
+                overhead: float):
+    """(per_op_seconds, inner) for one op — the SINGLE implementation of
+    the measurement discipline (matmul_tune.py reuses it; an earlier
+    hand-rolled copy there is how elided numbers slipped through once).
+
+    Calibration grows ``inner`` geometrically over a few rounds instead
+    of one rescale: a single tunnel-noise trough at the probe (dt under
+    the cached overhead → net ≤ 0) would otherwise floor the estimate
+    and explode ``inner`` straight to the cap."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def make_loop(inner):
+        def loop(*a):
+            def body(acc, _):
+                eps = (acc * 1e-30).astype(a[i0].dtype)
+                pert = tuple(x + eps if i == i0 else x
+                             for i, x in enumerate(a))
+                r = run(*pert).ravel()
+                idx = jnp.abs(acc.astype(jnp.int32)) % r.shape[0]
+                full = (r.sum().astype(jnp.float32) *
+                        (acc * 1e-30 + 1e-30))
+                return acc + r[idx].astype(jnp.float32) + full, None
+            return lax.scan(body, jnp.float32(0), None, length=inner)[0]
+        return jax.jit(loop)
+
+    inner = 8
+    for _ in range(4):
+        jitted = make_loop(inner)
+        float(jitted(*args))                          # compile + warm
+        dt = best_of(lambda: float(jitted(*args)))
+        net, measured_inner = dt - overhead, inner    # a matched pair —
+        # per_op must divide net by the inner it was MEASURED at, never
+        # by a post-growth inner the loop prepared but didn't time
+        if net >= 0.6 * target_s or inner >= inner_cap:
+            break
+        # growth factor from the estimate, but never more than 16× per
+        # round — a noise-negative net can't overshoot the whole budget
+        grow = min(16.0, target_s / max(net, 0.1 * overhead, 1e-4))
+        inner = int(min(inner_cap, max(inner + 1, inner * grow)))
+    return max(net, 1e-9) / measured_inner, measured_inner
 
 
 def bench_matmul(m, k, n, dtype):
@@ -112,11 +208,16 @@ def bench_flash(b, heads, seq, d, causal, dtype):
     from lua_mapreduce_tpu import ops
 
     def make():
-        q = jax.random.normal(jax.random.PRNGKey(0), (b, heads, seq, d),
+        # layout is (B, L, H, D) — flash_attention's contract. An earlier
+        # revision built (B, H, L, D), silently benchmarking seq-len-8
+        # attention with thousands of heads while counting seq² FLOPs
+        # (256× overcount); the near-identical s2048/s4096 timings in the
+        # resulting artifact were the tell.
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, seq, heads, d),
                               dtype)
-        k = jax.random.normal(jax.random.PRNGKey(1), (b, heads, seq, d),
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, seq, heads, d),
                               dtype)
-        v = jax.random.normal(jax.random.PRNGKey(2), (b, heads, seq, d),
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, seq, heads, d),
                               dtype)
         flops = 4.0 * b * heads * seq * seq * d * (0.5 if causal else 1.0)
         return (lambda q, k, v: ops.flash_attention(q, k, v, causal=causal,
