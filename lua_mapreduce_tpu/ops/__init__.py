@@ -7,25 +7,48 @@ package is the TPU-native replacement: Pallas kernels tiled for the MXU
 (128×128 systolic array) and VPU, with XLA reference implementations used
 for (a) correctness tests and (b) non-TPU backends.
 
-Backend policy (``default_backend``): "pallas" on TPU, "xla" elsewhere.
-Every op takes ``backend=`` with values "auto" | "pallas" | "xla" |
-"pallas_interpret" (interpreter mode, for CPU tests of the kernel path).
+Backend policy (``default_backend``): per-op, measured, not dogmatic.
+On TPU each op's ``auto`` resolves to whichever implementation the
+committed kernel bench (benchmarks/results/kernels.json) shows faster on
+real hardware — a hand-written kernel is a means, not an end, and for
+some ops XLA's lowering is the better TPU program. Off-TPU everything
+resolves to "xla" (Pallas-TPU kernels only lower on TPU). Every op takes
+``backend=`` with values "auto" | "pallas" | "xla" | "pallas_interpret"
+(interpreter mode, for CPU tests of the kernel path).
 """
 
 from __future__ import annotations
 
 import jax
 
+# Measured on a TPU v5e (benchmarks/results/kernels.json): XLA's conv
+# lowering beats the im2col+Pallas path (45.7 vs 7.9 TF/s on the ResNet
+# 56×56 block) and its large-matmul schedule beats the Pallas one; the
+# Pallas pooling kernel beats XLA's reduce_window ~2.7×, and the fused
+# flash kernel beats the O(L²)-materializing XLA composition while also
+# never writing the score matrix to HBM. Softmax is a wash; XLA wins on
+# fusion-with-neighbors grounds.
+_TPU_AUTO_POLICY = {
+    "matmul": "xla",
+    "conv2d": "xla",
+    "softmax": "xla",
+    "maxpool2d": "pallas",
+    "avgpool2d": "pallas",
+    "flash_attention": "pallas",
+}
 
-def default_backend() -> str:
-    """'pallas' on TPU, 'xla' on CPU/GPU (Pallas-TPU kernels only lower
-    on TPU; the interpreter is for tests, not production)."""
-    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+def default_backend(op: str | None = None) -> str:
+    """Resolved backend for ``op`` on the current platform: the measured
+    per-op winner on TPU (see ``_TPU_AUTO_POLICY``), 'xla' elsewhere."""
+    if jax.default_backend() != "tpu":
+        return "xla"
+    return _TPU_AUTO_POLICY.get(op, "pallas")
 
 
-def resolve_backend(backend: str) -> str:
+def resolve_backend(backend: str, op: str | None = None) -> str:
     if backend == "auto":
-        return default_backend()
+        return default_backend(op)
     if backend not in ("pallas", "xla", "pallas_interpret"):
         raise ValueError(f"unknown backend {backend!r}")
     return backend

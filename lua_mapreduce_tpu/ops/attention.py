@@ -60,9 +60,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def fold():
-        q = q_ref[0].astype(jnp.float32)                # (bq, d)
-        k = k_ref[0].astype(jnp.float32)                # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
+        # dots take the INPUT dtype (bf16×bf16→f32 is the MXU's native
+        # mode — upcasting operands to f32 first quarters matmul
+        # throughput); only the softmax bookkeeping runs in f32
+        q = q_ref[0]                                    # (bq, d)
+        k = k_ref[0]                                    # (bk, d)
+        v = v_ref[0]
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -84,8 +87,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         alpha = jnp.exp(m_prev - m_new)
         m_scr[:] = m_new
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p folds back to the value dtype for the MXU; the f32 denominator
+        # (summed above, BEFORE the downcast) keeps normalization exact
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -186,10 +191,15 @@ def flash_attention(q, k, v, *, causal: bool = False,
     ``backend="pallas"``/``"pallas_interpret"`` runs the fused VMEM
     kernel; ``"xla"`` is the reference composition (correctness oracle,
     non-TPU platforms)."""
-    backend = resolve_backend(backend)
+    backend = resolve_backend(backend, "flash_attention")
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} "
                          f"{v.shape}")
+    # the kernel's dots run in the operand dtype (MXU-native bf16 path),
+    # so mixed q/k/v dtypes are promoted HERE — otherwise dot_general
+    # fails deep inside the pallas trace with no user-facing cause
+    dt = jnp.promote_types(q.dtype, jnp.promote_types(k.dtype, v.dtype))
+    q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
     if backend == "xla":
         scale = 1.0 / float(q.shape[-1]) ** 0.5
         return _attn_reference_xla(q, k, v, causal, scale)

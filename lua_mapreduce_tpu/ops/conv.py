@@ -78,7 +78,7 @@ def conv2d(x, w, b=None, *, stride=1, padding: Padding = "VALID",
     ``lax.conv_general_dilated`` (the reference implementation for
     correctness tests and non-TPU platforms).
     """
-    backend = resolve_backend(backend)
+    backend = resolve_backend(backend, "conv2d")
     kh, kw, cin, cout = w.shape
     sh, sw = _norm_stride(stride)
     ph, pw = _norm_padding(padding, kh, kw, x.shape[1], x.shape[2], sh, sw)

@@ -130,7 +130,7 @@ def matmul(a, b, *, backend: str = "auto", block_m: int = 256,
     promoted input dtype. Differentiable via a custom VJP whose backward
     matmuls run through the same Pallas kernel.
     """
-    backend = resolve_backend(backend)
+    backend = resolve_backend(backend, "matmul")
     if backend == "xla":
         return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
             out_dtype or jnp.promote_types(a.dtype, b.dtype))

@@ -118,7 +118,7 @@ _pool_p.defvjp(_pool_p_fwd, _pool_p_bwd)
 
 
 def _pool(x, window, stride, mode, backend):
-    backend = resolve_backend(backend)
+    backend = resolve_backend(backend, f"{mode}pool2d")
     window = _norm_stride(window)
     stride = _norm_stride(stride if stride is not None else window)
     if backend == "xla":

@@ -115,7 +115,7 @@ _softmax_p.defvjp(_softmax_fwd, _softmax_bwd)
 
 def log_softmax(x, *, backend: str = "auto", block_rows: int = 256):
     """Numerically-stable log-softmax over the last axis."""
-    backend = resolve_backend(backend)
+    backend = resolve_backend(backend, "softmax")
     if backend == "xla":
         return jax.nn.log_softmax(x, axis=-1)
     return _log_softmax_p(x, (block_rows, backend == "pallas_interpret"))
@@ -123,7 +123,7 @@ def log_softmax(x, *, backend: str = "auto", block_rows: int = 256):
 
 def softmax(x, *, backend: str = "auto", block_rows: int = 256):
     """Numerically-stable softmax over the last axis."""
-    backend = resolve_backend(backend)
+    backend = resolve_backend(backend, "softmax")
     if backend == "xla":
         return jax.nn.softmax(x, axis=-1)
     return _softmax_p(x, (block_rows, backend == "pallas_interpret"))

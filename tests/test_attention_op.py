@@ -37,6 +37,21 @@ def test_ragged_length_padding():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_mixed_dtypes_promoted():
+    """bf16 q with f32 k/v must work on BOTH backends (the kernel dots
+    run in operand dtype, so promotion happens at the public boundary)."""
+    q, k, v = _qkv(5)
+    want = flash_attention(q, k, v, causal=True, backend="xla")
+    got_x = flash_attention(q.astype(jnp.bfloat16), k, v, causal=True,
+                            backend="xla")
+    got_p = flash_attention(q.astype(jnp.bfloat16), k, v, causal=True,
+                            backend="pallas_interpret")
+    for got in (got_x, got_p):
+        assert got.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want), rtol=0.02, atol=0.02)
+
+
 def test_bfloat16():
     q, k, v = _qkv(2, dtype=jnp.bfloat16)
     want = flash_attention(q.astype(jnp.float32), k.astype(jnp.float32),

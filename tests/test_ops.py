@@ -141,8 +141,17 @@ def test_avgpool_matches_xla():
 
 
 def test_default_backend_mapping():
-    expected = "pallas" if jax.default_backend() == "tpu" else "xla"
-    assert ops.default_backend() == expected
+    on_tpu = jax.default_backend() == "tpu"
+    # off-TPU everything is XLA (Pallas-TPU kernels don't lower); on TPU
+    # "auto" resolves per op to the measured winner from
+    # benchmarks/results/kernels.json (ops/__init__._TPU_AUTO_POLICY)
+    assert ops.default_backend() == ("pallas" if on_tpu else "xla")
+    for op, tpu_winner in ops._TPU_AUTO_POLICY.items():
+        want = tpu_winner if on_tpu else "xla"
+        assert ops.default_backend(op) == want
+        assert ops.resolve_backend("auto", op) == want
+    # explicit backends are never overridden by the policy
+    assert ops.resolve_backend("pallas", "conv2d") == "pallas"
     with pytest.raises(ValueError):
         ops.resolve_backend("cuda")
 
