@@ -61,10 +61,12 @@ __all__ = [
 def utest():
     """Run every module's self-test (reference mapreduce/test.lua:30-39)."""
     from lua_mapreduce_tpu.core import heap, merge, serialize
-    from lua_mapreduce_tpu.coord import jobstore
-    from lua_mapreduce_tpu.engine import contract
-    from lua_mapreduce_tpu.store import memfs
+    from lua_mapreduce_tpu.coord import jobstore, persistent_table
+    from lua_mapreduce_tpu.engine import contract, server, worker
+    from lua_mapreduce_tpu.store import memfs, router
+    from lua_mapreduce_tpu.utils import stats
 
-    for mod in (tuples, heap, serialize, merge, jobstore, memfs, contract):
+    for mod in (tuples, heap, serialize, merge, jobstore, memfs, contract,
+                router, persistent_table, stats, worker, server):
         if hasattr(mod, "utest"):
             mod.utest()

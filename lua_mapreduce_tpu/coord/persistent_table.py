@@ -178,3 +178,40 @@ class PersistentTable:
         if self._read_only:
             raise PermissionError(
                 f"persistent table {self._name!r} is read-only")
+
+
+def utest() -> None:
+    """Self-test (reference persistent_table.lua:256-264: two clients
+    round-tripping one document, optimistic conflict, lock)."""
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+
+    store = MemJobStore()
+    a = PersistentTable("_pt_utest", store)
+    a["model"] = "m.ckpt"
+    a.update()
+    b = PersistentTable("_pt_utest", store)
+    assert b["model"] == "m.ckpt"
+    b["model"] = "m2.ckpt"
+    b.update()
+    a["model"] = "retried-write"           # a still holds the old stamp
+    try:
+        a.update()
+    except ConflictError:
+        pass
+    else:
+        raise AssertionError("stale write must raise ConflictError")
+    a.refresh()                            # new stamp; pending write kept
+    a.update()
+    assert PersistentTable("_pt_utest", store)["model"] == "retried-write"
+
+    ro = PersistentTable("_pt_utest", store, read_only=True)
+    try:
+        ro["model"] = "x"
+    except PermissionError:
+        pass
+    else:
+        raise AssertionError("read_only must reject writes")
+
+    a.lock()
+    a.unlock()
+    a.drop()

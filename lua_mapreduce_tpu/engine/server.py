@@ -324,3 +324,59 @@ class Server:
     def _log(self, msg: str) -> None:
         if self.verbose:
             print(f"[server] {msg}", flush=True)
+
+
+def utest() -> None:
+    """Self-test (reference server.lua:629-655 utest role, upgraded to a
+    micro end-to-end): one server + one in-process worker over the
+    in-memory job store run a 3-job task through map → shuffle → reduce
+    → finalfn, and the stats/finished_value surfaces are checked."""
+    import sys
+    import threading
+    import types
+
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.worker import Worker
+
+    mod = types.ModuleType("_server_utest_mod")
+
+    def taskfn(emit):
+        for i in range(3):
+            emit(str(i), list(range(i + 1)))
+
+    def mapfn(key, values, emit):
+        for v in values:
+            emit("n", v)
+
+    def reducefn(key, values):
+        return sum(values)
+
+    def finalfn(pairs):
+        mod.result = {k: v for k, (v,) in pairs}   # keep results
+
+    mod.taskfn, mod.mapfn, mod.reducefn = taskfn, mapfn, reducefn
+    mod.partitionfn = lambda key: 0
+    mod.finalfn = finalfn
+    sys.modules["_server_utest_mod"] = mod
+    try:
+        store = MemJobStore()
+        spec = TaskSpec(taskfn="_server_utest_mod",
+                        mapfn="_server_utest_mod",
+                        partitionfn="_server_utest_mod",
+                        reducefn="_server_utest_mod",
+                        finalfn="_server_utest_mod",
+                        storage="mem:_server_utest")
+        server = Server(store, poll_interval=0.01).configure(spec)
+        w = Worker(store).configure(max_iter=400, max_sleep=0.02)
+        t = threading.Thread(target=w.execute, daemon=True)
+        t.start()
+        stats = server.loop()
+        t.join(timeout=30)
+        # sum over shards of 0..i = 0 + (0+1) + (0+1+2) = 4
+        assert mod.result == {"n": 4}, mod.result
+        it = stats.iterations[-1]
+        assert it.map.count == 3 and it.map.failed == 0
+        assert it.reduce.count == 1 and it.reduce.failed == 0
+    finally:
+        del sys.modules["_server_utest_mod"]

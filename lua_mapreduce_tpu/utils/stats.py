@@ -78,3 +78,22 @@ class TaskStats:
             "iterations": [s.as_dict() for s in self.iterations],
             "wall_time": self.wall_time,
         }
+
+
+def utest() -> None:
+    """Self-test (reference server.lua:629-655 utest role: the stats
+    aggregation — per-phase sums + cluster time = max(written) −
+    min(started), server.lua:155-183)."""
+    times = [JobTimes(started=1.0, finished=2.0, written=3.0, cpu=0.5),
+             JobTimes(started=2.0, finished=4.0, written=6.0, cpu=1.5)]
+    ph = PhaseStats().fold(times, failed=1)
+    assert ph.count == 2 and ph.failed == 1
+    assert abs(ph.sum_cpu_time - 2.0) < 1e-9
+    assert abs(ph.sum_real_time - (2.0 + 4.0)) < 1e-9
+    assert abs(ph.cluster_time - (6.0 - 1.0)) < 1e-9
+    red = PhaseStats().fold(
+        [JobTimes(started=6.0, finished=7.0, written=8.0, cpu=1.0)])
+    it = IterationStats(iteration=1, map=ph, reduce=red)
+    assert abs(it.cluster_time - (5.0 + 2.0)) < 1e-9
+    d = TaskStats(iterations=[it]).as_dict()
+    assert d["iterations"][0]["map"]["count"] == 2

@@ -23,6 +23,15 @@ def test_merge_utest():
     merge.utest()
 
 
+def test_package_utest_runs_all_modules():
+    """mapreduce.utest parity (reference test.lua:30-39 / init.lua:36-38):
+    the package-level runner drives EVERY module self-test, including the
+    micro e2e in engine.server.utest."""
+    import lua_mapreduce_tpu
+
+    lua_mapreduce_tpu.utest()
+
+
 def test_tuple_intern_table_is_bounded():
     t = tuples.intern(("bounded-key", 1))
     assert tuples.stats()["size"] <= tuples._MAX_ENTRIES
