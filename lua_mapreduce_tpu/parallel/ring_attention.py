@@ -54,8 +54,14 @@ def attention_reference(q, k, v, *, causal: bool = False):
 
 def _block_fold(o, m, l, q, k, v, mask, scale):
     """Fold one KV block into the online-softmax accumulator (o, m, l):
-    the flash-attention update, shapes (B,H,Lq,D), (B,H,Lq), (B,H,Lq)."""
-    s = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale     # (B,H,Lq,Lk) MXU
+    the flash-attention update, shapes (B,H,Lq,D), (B,H,Lq), (B,H,Lq).
+
+    Dots run in the operand dtype (bf16×bf16→f32 is the MXU's native
+    mode; upcasting operands first quarters matmul throughput, the same
+    fix as ops/attention.py); accumulators and softmax bookkeeping stay
+    f32 via ``preferred_element_type`` regardless of input dtype."""
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k,
+                   preferred_element_type=jnp.float32) * scale  # MXU
     s = jnp.where(mask, s, _NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     # p is explicitly re-masked: when a whole block is masked, s - m_new
@@ -63,7 +69,9 @@ def _block_fold(o, m, l, q, k, v, mask, scale):
     p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
     alpha = jnp.exp(m - m_new)
     l_new = l * alpha + jnp.sum(p, axis=-1)
-    o_new = o * alpha[..., None] + jnp.einsum("bhlm,bmhd->bhld", p, v)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhlm,bmhd->bhld", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
     return o_new, m_new, l_new
 
 
@@ -74,16 +82,17 @@ def _ring_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
     b, l_loc, h, d = q.shape
     scale = 1.0 / jnp.sqrt(d)
     my = lax.axis_index(axis)
-    qf = q.astype(jnp.float32)
     pos_q = my * l_loc + jnp.arange(l_loc)              # global q rows
 
     # accumulators are derived from q (zeroed) rather than jnp.zeros so
     # they inherit q's varying-axes type: fresh constants are replicated
     # in shard_map's vma typing and would mismatch the scan carry — and
     # deriving from q stays correct however many mesh axes the CALLER's
-    # shard_map adds around this body (e.g. dp × sp in the transformer)
-    z = jnp.transpose(qf, (0, 2, 1, 3)) * 0.0           # (B,H,Lq,D)
-    o = z
+    # shard_map adds around this body (e.g. dp × sp in the transformer).
+    # Accumulators are f32 regardless of input dtype; q/k/v keep their
+    # dtype so the _block_fold dots hit the MXU's native bf16 mode.
+    z = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32) * 0.0
+    o = z                                               # (B,H,Lq,D)
     m = z[..., 0] + _NEG_INF
     l = z[..., 0]
 
@@ -94,8 +103,7 @@ def _ring_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
             mask = pos_q[:, None] >= pos_k[None, :]     # (Lq, Lk)
         else:
             mask = jnp.ones((l_loc, l_loc), bool)
-        return _block_fold(o, m, l, qf, kb.astype(jnp.float32),
-                           vb.astype(jnp.float32), mask, scale)
+        return _block_fold(o, m, l, q, kb, vb, mask, scale)
 
     # step 0 folds the LOCAL block before any communication, so the ring
     # makes exactly n_shards - 1 sends — the final fold needs no rotate
