@@ -72,6 +72,23 @@ class TransformerConfig:
                                  n_layers=2, d_ff=64, max_seq=128)
 
 
+def flops_per_token(cfg: TransformerConfig, seq_len: int,
+                    causal: bool = True) -> float:
+    """Matmul FLOPs per token for one TRAIN step (fwd + bwd ≈ 3× fwd) —
+    the MFU numerator (same accounting role as models/mlp.py
+    ``flops_per_example``). Counted: qkv+out projections (8d²/token),
+    attention score+value contractions (4·L·d, halved when causal),
+    dense FFN (4·d·d_ff), tied LM head (2·d·V). Uncounted (understates
+    utilization): layernorms, softmax, embeddings, and the extra block
+    forward under ``cfg.remat``. MoE FFN FLOPs follow the per-token
+    routed expert (same as dense for top-1 switch routing)."""
+    d, dff = cfg.d_model, cfg.d_ff
+    attn = 4.0 * seq_len * d * (0.5 if causal else 1.0)
+    per_layer = 8.0 * d * d + attn + 4.0 * d * dff
+    fwd = cfg.n_layers * per_layer + 2.0 * d * cfg.vocab
+    return 3.0 * fwd
+
+
 def _check_moe(cfg: TransformerConfig, n_ep: Optional[int] = None) -> None:
     if cfg.moe_experts and cfg.moe_capacity <= 0:
         raise ValueError(

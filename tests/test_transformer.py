@@ -373,3 +373,17 @@ def test_remat_composes_with_sequence_parallel(mesh):
                           *tfm.shard_batch(mesh, tok, tgt))
         losses[name] = float(loss)
     assert np.allclose(losses["plain"], losses["remat"], rtol=1e-6)
+
+
+def test_flops_per_token_accounting():
+    """MFU numerator sanity: hand-counted matmul FLOPs for a small cfg."""
+    from lua_mapreduce_tpu.models.transformer import (TransformerConfig,
+                                                      flops_per_token)
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=128, max_seq=64)
+    d, dff, L = 32, 128, 16
+    fwd = 2 * (8 * d * d + 4 * L * d * 0.5 + 4 * d * dff) + 2 * d * 64
+    assert flops_per_token(cfg, L) == 3.0 * fwd
+    # non-causal doubles only the attention term
+    delta = flops_per_token(cfg, L, causal=False) - flops_per_token(cfg, L)
+    assert delta == 3.0 * 2 * (2.0 * L * d)
