@@ -97,12 +97,23 @@ def _ring_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
     l = z[..., 0]
 
     def fold(o, m, l, kb, vb, src):
-        """Fold the KV block belonging to global shard ``src``."""
+        """Fold the KV block belonging to global shard ``src``. Causal
+        blocks wholly above the diagonal (this shard's newest key is
+        still older than the query shard's oldest row... i.e. every
+        score masked) are SKIPPED via lax.cond, not just masked — the
+        same pruning the flash kernel does with pl.when, worth ~half
+        the attention FLOPs at large ring sizes. Numerics are identical
+        (a fully-masked block contributes nothing to (o, m, l))."""
         pos_k = src * l_loc + jnp.arange(l_loc)
         if causal:
             mask = pos_q[:, None] >= pos_k[None, :]     # (Lq, Lk)
-        else:
-            mask = jnp.ones((l_loc, l_loc), bool)
+            all_masked = src * l_loc > my * l_loc + (l_loc - 1)
+            return lax.cond(
+                all_masked,
+                lambda ops: ops[:3],
+                lambda ops: _block_fold(*ops, mask, scale),
+                (o, m, l, q, kb, vb))
+        mask = jnp.ones((l_loc, l_loc), bool)
         return _block_fold(o, m, l, q, kb, vb, mask, scale)
 
     # step 0 folds the LOCAL block before any communication, so the ring
