@@ -86,3 +86,48 @@ class TestUlysses:
         q, k, v = _qkv(6, h=6)
         with pytest.raises(ValueError, match="heads not divisible"):
             ra.ulysses_attention(q, k, v, mesh)
+
+
+class TestZigzagRing:
+    """schedule="zigzag": the causal load-balanced ring must be
+    indistinguishable from the oracle — the permutation is internal."""
+
+    @pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+    def test_matches_reference(self, mesh, causal):
+        q, k, v = _qkv(7)
+        want = ra.attention_reference(q, k, v, causal=causal)
+        got = ra.ring_attention(q, k, v, mesh, axis="sp", causal=causal,
+                                schedule="zigzag")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gradients_match_reference(self, mesh):
+        q, k, v = _qkv(8, l=32, h=4)
+
+        def ref_loss(q):
+            return jnp.sum(ra.attention_reference(q, k, v, causal=True))
+
+        def zz_loss(q):
+            return jnp.sum(ra.ring_attention(q, k, v, mesh, axis="sp",
+                                             causal=True,
+                                             schedule="zigzag"))
+
+        np.testing.assert_allclose(np.asarray(jax.grad(zz_loss)(q)),
+                                   np.asarray(jax.grad(ref_loss)(q)),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bad_lengths_and_schedule_rejected(self, mesh):
+        q, k, v = _qkv(9, l=24)      # 24 % (2*8) != 0
+        with pytest.raises(ValueError, match="zigzag"):
+            ra.ring_attention(q, k, v, mesh, axis="sp", causal=True,
+                              schedule="zigzag")
+        q, k, v = _qkv(9)
+        with pytest.raises(ValueError, match="schedule"):
+            ra.ring_attention(q, k, v, mesh, axis="sp",
+                              schedule="stripy")
+
+    def test_perm_is_a_permutation(self):
+        perm = ra._zigzag_perm(32, 4)
+        assert sorted(perm.tolist()) == list(range(32))
+        # shard 0 holds the first and LAST stripes
+        assert perm[:8].tolist() == [0, 1, 2, 3, 28, 29, 30, 31]
