@@ -37,7 +37,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from lua_mapreduce_tpu.parallel import moe as _moe
 from lua_mapreduce_tpu.parallel.pipeline import pipeline_apply
 from lua_mapreduce_tpu.parallel.ring_attention import (
-    _ring_shard, _ulysses_shard, attention_reference)
+    _ring_shard, _ring_shard_zigzag, _ulysses_shard, _zigzag_perm,
+    attention_reference)
 
 Params = Dict[str, jnp.ndarray]
 
@@ -238,6 +239,9 @@ def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int,
     if attn == "ring":
         return functools.partial(_ring_shard, axis=sp_axis,
                                  n_shards=n_sp, causal=True)
+    if attn == "zigzag":
+        return functools.partial(_ring_shard_zigzag, axis=sp_axis,
+                                 n_shards=n_sp, causal=True)
     if attn == "ulysses":
         if n_heads % n_sp:
             raise ValueError(
@@ -245,7 +249,24 @@ def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int,
                 f"{n_heads} heads over {n_sp} devices")
         return functools.partial(_ulysses_shard, axis=sp_axis,
                                  n_shards=n_sp, causal=True)
-    raise ValueError(f"unknown attn {attn!r} (want 'ring' or 'ulysses')")
+    raise ValueError(f"unknown attn {attn!r} "
+                     f"(want 'ring', 'zigzag' or 'ulysses')")
+
+
+def _zigzag_pos(sp_axis: str, n_sp: int, l_loc: int):
+    """This device's global positions under the zigzag layout: its local
+    rows are [stripe my ‖ stripe 2P−1−my] of the permuted sequence
+    (parallel/ring_attention._zigzag_perm)."""
+    h = l_loc // 2
+    my = lax.axis_index(sp_axis)
+    return jnp.concatenate([my * h + jnp.arange(h),
+                            (2 * n_sp - 1 - my) * h + jnp.arange(h)])
+
+
+def _zigzag_check(seq_len: int, n_sp: int) -> None:
+    if seq_len % (2 * n_sp):
+        raise ValueError(f"zigzag needs seq len divisible by "
+                         f"2×sp: {seq_len} vs {2 * n_sp}")
 
 
 def make_sharded_apply(cfg: TransformerConfig, mesh, *,
@@ -266,7 +287,10 @@ def make_sharded_apply(cfg: TransformerConfig, mesh, *,
     def shard_fwd(params, tokens):
         l_loc = tokens.shape[1]
         _check_seq(l_loc * n_sp, cfg)
-        pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
+        if attn == "zigzag":
+            pos = _zigzag_pos(sp_axis, n_sp, l_loc)
+        else:
+            pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
         return _forward(params, tokens, pos, cfg, attn_shard,
                         block=block)[0]
 
@@ -275,10 +299,18 @@ def make_sharded_apply(cfg: TransformerConfig, mesh, *,
         # drift from init_transformer's key set
         specs = {k: _spec_for(k, suffix) for k in params} \
             if cfg.moe_experts else P()
+        if attn == "zigzag":
+            # permute in, un-permute out — callers see standard order
+            _zigzag_check(tokens.shape[1], n_sp)
+            perm = _zigzag_perm(tokens.shape[1], n_sp)
+            tokens = tokens[:, perm]
         fn = jax.shard_map(shard_fwd, mesh=mesh,
                            in_specs=(specs, P(dp_axis, sp_axis)),
                            out_specs=P(dp_axis, sp_axis))
-        return fn(params, tokens)
+        out = fn(params, tokens)
+        if attn == "zigzag":
+            out = out[:, perm.argsort()]
+        return out
 
     return jax.jit(apply)
 
@@ -341,7 +373,10 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
     def shard_step(params, tokens, targets):
         l_loc = tokens.shape[1]
         _check_seq(l_loc * n_sp, cfg)
-        pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
+        if attn == "zigzag":
+            pos = _zigzag_pos(sp_axis, n_sp, l_loc)
+        else:
+            pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
 
         def global_loss(p):
             local = lm_loss_local(p, tokens, targets, cfg, attn_shard,
@@ -355,6 +390,13 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
         # init_transformer; same pattern as the 3-D step)
         specs = {k: _spec_for(k, suffix) for k in params} \
             if cfg.moe_experts else P()
+        if attn == "zigzag":
+            # tokens AND targets ride the same permutation; the loss is
+            # a token mean, so no un-permutation is needed on the way
+            # out — the step is drop-in for the contiguous ring
+            _zigzag_check(tokens.shape[1], n_sp)
+            perm = _zigzag_perm(tokens.shape[1], n_sp)
+            tokens, targets = tokens[:, perm], targets[:, perm]
         mapped = jax.shard_map(
             shard_step, mesh=mesh,
             in_specs=(specs, P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
@@ -472,6 +514,9 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
     if cfg.moe_experts:
         raise ValueError("MoE blocks are not supported on the 3-D tp "
                          "path; use make_train_step (experts over dp)")
+    if attn == "zigzag":
+        raise ValueError("zigzag is a 2-D (dp, sp) schedule for now; "
+                         "use make_train_step, or attn='ring' here")
     # the ulysses divisibility check sees the PER-TP-SLICE head count
     attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg,
                                 n_heads=cfg.n_heads // n_mp)

@@ -34,7 +34,7 @@ def _tokens(cfg, b=4, l=64, seed=0):
     return jnp.asarray(rng.randint(0, cfg.vocab, (b, l)), jnp.int32)
 
 
-@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+@pytest.mark.parametrize("attn", ["ring", "zigzag", "ulysses"])
 def test_sharded_forward_matches_oracle(mesh, cfg, params, attn):
     tokens = _tokens(cfg)
     want = tfm.transformer_apply(params, tokens, cfg=cfg)
@@ -42,6 +42,33 @@ def test_sharded_forward_matches_oracle(mesh, cfg, params, attn):
     got = fwd(params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_zigzag_step_is_dropin_for_ring(mesh, cfg):
+    """attn='zigzag' must be loss- and grad-equivalent to the contiguous
+    ring (the permutation is internal; the loss is a token mean)."""
+    rng = np.random.RandomState(5)
+    b, l = 4, 64
+    seq = rng.randint(0, cfg.vocab, (b, l + 1))
+    tokens = jnp.asarray(seq[:, :-1], jnp.int32)
+    targets = jnp.asarray(seq[:, 1:], jnp.int32)
+    params = tfm.init_transformer(jax.random.PRNGKey(3), cfg)
+    opt = optax.sgd(0.1)
+    tokens_d, targets_d = tfm.shard_batch(mesh, tokens, targets)
+
+    outs = {}
+    for attn in ("ring", "zigzag"):
+        step = tfm.make_train_step(cfg, mesh, opt, attn=attn)
+        # the step donates params/opt_state buffers — give each run its
+        # own copies or the second run sees deleted arrays
+        p0 = jax.tree.map(jnp.copy, params)
+        p, _, loss = step(p0, opt.init(p0), tokens_d, targets_d)
+        outs[attn] = (float(loss), p)
+    assert abs(outs["ring"][0] - outs["zigzag"][0]) < 2e-5
+    for k in outs["ring"][1]:
+        np.testing.assert_allclose(np.asarray(outs["ring"][1][k]),
+                                   np.asarray(outs["zigzag"][1][k]),
+                                   rtol=2e-4, atol=2e-4)
 
 
 def test_train_step_learns_copy_task(mesh, cfg):
