@@ -514,9 +514,6 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
     if cfg.moe_experts:
         raise ValueError("MoE blocks are not supported on the 3-D tp "
                          "path; use make_train_step (experts over dp)")
-    if attn == "zigzag":
-        raise ValueError("zigzag is a 2-D (dp, sp) schedule for now; "
-                         "use make_train_step, or attn='ring' here")
     # the ulysses divisibility check sees the PER-TP-SLICE head count
     attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg,
                                 n_heads=cfg.n_heads // n_mp)
@@ -526,7 +523,10 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
     def shard_step(params, tokens, targets):
         l_loc = tokens.shape[1]
         _check_seq(l_loc * n_sp, cfg)
-        pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
+        if attn == "zigzag":
+            pos = _zigzag_pos(sp_axis, n_sp, l_loc)
+        else:
+            pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
 
         def global_loss(p):
             local = lm_loss_local(p, tokens, targets, cfg, attn_shard,
@@ -543,6 +543,12 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
         return {k: _spec_for(k, specs) for k in params_like}
 
     def step(params, opt_state, tokens, targets):
+        if attn == "zigzag":
+            # same internal permutation as the 2-D step: token mean
+            # loss, so no un-permutation on the way out
+            _zigzag_check(tokens.shape[1], n_sp)
+            perm = _zigzag_perm(tokens.shape[1], n_sp)
+            tokens, targets = tokens[:, perm], targets[:, perm]
         mapped = jax.shard_map(
             shard_step, mesh=mesh,
             in_specs=(specs_tree(params), P(dp_axis, sp_axis),

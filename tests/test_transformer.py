@@ -170,6 +170,32 @@ class Test3D:
                 np.asarray(p3[k]), np.asarray(p2[k]), rtol=2e-4,
                 atol=2e-4, err_msg=k)
 
+    def test_3d_zigzag_matches_3d_ring(self, mesh3, cfg):
+        """attn='zigzag' on the 3-D mesh: loss/params equivalent to the
+        contiguous 3-D ring (internal permutation, token-mean loss)."""
+        rng = np.random.RandomState(4)
+        b, l = 4, 32
+        seq = rng.randint(0, cfg.vocab, (b, l + 1))
+        tokens = jnp.asarray(seq[:, :-1], jnp.int32)
+        targets = jnp.asarray(seq[:, 1:], jnp.int32)
+        opt = optax.sgd(0.1)
+        params0 = tfm.init_transformer(jax.random.PRNGKey(9), cfg)
+
+        outs = {}
+        for attn in ("ring", "zigzag"):
+            step = tfm.make_train_step_3d(cfg, mesh3, opt, attn=attn)
+            p = tfm.shard_params_3d(
+                jax.tree.map(jnp.copy, params0), mesh3, cfg)
+            p, _, loss = step(p, opt.init(p),
+                              *tfm.shard_batch(mesh3, tokens, targets))
+            outs[attn] = (float(loss), tfm.unshard_params_3d(p, cfg))
+        assert abs(outs["ring"][0] - outs["zigzag"][0]) < 2e-5
+        for k in outs["ring"][1]:
+            np.testing.assert_allclose(
+                np.asarray(outs["ring"][1][k]),
+                np.asarray(outs["zigzag"][1][k]),
+                rtol=2e-4, atol=2e-4, err_msg=k)
+
     def test_3d_training_learns(self, mesh3, cfg):
         rng = np.random.RandomState(1)
         b, l = 8, 32
