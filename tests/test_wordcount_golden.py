@@ -218,3 +218,31 @@ def test_wordcount_big_miniature(tmp_path):
     got = {k: v[0] for k, v in ex.results()}
     assert got == dict(golden)
     assert sum(got.values()) == corpus.total_words(3)
+
+
+def test_in_map_combiner_bounds_memory(monkeypatch):
+    """The MAX_MAP_RESULT threshold must fire MID-map (reference
+    job.lua:92-96): with a skewed key emitted far past the threshold,
+    the in-memory bucket is folded in place and never grows unbounded,
+    and the fold loses nothing."""
+    from lua_mapreduce_tpu.engine import job as jobmod
+    from lua_mapreduce_tpu.engine.job import make_map_emit
+
+    monkeypatch.setattr(jobmod, "MAX_MAP_RESULT", 50)
+    seen_bucket_sizes = []
+
+    def combiner(key, values):
+        seen_bucket_sizes.append(len(values))
+        return sum(values)
+
+    result = {}
+    emit = make_map_emit(result, combiner)
+    for _ in range(500):                    # one hot key, 10x threshold
+        emit("hot", 1)
+    emit("cold", 1)
+
+    assert seen_bucket_sizes, "combiner never fired mid-map"
+    assert max(seen_bucket_sizes) <= 51     # bucket stays bounded
+    # nothing lost: a final fold over the remainder gives the true count
+    assert combiner("hot", result["hot"]) == 500
+    assert result["cold"] == [1]
