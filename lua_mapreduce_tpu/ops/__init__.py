@@ -24,9 +24,11 @@ import jax
 # Measured on a TPU v5e (benchmarks/results/kernels.json): XLA's conv
 # lowering beats the im2col+Pallas path (45.7 vs 7.9 TF/s on the ResNet
 # 56×56 block) and its large-matmul schedule beats the Pallas one; the
-# Pallas pooling kernel beats XLA's reduce_window ~2.7×, and the fused
-# flash kernel beats the O(L²)-materializing XLA composition while also
-# never writing the score matrix to HBM. Softmax is a wash; XLA wins on
+# Pallas pooling kernel beats XLA's reduce_window ~2.7×. Flash resolves
+# to Pallas on memory grounds: the XLA composition materializes the
+# (L, L) f32 score matrix in HBM (1 GB at L=4096, h=8, b=2), the fused
+# kernel never does — its head-to-head speed entry is pending a clean
+# real-chip run (see kernels.json note). Softmax is a wash; XLA wins on
 # fusion-with-neighbors grounds.
 _TPU_AUTO_POLICY = {
     "matmul": "xla",
