@@ -37,8 +37,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from lua_mapreduce_tpu.parallel import moe as _moe
 from lua_mapreduce_tpu.parallel.pipeline import pipeline_apply
 from lua_mapreduce_tpu.parallel.ring_attention import (
-    _ring_shard, _ring_shard_zigzag, _ulysses_shard, _zigzag_perm,
-    attention_reference)
+    _ring_shard, _ring_shard_zigzag, _ulysses_shard, _zigzag_check,
+    _zigzag_perm, attention_reference)
 
 Params = Dict[str, jnp.ndarray]
 
@@ -253,20 +253,28 @@ def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int,
                      f"(want 'ring', 'zigzag' or 'ulysses')")
 
 
-def _zigzag_pos(sp_axis: str, n_sp: int, l_loc: int):
-    """This device's global positions under the zigzag layout: its local
-    rows are [stripe my ‖ stripe 2P−1−my] of the permuted sequence
-    (parallel/ring_attention._zigzag_perm)."""
-    h = l_loc // 2
-    my = lax.axis_index(sp_axis)
-    return jnp.concatenate([my * h + jnp.arange(h),
-                            (2 * n_sp - 1 - my) * h + jnp.arange(h)])
+def _shard_pos(attn: str, sp_axis: str, n_sp: int, l_loc: int):
+    """This device's global positions: contiguous for ring/ulysses, the
+    two-stripe layout for zigzag (parallel/ring_attention._zigzag_perm)
+    — shared by every shard_step/shard_fwd body."""
+    if attn == "zigzag":
+        h = l_loc // 2
+        my = lax.axis_index(sp_axis)
+        return jnp.concatenate([my * h + jnp.arange(h),
+                                (2 * n_sp - 1 - my) * h + jnp.arange(h)])
+    return lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
 
 
-def _zigzag_check(seq_len: int, n_sp: int) -> None:
-    if seq_len % (2 * n_sp):
-        raise ValueError(f"zigzag needs seq len divisible by "
-                         f"2×sp: {seq_len} vs {2 * n_sp}")
+def _maybe_zigzag(attn: str, n_sp: int, *seqs):
+    """Apply the internal zigzag permutation to (B, L) sequence arrays
+    at a step/apply boundary; identity for other schedules. Returns the
+    permuted arrays plus the permutation (None when not zigzag) so a
+    forward can un-permute its outputs."""
+    if attn != "zigzag":
+        return (*seqs, None)
+    _zigzag_check(seqs[0].shape[1], n_sp)
+    perm = _zigzag_perm(seqs[0].shape[1], n_sp)
+    return (*(s[:, perm] for s in seqs), perm)
 
 
 def make_sharded_apply(cfg: TransformerConfig, mesh, *,
@@ -287,10 +295,7 @@ def make_sharded_apply(cfg: TransformerConfig, mesh, *,
     def shard_fwd(params, tokens):
         l_loc = tokens.shape[1]
         _check_seq(l_loc * n_sp, cfg)
-        if attn == "zigzag":
-            pos = _zigzag_pos(sp_axis, n_sp, l_loc)
-        else:
-            pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
+        pos = _shard_pos(attn, sp_axis, n_sp, l_loc)
         return _forward(params, tokens, pos, cfg, attn_shard,
                         block=block)[0]
 
@@ -299,18 +304,14 @@ def make_sharded_apply(cfg: TransformerConfig, mesh, *,
         # drift from init_transformer's key set
         specs = {k: _spec_for(k, suffix) for k in params} \
             if cfg.moe_experts else P()
-        if attn == "zigzag":
-            # permute in, un-permute out — callers see standard order
-            _zigzag_check(tokens.shape[1], n_sp)
-            perm = _zigzag_perm(tokens.shape[1], n_sp)
-            tokens = tokens[:, perm]
+        # zigzag: permute in, un-permute out — callers see
+        # standard order (perm is None otherwise)
+        tokens, perm = _maybe_zigzag(attn, n_sp, tokens)
         fn = jax.shard_map(shard_fwd, mesh=mesh,
                            in_specs=(specs, P(dp_axis, sp_axis)),
                            out_specs=P(dp_axis, sp_axis))
         out = fn(params, tokens)
-        if attn == "zigzag":
-            out = out[:, perm.argsort()]
-        return out
+        return out if perm is None else out[:, perm.argsort()]
 
     return jax.jit(apply)
 
@@ -373,10 +374,7 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
     def shard_step(params, tokens, targets):
         l_loc = tokens.shape[1]
         _check_seq(l_loc * n_sp, cfg)
-        if attn == "zigzag":
-            pos = _zigzag_pos(sp_axis, n_sp, l_loc)
-        else:
-            pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
+        pos = _shard_pos(attn, sp_axis, n_sp, l_loc)
 
         def global_loss(p):
             local = lm_loss_local(p, tokens, targets, cfg, attn_shard,
@@ -390,13 +388,11 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
         # init_transformer; same pattern as the 3-D step)
         specs = {k: _spec_for(k, suffix) for k in params} \
             if cfg.moe_experts else P()
-        if attn == "zigzag":
-            # tokens AND targets ride the same permutation; the loss is
-            # a token mean, so no un-permutation is needed on the way
-            # out — the step is drop-in for the contiguous ring
-            _zigzag_check(tokens.shape[1], n_sp)
-            perm = _zigzag_perm(tokens.shape[1], n_sp)
-            tokens, targets = tokens[:, perm], targets[:, perm]
+        # zigzag: tokens AND targets ride the same internal
+        # permutation; the loss is a token mean, so no
+        # un-permutation is needed — drop-in for the ring
+        tokens, targets, _ = _maybe_zigzag(attn, n_sp, tokens,
+                                           targets)
         mapped = jax.shard_map(
             shard_step, mesh=mesh,
             in_specs=(specs, P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
@@ -523,10 +519,7 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
     def shard_step(params, tokens, targets):
         l_loc = tokens.shape[1]
         _check_seq(l_loc * n_sp, cfg)
-        if attn == "zigzag":
-            pos = _zigzag_pos(sp_axis, n_sp, l_loc)
-        else:
-            pos = lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
+        pos = _shard_pos(attn, sp_axis, n_sp, l_loc)
 
         def global_loss(p):
             local = lm_loss_local(p, tokens, targets, cfg, attn_shard,
@@ -543,12 +536,9 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
         return {k: _spec_for(k, specs) for k in params_like}
 
     def step(params, opt_state, tokens, targets):
-        if attn == "zigzag":
-            # same internal permutation as the 2-D step: token mean
-            # loss, so no un-permutation on the way out
-            _zigzag_check(tokens.shape[1], n_sp)
-            perm = _zigzag_perm(tokens.shape[1], n_sp)
-            tokens, targets = tokens[:, perm], targets[:, perm]
+        # same internal zigzag permutation as the 2-D step
+        tokens, targets, _ = _maybe_zigzag(attn, n_sp, tokens,
+                                           targets)
         mapped = jax.shard_map(
             shard_step, mesh=mesh,
             in_specs=(specs_tree(params), P(dp_axis, sp_axis),

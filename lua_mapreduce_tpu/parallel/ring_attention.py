@@ -37,6 +37,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -75,6 +76,19 @@ def _block_fold(o, m, l, q, k, v, mask, scale):
     return o_new, m_new, l_new
 
 
+def _cond_fold(pred, o, m, l, q, k, v, mask, scale):
+    """_block_fold gated on a traced predicate: fully-masked causal
+    blocks are SKIPPED via lax.cond rather than folded-as-masked — the
+    same pruning the flash kernel does with pl.when, and AD-transparent
+    (both cond branches differentiate). A skipped block contributes
+    nothing to (o, m, l), so numerics are identical."""
+    return lax.cond(
+        pred,
+        lambda t: _block_fold(*t, mask, scale),
+        lambda t: t[:3],
+        (o, m, l, q, k, v))
+
+
 def _ring_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
     """Per-device body (inside shard_map): local q stays put, (k, v)
     rotate the ring; after step i this device holds the KV shard of
@@ -98,21 +112,13 @@ def _ring_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
 
     def fold(o, m, l, kb, vb, src):
         """Fold the KV block belonging to global shard ``src``. Causal
-        blocks wholly above the diagonal (this shard's newest key is
-        still older than the query shard's oldest row... i.e. every
-        score masked) are SKIPPED via lax.cond, not just masked — the
-        same pruning the flash kernel does with pl.when, worth ~half
-        the attention FLOPs at large ring sizes. Numerics are identical
-        (a fully-masked block contributes nothing to (o, m, l))."""
+        blocks wholly above the diagonal (src > my: every score masked)
+        are skipped via _cond_fold — worth ~half the attention FLOPs at
+        large ring sizes."""
         pos_k = src * l_loc + jnp.arange(l_loc)
         if causal:
             mask = pos_q[:, None] >= pos_k[None, :]     # (Lq, Lk)
-            all_masked = src * l_loc > my * l_loc + (l_loc - 1)
-            return lax.cond(
-                all_masked,
-                lambda ops: ops[:3],
-                lambda ops: _block_fold(*ops, mask, scale),
-                (o, m, l, q, kb, vb))
+            return _cond_fold(src <= my, o, m, l, q, kb, vb, mask, scale)
         mask = jnp.ones((l_loc, l_loc), bool)
         return _block_fold(o, m, l, q, kb, vb, mask, scale)
 
@@ -146,8 +152,6 @@ def _zigzag_perm(seq_len: int, n_shards: int):
     so every ring step's wall time is one FULL block fold on whichever
     device is busiest; zigzag makes every device's visible fraction
     ~equal at every step (~half a block), a ~2× causal wall-time win."""
-    import numpy as np
-
     h = seq_len // (2 * n_shards)
     idx = []
     for d in range(n_shards):
@@ -155,6 +159,14 @@ def _zigzag_perm(seq_len: int, n_shards: int):
         idx.extend(range((2 * n_shards - 1 - d) * h,
                          (2 * n_shards - d) * h))
     return np.asarray(idx)
+
+
+def _zigzag_check(seq_len: int, n_shards: int) -> None:
+    """Shared validation for every zigzag entry point (standalone ring,
+    transformer 2-D/3-D steps): the permutation needs 2 stripes/shard."""
+    if seq_len % (2 * n_shards):
+        raise ValueError(f"zigzag needs seq len divisible by 2×sp: "
+                         f"{seq_len} vs {2 * n_shards}")
 
 
 def _ring_shard_zigzag(q, k, v, *, axis: str, n_shards: int,
@@ -179,14 +191,13 @@ def _ring_shard_zigzag(q, k, v, *, axis: str, n_shards: int,
     l = z[..., 0]
     q_lo, q_hi = q[:, :h], q[:, h:]
 
-    def cond_fold(pred, o_, m_, l_, q_, k_, v_, mask):
-        return lax.cond(
-            pred,
-            lambda t: _block_fold(*t, mask, scale),
-            lambda t: t[:3],
-            (o_, m_, l_, q_, k_, v_))
-
     def fold(o, m, l, kb, vb, src):
+        if not causal:
+            # quadrant splitting only buys anything under a causal
+            # mask — full attention is one ordinary block fold
+            return _block_fold(o, m, l, q, kb, vb,
+                               jnp.ones((l_loc, l_loc), bool), scale)
+
         k_lo, k_hi = kb[:, :h], kb[:, h:]
         v_lo, v_hi = vb[:, :h], vb[:, h:]
         o_lo, o_hi = o[..., :h, :], o[..., h:, :]
@@ -195,31 +206,20 @@ def _ring_shard_zigzag(q, k, v, *, axis: str, n_shards: int,
         pk_lo = src * h + jnp.arange(h)
         pk_hi = (2 * n_shards - 1 - src) * h + jnp.arange(h)
 
-        if causal:
-            # (q_low, k_low): on the diagonal band; compute iff src ≤ my
-            o_lo, m_lo, l_lo = cond_fold(
-                src <= my, o_lo, m_lo, l_lo, q_lo, k_lo, v_lo,
-                pos_lo[:, None] >= pk_lo[None, :])
-            # (q_high, k_low): high queries see every low key — always
-            o_hi, m_hi, l_hi = _block_fold(
-                o_hi, m_hi, l_hi, q_hi, k_lo, v_lo,
-                pos_hi[:, None] >= pk_lo[None, :], scale)
-            # (q_high, k_high): mirrored diagonal; compute iff src ≥ my
-            o_hi, m_hi, l_hi = cond_fold(
-                src >= my, o_hi, m_hi, l_hi, q_hi, k_hi, v_hi,
-                pos_hi[:, None] >= pk_hi[None, :])
-            # (q_low, k_high): low queries precede every high key —
-            # fully masked for every (src, my) pair, statically omitted
-        else:
-            full = jnp.ones((h, h), bool)
-            o_lo, m_lo, l_lo = _block_fold(o_lo, m_lo, l_lo, q_lo,
-                                           k_lo, v_lo, full, scale)
-            o_lo, m_lo, l_lo = _block_fold(o_lo, m_lo, l_lo, q_lo,
-                                           k_hi, v_hi, full, scale)
-            o_hi, m_hi, l_hi = _block_fold(o_hi, m_hi, l_hi, q_hi,
-                                           k_lo, v_lo, full, scale)
-            o_hi, m_hi, l_hi = _block_fold(o_hi, m_hi, l_hi, q_hi,
-                                           k_hi, v_hi, full, scale)
+        # (q_low, k_low): on the diagonal band; compute iff src ≤ my
+        o_lo, m_lo, l_lo = _cond_fold(
+            src <= my, o_lo, m_lo, l_lo, q_lo, k_lo, v_lo,
+            pos_lo[:, None] >= pk_lo[None, :], scale)
+        # (q_high, k_low): high queries see every low key — always
+        o_hi, m_hi, l_hi = _block_fold(
+            o_hi, m_hi, l_hi, q_hi, k_lo, v_lo,
+            pos_hi[:, None] >= pk_lo[None, :], scale)
+        # (q_high, k_high): mirrored diagonal; compute iff src ≥ my
+        o_hi, m_hi, l_hi = _cond_fold(
+            src >= my, o_hi, m_hi, l_hi, q_hi, k_hi, v_hi,
+            pos_hi[:, None] >= pk_hi[None, :], scale)
+        # (q_low, k_high): low queries precede every high key —
+        # fully masked for every (src, my) pair, statically omitted
         return (jnp.concatenate([o_lo, o_hi], axis=-2),
                 jnp.concatenate([m_lo, m_hi], axis=-1),
                 jnp.concatenate([l_lo, l_hi], axis=-1))
@@ -273,10 +273,7 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
     if schedule not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown ring schedule {schedule!r}")
     if schedule == "zigzag":
-        if q.shape[1] % (2 * n_shards):
-            raise ValueError(
-                f"zigzag needs seq len divisible by 2×{axis}: "
-                f"{q.shape[1]} vs {2 * n_shards}")
+        _zigzag_check(q.shape[1], n_shards)
         perm = _zigzag_perm(q.shape[1], n_shards)
         inv = perm.argsort()
         q, k, v = (x[:, perm] for x in (q, k, v))
