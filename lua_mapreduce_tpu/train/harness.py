@@ -47,6 +47,12 @@ class TrainConfig:
     max_epochs: int = 40            # init.lua max epochs
     patience: int = 10              # train_holdout_validation analog
     seed: int = 1234
+    # gradient accumulation: >1 splits each per-device batch tile into
+    # this many microbatches folded in a lax.scan before ONE optimizer
+    # update — same numbers as the big batch (mean of microbatch grads ≡
+    # grad of the mean loss), activation memory ÷ grad_accum. The
+    # standard lever when the target batch doesn't fit HBM.
+    grad_accum: int = 1
     # device-side tracing (the SURVEY §5 tracing subsystem's hot-path
     # half — JobTimes covers the host engine): when set, the SECOND
     # run_epoch call (the first is compile-skewed) is captured with
@@ -89,6 +95,7 @@ class DataParallelTrainer:
 
     def _build_step(self):
         axis, loss_fn, optimizer = self.axis, self.loss_fn, self.optimizer
+        accum = self.config.grad_accum
 
         def step(params, opt_state, x, y):
             def shard_step(params, x, y):
@@ -97,10 +104,29 @@ class DataParallelTrainer:
                 # (common.lua:112-137) fused into the backward pass. (An
                 # explicit post-grad pmean would double-count under
                 # shard_map's auto-psum of replicated-input cotangents.)
-                def global_loss(p):
-                    return lax.pmean(loss_fn(p, x, y), axis)
+                def global_loss(p, xm, ym):
+                    return lax.pmean(loss_fn(p, xm, ym), axis)
 
-                return jax.value_and_grad(global_loss)(params)
+                if accum == 1:
+                    return jax.value_and_grad(global_loss)(params, x, y)
+
+                # microbatch fold: scan keeps one microbatch's
+                # activations live at a time; grads/losses average to
+                # exactly the whole-tile values (equal-size microbatches
+                # of a mean loss)
+                xm = x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
+                ym = y.reshape(accum, y.shape[0] // accum, *y.shape[1:])
+
+                def body(carry, mb):
+                    loss_a, g_a = carry
+                    l, g = jax.value_and_grad(global_loss)(params, *mb)
+                    return (loss_a + l,
+                            jax.tree.map(jnp.add, g_a, g)), None
+
+                zeros = jax.tree.map(jnp.zeros_like, params)
+                (loss_s, g_s), _ = lax.scan(body, (0.0, zeros), (xm, ym))
+                return (loss_s / accum,
+                        jax.tree.map(lambda g: g / accum, g_s))
 
             loss, grads = jax.shard_map(
                 shard_step, mesh=self.mesh,
@@ -187,6 +213,12 @@ class DataParallelTrainer:
 
     def _shard_batch(self, x, y, batched: bool = False):
         dim = 1 if batched else 0
+        n_dp = self.mesh.shape[self.axis]
+        rows = x.shape[dim]
+        if rows % (n_dp * self.config.grad_accum):
+            raise ValueError(
+                f"batch of {rows} does not split over {self.axis}={n_dp} "
+                f"× grad_accum={self.config.grad_accum}")
         spec = [None] * (dim + 1)
         spec[dim] = self.axis
         sharding = NamedSharding(self.mesh, P(*spec))

@@ -30,6 +30,29 @@ def digits():
     return make_digits(seed=0)
 
 
+def test_grad_accum_matches_big_batch(mesh, digits):
+    """grad_accum=4 (microbatch scan, one optimizer update) must produce
+    the same step as the whole batch at once — mean of equal-size
+    microbatch grads ≡ grad of the mean loss."""
+    x, y = digits[0][:128], digits[1][:128]
+    params = init_mlp(jax.random.PRNGKey(7))
+
+    losses, stepped = {}, {}
+    for accum in (1, 4):
+        tr = DataParallelTrainer(nll_loss, params, mesh,
+                                 TrainConfig(grad_accum=accum))
+        losses[accum] = tr.step(x, y)
+        stepped[accum] = jax.tree.map(np.asarray, tr.params)
+    assert abs(losses[1] - losses[4]) < 1e-6
+    for k in stepped[1]:
+        np.testing.assert_allclose(stepped[1][k], stepped[4][k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+    with pytest.raises(ValueError, match="grad_accum"):
+        DataParallelTrainer(nll_loss, params, mesh,
+                            TrainConfig(grad_accum=3)).step(x, y)
+
+
 def test_dp_step_equals_single_device_step(mesh, digits):
     """pmean of per-shard grads == full-batch grad: one mesh step must
     match one plain optax step bit-for-bit (up to float assoc)."""
