@@ -352,11 +352,17 @@ def shard_params_moe(params: Params, mesh, *, ep_axis: str = "dp"
 
 def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
                     attn: str = "ring", dp_axis: str = "dp",
-                    sp_axis: str = "sp"):
+                    sp_axis: str = "sp", grad_accum: int = 1):
     """Jitted SPMD LM train step: ``step(params, opt_state, tokens,
     targets) -> (params, opt_state, loss)`` with tokens/targets sharded
     P(dp, sp) and the gradient all-reduce (pmean over dp AND sp) fused
     into the backward pass.
+
+    ``grad_accum`` > 1 folds that many microbatches (split along each
+    device's batch rows) in a lax.scan before the single optimizer
+    update — activation memory ÷ grad_accum, numbers identical to the
+    whole tile (the long-context lever that composes with cfg.remat:
+    remat bounds per-layer activations, accumulation bounds the batch).
 
     With ``cfg.moe_experts`` > 0 the block FFNs are switch-MoE with
     experts sharded over the dp axis (the standard ep ≡ dp grouping:
@@ -376,12 +382,30 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
         _check_seq(l_loc * n_sp, cfg)
         pos = _shard_pos(attn, sp_axis, n_sp, l_loc)
 
-        def global_loss(p):
-            local = lm_loss_local(p, tokens, targets, cfg, attn_shard,
+        def global_loss(p, tok, tgt):
+            local = lm_loss_local(p, tok, tgt, cfg, attn_shard,
                                   pos, block=block)
             return lax.pmean(lax.pmean(local, sp_axis), dp_axis)
 
-        return jax.value_and_grad(global_loss)(params)
+        if grad_accum == 1:
+            return jax.value_and_grad(global_loss)(params, tokens,
+                                                   targets)
+        rows = tokens.shape[0]
+        if rows % grad_accum:
+            raise ValueError(f"per-device batch of {rows} rows does not "
+                             f"split into grad_accum={grad_accum}")
+        tok_m = tokens.reshape(grad_accum, rows // grad_accum, l_loc)
+        tgt_m = targets.reshape(grad_accum, rows // grad_accum, l_loc)
+
+        def body(carry, mb):
+            loss_a, g_a = carry
+            l, g = jax.value_and_grad(global_loss)(params, *mb)
+            return (loss_a + l, jax.tree.map(jnp.add, g_a, g)), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (loss_s, g_s), _ = lax.scan(body, (0.0, zeros), (tok_m, tgt_m))
+        return (loss_s / grad_accum,
+                jax.tree.map(lambda g: g / grad_accum, g_s))
 
     def step(params, opt_state, tokens, targets):
         # specs derive from the ACTUAL param keys (cannot drift from

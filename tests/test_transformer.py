@@ -44,6 +44,34 @@ def test_sharded_forward_matches_oracle(mesh, cfg, params, attn):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_grad_accum_matches_whole_tile(mesh, cfg):
+    """make_train_step(grad_accum=2): identical loss/params to the
+    un-accumulated step (mean of equal microbatch grads ≡ grad of the
+    mean loss), with remat on — the two memory levers must compose."""
+    rng = np.random.RandomState(6)
+    b, l = 8, 64
+    seq = rng.randint(0, cfg.vocab, (b, l + 1))
+    tokens = jnp.asarray(seq[:, :-1], jnp.int32)
+    targets = jnp.asarray(seq[:, 1:], jnp.int32)
+    rcfg = tfm.TransformerConfig(**{**cfg.__dict__, "remat": True})
+    params = tfm.init_transformer(jax.random.PRNGKey(8), rcfg)
+    opt = optax.sgd(0.1)
+    td = tfm.shard_batch(mesh, tokens, targets)
+
+    outs = {}
+    for accum in (1, 2):
+        step = tfm.make_train_step(rcfg, mesh, opt, attn="ring",
+                                   grad_accum=accum)
+        p0 = jax.tree.map(jnp.copy, params)
+        p, _, loss = step(p0, opt.init(p0), *td)
+        outs[accum] = (float(loss), p)
+    assert abs(outs[1][0] - outs[2][0]) < 2e-6
+    for k in outs[1][1]:
+        np.testing.assert_allclose(np.asarray(outs[1][1][k]),
+                                   np.asarray(outs[2][1][k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
 def test_zigzag_step_is_dropin_for_ring(mesh, cfg):
     """attn='zigzag' must be loss- and grad-equivalent to the contiguous
     ring (the permutation is internal; the loss is a token mean)."""
