@@ -99,6 +99,11 @@ def force_cpu_if_unavailable(timeout_s: float = 120.0) -> str:
     # environment)? — nothing to probe, and probing would burn the full
     # subprocess timeout against a wedged tunnel for no decision
     if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the env var ALONE is not enough: the axon plugin's
+        # sitecustomize overrides it, so the process would still
+        # initialize (and hang on) the tunnel backend — pin the config
+        import jax
+        jax.config.update("jax_platforms", "cpu")
         return "cpu"
     j = sys.modules.get("jax")
     if j is not None and getattr(j.config, "jax_platforms", None) == "cpu":

@@ -83,3 +83,26 @@ def test_remove_results_aborts_without_confirmation(tmp_path, monkeypatch):
     rc = remove_results.main([coord])
     assert rc == 1
     assert FileJobStore(coord).get_task() is not None
+
+
+def test_lm_example_smoke():
+    """The long-context LM demo must run end to end on a virtual mesh
+    (and regression-guards the jax_env fix: with JAX_PLATFORMS=cpu in
+    the env, the process must PIN jax.config too — the axon plugin's
+    sitecustomize overrides the env var alone, which once left this
+    demo hanging on a wedged tunnel backend)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "examples.lm.train_lm", "--steps", "2",
+         "--seq", "32", "--dp", "2", "--sp", "2", "--grad-accum", "1",
+         "--batch", "4"],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done: final loss" in out.stdout
