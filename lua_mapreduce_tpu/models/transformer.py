@@ -523,9 +523,12 @@ def _block_tp(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
 
 def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
                        attn: str = "ring", dp_axis: str = "dp",
-                       sp_axis: str = "sp", mp_axis: str = "mp"):
+                       sp_axis: str = "sp", mp_axis: str = "mp",
+                       grad_accum: int = 1):
     """Jitted LM train step over a (dp, sp, mp) mesh. ``params`` must
-    come from :func:`shard_params_3d`; tokens/targets are P(dp, sp)."""
+    come from :func:`shard_params_3d`; tokens/targets are P(dp, sp).
+    ``grad_accum`` as in :func:`make_train_step` — microbatch fold
+    before the single optimizer update."""
     n_sp = mesh.shape[sp_axis]
     n_mp = mesh.shape[mp_axis]
     if cfg.n_heads % n_mp:
@@ -545,8 +548,8 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
         _check_seq(l_loc * n_sp, cfg)
         pos = _shard_pos(attn, sp_axis, n_sp, l_loc)
 
-        def global_loss(p):
-            local = lm_loss_local(p, tokens, targets, cfg, attn_shard,
+        def global_loss(p, tok, tgt):
+            local = lm_loss_local(p, tok, tgt, cfg, attn_shard,
                                   pos, block=tp_block)
             # pmean over the DATA axes only: the mp axis carries the
             # same loss replicated, and omitting it keeps the
@@ -554,7 +557,25 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
             # right scale (sum of per-slice contributions, unscaled)
             return lax.pmean(lax.pmean(local, sp_axis), dp_axis)
 
-        return jax.value_and_grad(global_loss)(params)
+        if grad_accum == 1:
+            return jax.value_and_grad(global_loss)(params, tokens,
+                                                   targets)
+        rows = tokens.shape[0]
+        if rows % grad_accum:
+            raise ValueError(f"per-device batch of {rows} rows does not "
+                             f"split into grad_accum={grad_accum}")
+        tok_m = tokens.reshape(grad_accum, rows // grad_accum, l_loc)
+        tgt_m = targets.reshape(grad_accum, rows // grad_accum, l_loc)
+
+        def body(carry, mb):
+            loss_a, g_a = carry
+            l, g = jax.value_and_grad(global_loss)(params, *mb)
+            return (loss_a + l, jax.tree.map(jnp.add, g_a, g)), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (loss_s, g_s), _ = lax.scan(body, (0.0, zeros), (tok_m, tgt_m))
+        return (loss_s / grad_accum,
+                jax.tree.map(lambda g: g / grad_accum, g_s))
 
     def specs_tree(params_like):
         return {k: _spec_for(k, specs) for k in params_like}

@@ -224,6 +224,30 @@ class Test3D:
                 np.asarray(outs["zigzag"][1][k]),
                 rtol=2e-4, atol=2e-4, err_msg=k)
 
+    def test_3d_grad_accum_matches_whole_tile(self, mesh3, cfg):
+        rng = np.random.RandomState(11)
+        b, l = 4, 32
+        seq = rng.randint(0, cfg.vocab, (b, l + 1))
+        tokens = jnp.asarray(seq[:, :-1], jnp.int32)
+        targets = jnp.asarray(seq[:, 1:], jnp.int32)
+        opt = optax.sgd(0.1)
+        params0 = tfm.init_transformer(jax.random.PRNGKey(12), cfg)
+
+        outs = {}
+        for accum in (1, 2):
+            step = tfm.make_train_step_3d(cfg, mesh3, opt, attn="ring",
+                                          grad_accum=accum)
+            p = tfm.shard_params_3d(
+                jax.tree.map(jnp.copy, params0), mesh3, cfg)
+            p, _, loss = step(p, opt.init(p),
+                              *tfm.shard_batch(mesh3, tokens, targets))
+            outs[accum] = (float(loss), tfm.unshard_params_3d(p, cfg))
+        assert abs(outs[1][0] - outs[2][0]) < 2e-6
+        for k in outs[1][1]:
+            np.testing.assert_allclose(
+                np.asarray(outs[1][1][k]), np.asarray(outs[2][1][k]),
+                rtol=1e-5, atol=1e-6, err_msg=k)
+
     def test_3d_training_learns(self, mesh3, cfg):
         rng = np.random.RandomState(1)
         b, l = 8, 32
