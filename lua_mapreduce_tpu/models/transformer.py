@@ -42,6 +42,8 @@ from lua_mapreduce_tpu.parallel.ring_attention import (
 
 Params = Dict[str, jnp.ndarray]
 
+_NEG_INF_DECODE = -1e30   # finite mask fill (ring_attention._NEG_INF twin)
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -214,6 +216,84 @@ def _forward(params: Params, tokens, pos, cfg: TransformerConfig,
         aux_total = aux_total + aux
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
     return x @ params["tok_emb"].T, aux_total           # tied head
+
+
+def greedy_decode(params: Params, prompt, n_new: int, *,
+                  cfg: TransformerConfig = TransformerConfig()
+                  ) -> jnp.ndarray:
+    """KV-cached greedy decoding: (B, P) int32 prompt → (B, P+n_new).
+
+    The inference half of the LM family (training: make_train_step).
+    One ``lax.scan`` over positions with per-layer (B, L, H, Dh) caches
+    in the carry — static shapes throughout, so the whole decode is one
+    compiled program; each step attends its single query against the
+    cache under an iota≤t mask. Inside the prompt the next input is the
+    given token (prefill and generation share one code path); after it,
+    the argmax. Exactness is pinned by a test re-running the FULL
+    forward at every prefix — the cache must change nothing.
+
+    Dense FFN only: MoE routing capacity is defined per batch-of-tokens
+    group and a 1-token step would route degenerately."""
+    if cfg.moe_experts:
+        raise ValueError("greedy_decode supports dense-FFN configs; "
+                         "MoE capacity is per token group, degenerate "
+                         "at one position per step")
+    b, p_len = prompt.shape
+    total = p_len + n_new
+    _check_seq(total, cfg)
+    h, hd = cfg.n_heads, cfg.d_model // cfg.n_heads
+
+    caches = {
+        f"L{i}_{kv}": jnp.zeros((b, total, h, hd), params["tok_emb"].dtype)
+        for i in range(cfg.n_layers) for kv in ("k", "v")
+    }
+    # position t reads its input from `prompt` while t < p_len, else the
+    # previously generated token riding the carry
+    pad = jnp.zeros((b, total - p_len), jnp.int32)
+    given = jnp.concatenate([prompt.astype(jnp.int32), pad], axis=1)
+
+    def step(carry, t):
+        caches, cur = carry
+        tok = jnp.where(t < p_len, given[:, t], cur)    # (B,)
+        x = params["tok_emb"][tok] + params["pos_emb"][t]   # (B, D)
+        x = x[:, None, :]                               # (B, 1, D)
+        for i in range(cfg.n_layers):
+            pfx = f"L{i}"
+            y = _layer_norm(x, params[f"{pfx}_ln1_g"],
+                            params[f"{pfx}_ln1_b"])
+            qkv = y @ params[f"{pfx}_qkv_W"]
+            q, k, v = (s.reshape(b, 1, h, hd)
+                       for s in jnp.split(qkv, 3, axis=-1))
+            ck = lax.dynamic_update_slice(
+                caches[f"{pfx}_k"], k, (0, t, 0, 0))
+            cv = lax.dynamic_update_slice(
+                caches[f"{pfx}_v"], v, (0, t, 0, 0))
+            caches = {**caches, f"{pfx}_k": ck, f"{pfx}_v": cv}
+            s = jnp.einsum("bqhd,bmhd->bhqm", q, ck,
+                           preferred_element_type=jnp.float32)
+            s = s / jnp.sqrt(jnp.float32(hd))
+            s = jnp.where(jnp.arange(total)[None, None, None, :] <= t,
+                          s, _NEG_INF_DECODE)
+            w = jax.nn.softmax(s, axis=-1)
+            a = jnp.einsum("bhqm,bmhd->bqhd", w.astype(cv.dtype), cv,
+                           preferred_element_type=jnp.float32)
+            a = a.astype(x.dtype).reshape(b, 1, cfg.d_model)
+            x = x + a @ params[f"{pfx}_out_W"]
+            y = _layer_norm(x, params[f"{pfx}_ln2_g"],
+                            params[f"{pfx}_ln2_b"])
+            ff, _ = _ffn(params, pfx, y, cfg, None)
+            x = x + ff
+        x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+        logits = (x @ params["tok_emb"].T)[:, 0]        # (B, vocab)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (caches, nxt), nxt
+
+    (_, _), emitted = lax.scan(step, (caches, given[:, 0]),
+                               jnp.arange(total))
+    # emitted[t] is the model's prediction AFTER seeing position t;
+    # output = prompt ‖ generated continuation
+    gen = jnp.transpose(emitted, (1, 0))[:, p_len - 1:total - 1]
+    return jnp.concatenate([prompt.astype(jnp.int32), gen], axis=1)
 
 
 def transformer_apply(params: Params, tokens, *,

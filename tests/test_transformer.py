@@ -492,3 +492,59 @@ def test_flops_per_token_accounting():
     # non-causal doubles only the attention term
     delta = flops_per_token(cfg, L, causal=False) - flops_per_token(cfg, L)
     assert delta == 3.0 * 2 * (2.0 * L * d)
+
+
+class TestGreedyDecode:
+    """KV-cached decode vs the no-cache oracle: identical tokens."""
+
+    def test_matches_full_forward_rerun(self, cfg):
+        rng = np.random.RandomState(13)
+        params = tfm.init_transformer(jax.random.PRNGKey(13), cfg)
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab, (3, 5)), jnp.int32)
+        n_new = 7
+        got = tfm.greedy_decode(params, prompt, n_new, cfg=cfg)
+        assert got.shape == (3, 12)
+        assert np.array_equal(np.asarray(got[:, :5]), np.asarray(prompt))
+
+        # naive loop: re-run the FULL forward at every prefix
+        toks = prompt
+        for _ in range(n_new):
+            logits = tfm.transformer_apply(params, toks, cfg=cfg)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        assert np.array_equal(np.asarray(got), np.asarray(toks))
+
+    def test_trained_model_continues_pattern(self, mesh, cfg):
+        """Train on tok[t+1] = tok[t] + 1 (mod vocab), then decode: the
+        continuation must follow the arithmetic pattern."""
+        rng = np.random.RandomState(14)
+        b, l = 8, 64
+        start = rng.randint(0, cfg.vocab, (b, 1))
+        seq = (start + np.arange(l + 1)) % cfg.vocab
+        tokens = jnp.asarray(seq[:, :-1], jnp.int32)
+        targets = jnp.asarray(seq[:, 1:], jnp.int32)
+        params = tfm.init_transformer(jax.random.PRNGKey(2), cfg)
+        opt = optax.adam(3e-3)
+        opt_state = opt.init(params)
+        step = tfm.make_train_step(cfg, mesh, opt, attn="ring")
+        td = tfm.shard_batch(mesh, tokens, targets)
+        for _ in range(60):
+            params, opt_state, _ = step(params, opt_state, *td)
+
+        prompt = jnp.asarray((np.arange(8) + 3) % cfg.vocab,
+                             jnp.int32)[None, :]
+        out = np.asarray(tfm.greedy_decode(params, prompt, 8, cfg=cfg))[0]
+        want = (np.arange(16) + 3) % cfg.vocab
+        # chance is 1/64 per token; ≥half right after 60 tiny-model
+        # steps demonstrates the decode drives a LEARNED continuation
+        acc = float(np.mean(out[8:] == want[8:]))
+        assert acc >= 0.5, (out.tolist(), want.tolist())
+
+    def test_moe_rejected(self):
+        moe_cfg = tfm.TransformerConfig(vocab=16, d_model=16, n_heads=2,
+                                        n_layers=1, d_ff=32, max_seq=32,
+                                        moe_experts=2, moe_capacity=8)
+        params = tfm.init_transformer(jax.random.PRNGKey(0), moe_cfg)
+        with pytest.raises(ValueError, match="dense"):
+            tfm.greedy_decode(params, jnp.zeros((1, 4), jnp.int32), 2,
+                              cfg=moe_cfg)
