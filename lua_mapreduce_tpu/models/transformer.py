@@ -219,9 +219,11 @@ def _forward(params: Params, tokens, pos, cfg: TransformerConfig,
 
 
 def greedy_decode(params: Params, prompt, n_new: int, *,
-                  cfg: TransformerConfig = TransformerConfig()
-                  ) -> jnp.ndarray:
-    """KV-cached greedy decoding: (B, P) int32 prompt → (B, P+n_new).
+                  cfg: TransformerConfig = TransformerConfig(),
+                  temperature: float = 0.0,
+                  top_k: Optional[int] = None,
+                  key=None) -> jnp.ndarray:
+    """KV-cached decoding: (B, P) int32 prompt → (B, P+n_new).
 
     The inference half of the LM family (training: make_train_step).
     One ``lax.scan`` over positions with per-layer (B, L, H, Dh) caches
@@ -229,8 +231,11 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     compiled program; each step attends its single query against the
     cache under an iota≤t mask. Inside the prompt the next input is the
     given token (prefill and generation share one code path); after it,
-    the argmax. Exactness is pinned by a test re-running the FULL
-    forward at every prefix — the cache must change nothing.
+    the selected token: argmax when ``temperature`` is 0 (greedy — the
+    default, pinned token-exact against re-running the FULL forward at
+    every prefix), otherwise a categorical sample of logits/temperature
+    (requires ``key``), optionally truncated to the ``top_k`` highest
+    logits. Sampling is deterministic per (key, position).
 
     Dense FFN only: MoE routing capacity is defined per batch-of-tokens
     group and a 1-token step would route degenerately."""
@@ -238,6 +243,12 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
         raise ValueError("greedy_decode supports dense-FFN configs; "
                          "MoE capacity is per token group, degenerate "
                          "at one position per step")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
     b, p_len = prompt.shape
     total = p_len + n_new
     _check_seq(total, cfg)
@@ -285,7 +296,15 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
             x = x + ff
         x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
         logits = (x @ params["tok_emb"].T)[:, 0]        # (B, vocab)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            lg = logits.astype(jnp.float32) / temperature
+            if top_k is not None and top_k < cfg.vocab:
+                kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+                lg = jnp.where(lg >= kth, lg, _NEG_INF_DECODE)
+            nxt = jax.random.categorical(
+                jax.random.fold_in(key, t), lg, axis=-1).astype(jnp.int32)
         return (caches, nxt), nxt
 
     (_, _), emitted = lax.scan(step, (caches, given[:, 0]),

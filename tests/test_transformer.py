@@ -540,6 +540,27 @@ class TestGreedyDecode:
         acc = float(np.mean(out[8:] == want[8:]))
         assert acc >= 0.5, (out.tolist(), want.tolist())
 
+    def test_sampling(self, cfg):
+        params = tfm.init_transformer(jax.random.PRNGKey(20), cfg)
+        prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        k = jax.random.PRNGKey(0)
+        a = tfm.greedy_decode(params, prompt, 6, cfg=cfg,
+                              temperature=1.0, key=k)
+        b = tfm.greedy_decode(params, prompt, 6, cfg=cfg,
+                              temperature=1.0, key=k)
+        assert np.array_equal(np.asarray(a), np.asarray(b))  # per-key det.
+        assert np.all(np.asarray(a) < cfg.vocab)
+        c = tfm.greedy_decode(params, prompt, 6, cfg=cfg, temperature=1.0,
+                              key=jax.random.PRNGKey(9), top_k=3)
+        assert c.shape == (1, 10)
+        # near-zero temperature concentrates on the argmax → greedy
+        d = tfm.greedy_decode(params, prompt, 6, cfg=cfg,
+                              temperature=1e-4, key=k)
+        g = tfm.greedy_decode(params, prompt, 6, cfg=cfg)
+        assert np.array_equal(np.asarray(d), np.asarray(g))
+        with pytest.raises(ValueError, match="PRNG"):
+            tfm.greedy_decode(params, prompt, 2, cfg=cfg, temperature=0.5)
+
     def test_moe_rejected(self):
         moe_cfg = tfm.TransformerConfig(vocab=16, d_model=16, n_heads=2,
                                         n_layers=1, d_ff=32, max_seq=32,
