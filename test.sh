@@ -1,0 +1,11 @@
+#!/bin/bash
+# Self-test entry point (reference test.sh analog): per-module utest()
+# sweep, then the full golden-diff + unit suite on the virtual 8-device
+# CPU mesh. The reference's screen-backed multi-storage e2e matrix
+# (test.sh:8-73) lives in tests/ as pytest suites (test_wordcount_golden
+# covers every storage x combiner/reducer-property config; see
+# SURVEY.md §4).
+set -e
+cd "$(dirname "$0")"
+python -c "import lua_mapreduce_tpu; lua_mapreduce_tpu.utest(); print('utest: all module self-tests passed')"
+python -m pytest tests/ -q
