@@ -37,12 +37,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from lua_mapreduce_tpu.parallel import moe as _moe
 from lua_mapreduce_tpu.parallel.pipeline import pipeline_apply
 from lua_mapreduce_tpu.parallel.ring_attention import (
-    _ring_shard, _ring_shard_zigzag, _ulysses_shard, _zigzag_check,
-    _zigzag_perm, attention_reference)
+    _NEG_INF, _ring_shard, _ring_shard_zigzag, _ulysses_shard,
+    _zigzag_check, _zigzag_perm, attention_reference)
+from lua_mapreduce_tpu.train.accum import accum_value_and_grad
 
 Params = Dict[str, jnp.ndarray]
-
-_NEG_INF_DECODE = -1e30   # finite mask fill (ring_attention._NEG_INF twin)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -250,6 +249,10 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
     b, p_len = prompt.shape
+    if p_len < 1:
+        raise ValueError("prompt must contain at least one token "
+                         "(an empty prompt would silently return an "
+                         "empty continuation)")
     total = p_len + n_new
     _check_seq(total, cfg)
     h, hd = cfg.n_heads, cfg.d_model // cfg.n_heads
@@ -284,7 +287,7 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
                            preferred_element_type=jnp.float32)
             s = s / jnp.sqrt(jnp.float32(hd))
             s = jnp.where(jnp.arange(total)[None, None, None, :] <= t,
-                          s, _NEG_INF_DECODE)
+                          s, _NEG_INF)
             w = jax.nn.softmax(s, axis=-1)
             a = jnp.einsum("bhqm,bmhd->bqhd", w.astype(cv.dtype), cv,
                            preferred_element_type=jnp.float32)
@@ -302,7 +305,7 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
             lg = logits.astype(jnp.float32) / temperature
             if top_k is not None and top_k < cfg.vocab:
                 kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-                lg = jnp.where(lg >= kth, lg, _NEG_INF_DECODE)
+                lg = jnp.where(lg >= kth, lg, _NEG_INF)
             nxt = jax.random.categorical(
                 jax.random.fold_in(key, t), lg, axis=-1).astype(jnp.int32)
         return (caches, nxt), nxt
@@ -471,6 +474,12 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
     attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
     moe_axis = None
     if cfg.moe_experts:
+        if grad_accum > 1:
+            raise ValueError(
+                "grad_accum > 1 with moe_experts > 0 would silently "
+                "change the numbers: MoE capacity and the aux loss are "
+                "defined per device tile, so quarter-size microbatches "
+                "drop/route tokens differently than the whole tile")
         _check_moe(cfg, mesh.shape[dp_axis])
         moe_axis = dp_axis
     block = functools.partial(_block, moe_axis=moe_axis)
@@ -489,22 +498,8 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
         if grad_accum == 1:
             return jax.value_and_grad(global_loss)(params, tokens,
                                                    targets)
-        rows = tokens.shape[0]
-        if rows % grad_accum:
-            raise ValueError(f"per-device batch of {rows} rows does not "
-                             f"split into grad_accum={grad_accum}")
-        tok_m = tokens.reshape(grad_accum, rows // grad_accum, l_loc)
-        tgt_m = targets.reshape(grad_accum, rows // grad_accum, l_loc)
-
-        def body(carry, mb):
-            loss_a, g_a = carry
-            l, g = jax.value_and_grad(global_loss)(params, *mb)
-            return (loss_a + l, jax.tree.map(jnp.add, g_a, g)), None
-
-        zeros = jax.tree.map(jnp.zeros_like, params)
-        (loss_s, g_s), _ = lax.scan(body, (0.0, zeros), (tok_m, tgt_m))
-        return (loss_s / grad_accum,
-                jax.tree.map(lambda g: g / grad_accum, g_s))
+        return accum_value_and_grad(global_loss, params,
+                                    (tokens, targets), grad_accum)
 
     def step(params, opt_state, tokens, targets):
         # specs derive from the ACTUAL param keys (cannot drift from
@@ -659,22 +654,8 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
         if grad_accum == 1:
             return jax.value_and_grad(global_loss)(params, tokens,
                                                    targets)
-        rows = tokens.shape[0]
-        if rows % grad_accum:
-            raise ValueError(f"per-device batch of {rows} rows does not "
-                             f"split into grad_accum={grad_accum}")
-        tok_m = tokens.reshape(grad_accum, rows // grad_accum, l_loc)
-        tgt_m = targets.reshape(grad_accum, rows // grad_accum, l_loc)
-
-        def body(carry, mb):
-            loss_a, g_a = carry
-            l, g = jax.value_and_grad(global_loss)(params, *mb)
-            return (loss_a + l, jax.tree.map(jnp.add, g_a, g)), None
-
-        zeros = jax.tree.map(jnp.zeros_like, params)
-        (loss_s, g_s), _ = lax.scan(body, (0.0, zeros), (tok_m, tgt_m))
-        return (loss_s / grad_accum,
-                jax.tree.map(lambda g: g / grad_accum, g_s))
+        return accum_value_and_grad(global_loss, params,
+                                    (tokens, targets), grad_accum)
 
     def specs_tree(params_like):
         return {k: _spec_for(k, specs) for k in params_like}

@@ -1,0 +1,38 @@
+"""Gradient accumulation: the microbatch value_and_grad fold.
+
+ONE implementation shared by the DP trainer (train/harness.py) and both
+transformer train steps (models/transformer.py) — the fold splits each
+per-device batch tile into ``accum`` equal microbatches, scans
+``value_and_grad`` over them keeping one microbatch's activations live
+at a time, and returns the tile-mean (loss, grads): identical numbers
+to the whole tile, activation memory ÷ accum.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def accum_value_and_grad(global_loss, params, arrays, accum: int):
+    """Mean ``value_and_grad(global_loss)(params, *microbatch)`` over
+    ``accum`` equal microbatches of ``arrays`` (split on the leading
+    axis). ``global_loss(params, *arrays) -> scalar`` must be a MEAN
+    over examples, so equal-size microbatch grads average exactly to
+    the whole-tile grad."""
+    rows = arrays[0].shape[0]
+    if rows % accum:
+        raise ValueError(f"per-device batch of {rows} rows does not "
+                         f"split into grad_accum={accum}")
+    micro = tuple(a.reshape(accum, rows // accum, *a.shape[1:])
+                  for a in arrays)
+
+    def body(carry, mb):
+        loss_a, g_a = carry
+        l, g = jax.value_and_grad(global_loss)(params, *mb)
+        return (loss_a + l, jax.tree.map(jnp.add, g_a, g)), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (loss_s, g_s), _ = lax.scan(body, (0.0, zeros), micro)
+    return loss_s / accum, jax.tree.map(lambda g: g / accum, g_s)

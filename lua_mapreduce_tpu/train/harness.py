@@ -31,6 +31,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from lua_mapreduce_tpu.train import checkpoint as ckpt
+from lua_mapreduce_tpu.train.accum import accum_value_and_grad
 
 
 @dataclasses.dataclass
@@ -109,24 +110,11 @@ class DataParallelTrainer:
 
                 if accum == 1:
                     return jax.value_and_grad(global_loss)(params, x, y)
-
-                # microbatch fold: scan keeps one microbatch's
-                # activations live at a time; grads/losses average to
-                # exactly the whole-tile values (equal-size microbatches
-                # of a mean loss)
-                xm = x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
-                ym = y.reshape(accum, y.shape[0] // accum, *y.shape[1:])
-
-                def body(carry, mb):
-                    loss_a, g_a = carry
-                    l, g = jax.value_and_grad(global_loss)(params, *mb)
-                    return (loss_a + l,
-                            jax.tree.map(jnp.add, g_a, g)), None
-
-                zeros = jax.tree.map(jnp.zeros_like, params)
-                (loss_s, g_s), _ = lax.scan(body, (0.0, zeros), (xm, ym))
-                return (loss_s / accum,
-                        jax.tree.map(lambda g: g / accum, g_s))
+                # microbatch fold: one scan keeps a single microbatch's
+                # activations live at a time (shared implementation,
+                # train/accum.py)
+                return accum_value_and_grad(global_loss, params, (x, y),
+                                            accum)
 
             loss, grads = jax.shard_map(
                 shard_step, mesh=self.mesh,

@@ -569,3 +569,17 @@ class TestGreedyDecode:
         with pytest.raises(ValueError, match="dense"):
             tfm.greedy_decode(params, jnp.zeros((1, 4), jnp.int32), 2,
                               cfg=moe_cfg)
+
+
+def test_moe_with_grad_accum_rejected(mesh):
+    moe_cfg = tfm.TransformerConfig(vocab=16, d_model=16, n_heads=4,
+                                    n_layers=1, d_ff=32, max_seq=64,
+                                    moe_experts=2, moe_capacity=8)
+    with pytest.raises(ValueError, match="grad_accum"):
+        tfm.make_train_step(moe_cfg, mesh, optax.sgd(0.1), grad_accum=2)
+
+
+def test_empty_prompt_rejected(cfg):
+    params = tfm.init_transformer(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="at least one token"):
+        tfm.greedy_decode(params, jnp.zeros((1, 0), jnp.int32), 4, cfg=cfg)
