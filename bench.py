@@ -149,9 +149,12 @@ def bench_mapreduce_path(iterations: int = 3) -> float:
 
 def main() -> None:
     # a wedged single-tenant TPU tunnel hangs backend init forever; probe
-    # from a killable subprocess and fall back to CPU rather than hang
+    # from a killable subprocess and fall back to CPU rather than hang.
+    # This is the one artifact the driver keeps per round, so a negative
+    # verdict is retried fresh (3 probes over ~5 min) in case the tunnel
+    # recovered after the cached negative (VERDICT r2 item 2).
     from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
-    force_cpu_if_unavailable()
+    force_cpu_if_unavailable(retries=3, retry_wait_s=60.0)
 
     import jax
 

@@ -53,8 +53,19 @@ def init(args):
         "max_steps": int(args.get("max_steps", 40)),   # max epochs init.lua:20
         "patience": int(args.get("patience", 5)),
         "seed": int(args.get("seed", 0)),
+        # real-data contract (init.lua:80-123): a digits sheet image
+        # sliced into 16x16 patterns, 800/200 split; synthetic fallback
+        "image": args.get("image"),
     }
-    _data = make_digits(seed=_cfg["seed"], dim=_cfg["sizes"][0])
+    if _cfg["image"]:
+        from lua_mapreduce_tpu.train.data import load_digits_image
+        _data = load_digits_image(_cfg["image"])
+        if _data[0].shape[1] != _cfg["sizes"][0]:
+            raise ValueError(
+                f"digits sheet patterns are {_data[0].shape[1]}-dim but "
+                f"the model expects {_cfg['sizes'][0]} inputs")
+    else:
+        _data = make_digits(seed=_cfg["seed"], dim=_cfg["sizes"][0])
     store = get_storage_from(_cfg["model_store"])
     if not store.exists(MODEL_FILE):
         params = init_mlp(jax.random.PRNGKey(_cfg["seed"]), _cfg["sizes"])

@@ -16,10 +16,24 @@ NUM_REDUCERS = 15       # reference partitionfn.lua:2
 
 _corpus_dir = None
 _n_splits = corpus.N_SPLITS
+_files = None
 
 
 def init(args):
-    global _corpus_dir, _n_splits
+    global _corpus_dir, _n_splits, _files
+    # file-driven path (the reference's actual usage: taskfn.lua lists
+    # 197 REAL Europarl split files from disk): pass "files" — explicit
+    # ordered split paths — and no synthetic corpus is built. Europarl
+    # format is plain text, one sentence per line; mapfn just needs
+    # whitespace-tokenizable text, so any such files work.
+    _files = args.get("files")
+    if _files is not None:
+        missing = [p for p in _files if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(
+                f"{len(missing)} corpus split(s) not found, first: "
+                f"{missing[0]}")
+        return
     _corpus_dir = args["corpus_dir"]
     _n_splits = int(args.get("n_splits", corpus.N_SPLITS))
     if args.get("build", True):
@@ -29,6 +43,11 @@ def init(args):
 def taskfn(emit):
     # emit exactly the configured splits — globbing would silently count
     # extra splits present in a shared corpus dir
+    if _files is not None:
+        for i, path in enumerate(_files):
+            # basename collisions across dirs must stay distinct keys
+            emit(f"{i:03d}:{os.path.basename(path)}", path)
+        return
     for i in range(_n_splits):
         path = corpus.split_path(_corpus_dir, i)
         emit(os.path.basename(path), path)

@@ -29,7 +29,7 @@ from lua_mapreduce_tpu.store.router import get_storage_from
 MAP_NS = "map_jobs"
 RED_NS = "red_jobs"
 
-_CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "phases")
+_CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases")
 
 
 class Worker:
@@ -43,6 +43,11 @@ class Worker:
         self.max_iter = 20
         self.max_sleep = 20.0
         self.max_tasks = 1
+        # bounded lifetime in executed JOBS (None = unlimited): an
+        # elastic pool can recycle members mid-task — the job store's
+        # claim protocol owes correctness to arbitrary join/leave, and
+        # soak tests churn the pool through exactly this knob
+        self.max_jobs = None
         # which phases this worker claims — ("map",) / ("reduce",) build
         # heterogeneous pools (the sshfs pull model's distinct mapper
         # hosts, fs.lua:143-160); default runs everything like the
@@ -187,7 +192,13 @@ class Worker:
         tasks_done = 0
         sleep = DEFAULT_SLEEP
         saw_work = False
+        jobs_at_start = self.jobs_executed
         while idle_iters < self.max_iter and tasks_done < self.max_tasks:
+            if (self.max_jobs is not None and
+                    self.jobs_executed - jobs_at_start >= self.max_jobs):
+                self._log(f"leaving after {self.max_jobs} jobs "
+                          "(bounded lifetime)")
+                break
             try:
                 outcome = self.poll_once()
             except Exception:

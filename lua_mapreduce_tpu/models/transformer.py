@@ -236,12 +236,18 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     (requires ``key``), optionally truncated to the ``top_k`` highest
     logits. Sampling is deterministic per (key, position).
 
-    Dense FFN only: MoE routing capacity is defined per batch-of-tokens
-    group and a 1-token step would route degenerately."""
+    MoE configs decode with capacity-bounded switch routing per STEP:
+    the routing group at position t is that step's B tokens (one per
+    batch row), with the effective capacity ``min(moe_capacity, B)`` —
+    a bucket can never hold more than B tokens, so the clamp changes
+    no drop decision, only the dispatch shapes. When no bucket
+    overflows anywhere (capacity ≥ its worst-case load), decode is
+    token-exact against the full-forward oracle; under overflow the
+    drop ORDER differs (the oracle's cumulative token order runs over
+    the whole (B, L) tile, a step's over its B tokens), matching the
+    train-time rule that capacity semantics follow the routing group."""
     if cfg.moe_experts:
-        raise ValueError("greedy_decode supports dense-FFN configs; "
-                         "MoE capacity is per token group, degenerate "
-                         "at one position per step")
+        _check_moe(cfg)
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0 and key is None:
@@ -256,6 +262,10 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     total = p_len + n_new
     _check_seq(total, cfg)
     h, hd = cfg.n_heads, cfg.d_model // cfg.n_heads
+    # per-step routing group = B tokens; clamp dispatch capacity to it
+    step_cfg = (dataclasses.replace(cfg, moe_capacity=min(cfg.moe_capacity,
+                                                          b))
+                if cfg.moe_experts else cfg)
 
     caches = {
         f"L{i}_{kv}": jnp.zeros((b, total, h, hd), params["tok_emb"].dtype)
@@ -295,7 +305,7 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
             x = x + a @ params[f"{pfx}_out_W"]
             y = _layer_norm(x, params[f"{pfx}_ln2_g"],
                             params[f"{pfx}_ln2_b"])
-            ff, _ = _ffn(params, pfx, y, cfg, None)
+            ff, _ = _ffn(params, pfx, y, step_cfg, None)
             x = x + ff
         x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
         logits = (x @ params["tok_emb"].T)[:, 0]        # (B, vocab)
@@ -367,15 +377,22 @@ def _shard_pos(attn: str, sp_axis: str, n_sp: int, l_loc: int):
     return lax.axis_index(sp_axis) * l_loc + jnp.arange(l_loc)
 
 
-def _maybe_zigzag(attn: str, n_sp: int, *seqs):
+def _maybe_zigzag(attn: str, n_sp: int, *seqs, pre_permuted: bool = False):
     """Apply the internal zigzag permutation to (B, L) sequence arrays
     at a step/apply boundary; identity for other schedules. Returns the
     permuted arrays plus the permutation (None when not zigzag) so a
-    forward can un-permute its outputs."""
+    forward can un-permute its outputs.
+
+    ``pre_permuted=True`` (zigzag only) declares the arrays already in
+    zigzag layout — validated, not re-permuted (the caller permuted
+    host-side via ``shard_batch(..., schedule="zigzag")``, avoiding the
+    per-step cross-shard gather of sharded arrays)."""
     if attn != "zigzag":
         return (*seqs, None)
     _zigzag_check(seqs[0].shape[1], n_sp)
     perm = _zigzag_perm(seqs[0].shape[1], n_sp)
+    if pre_permuted:
+        return (*seqs, perm)
     return (*(s[:, perm] for s in seqs), perm)
 
 
@@ -454,7 +471,8 @@ def shard_params_moe(params: Params, mesh, *, ep_axis: str = "dp"
 
 def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
                     attn: str = "ring", dp_axis: str = "dp",
-                    sp_axis: str = "sp", grad_accum: int = 1):
+                    sp_axis: str = "sp", grad_accum: int = 1,
+                    zigzag_layout: bool = False):
     """Jitted SPMD LM train step: ``step(params, opt_state, tokens,
     targets) -> (params, opt_state, loss)`` with tokens/targets sharded
     P(dp, sp) and the gradient all-reduce (pmean over dp AND sp) fused
@@ -469,7 +487,16 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
     With ``cfg.moe_experts`` > 0 the block FFNs are switch-MoE with
     experts sharded over the dp axis (the standard ep ≡ dp grouping:
     expert buckets ride all_to_all between data-parallel peers); params
-    must then come from :func:`shard_params_moe`."""
+    must then come from :func:`shard_params_moe`.
+
+    ``zigzag_layout=True`` (``attn="zigzag"`` only) declares tokens and
+    targets ALREADY in zigzag order — feed batches through
+    ``shard_batch(..., schedule="zigzag")``, which permutes host-side
+    before device_put. The default path permutes inside the jitted step,
+    which on P(dp, sp)-sharded arrays is a per-step cross-shard gather
+    (ADVICE r2); the pre-permuted path removes it from steady state."""
+    if zigzag_layout and attn != "zigzag":
+        raise ValueError("zigzag_layout=True requires attn='zigzag'")
     n_sp = mesh.shape[sp_axis]
     attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
     moe_axis = None
@@ -509,8 +536,8 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
         # zigzag: tokens AND targets ride the same internal
         # permutation; the loss is a token mean, so no
         # un-permutation is needed — drop-in for the ring
-        tokens, targets, _ = _maybe_zigzag(attn, n_sp, tokens,
-                                           targets)
+        tokens, targets, _ = _maybe_zigzag(attn, n_sp, tokens, targets,
+                                           pre_permuted=zigzag_layout)
         mapped = jax.shard_map(
             shard_step, mesh=mesh,
             in_specs=(specs, P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
@@ -523,8 +550,23 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-def shard_batch(mesh, tokens, targets, dp_axis="dp", sp_axis="sp"):
-    """Place a (B, L) batch with batch over dp, sequence over sp."""
+def shard_batch(mesh, tokens, targets, dp_axis="dp", sp_axis="sp",
+                schedule="contiguous"):
+    """Place a (B, L) batch with batch over dp, sequence over sp.
+
+    ``schedule="zigzag"`` permutes both arrays into zigzag sequence
+    order HOST-SIDE before device_put (cheap numpy indexing, not a
+    cross-shard collective) — the data path for train steps built with
+    ``zigzag_layout=True``. Tokens and targets ride the same
+    permutation, so the next-token pairing is preserved row-wise."""
+    if schedule == "zigzag":
+        n_sp = mesh.shape[sp_axis]
+        _zigzag_check(np.shape(tokens)[1], n_sp)
+        perm = _zigzag_perm(np.shape(tokens)[1], n_sp)
+        tokens = np.asarray(tokens)[:, perm]
+        targets = np.asarray(targets)[:, perm]
+    elif schedule != "contiguous":
+        raise ValueError(f"unknown schedule {schedule!r}")
     sharding = NamedSharding(mesh, P(dp_axis, sp_axis))
     return (jax.device_put(tokens, sharding),
             jax.device_put(targets, sharding))
@@ -618,11 +660,14 @@ def _block_tp(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
 def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
                        attn: str = "ring", dp_axis: str = "dp",
                        sp_axis: str = "sp", mp_axis: str = "mp",
-                       grad_accum: int = 1):
+                       grad_accum: int = 1, zigzag_layout: bool = False):
     """Jitted LM train step over a (dp, sp, mp) mesh. ``params`` must
     come from :func:`shard_params_3d`; tokens/targets are P(dp, sp).
-    ``grad_accum`` as in :func:`make_train_step` — microbatch fold
-    before the single optimizer update."""
+    ``grad_accum`` and ``zigzag_layout`` as in :func:`make_train_step` —
+    microbatch fold before the single optimizer update; host-side
+    pre-permuted zigzag batches via ``shard_batch(schedule="zigzag")``."""
+    if zigzag_layout and attn != "zigzag":
+        raise ValueError("zigzag_layout=True requires attn='zigzag'")
     n_sp = mesh.shape[sp_axis]
     n_mp = mesh.shape[mp_axis]
     if cfg.n_heads % n_mp:
@@ -662,8 +707,8 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
 
     def step(params, opt_state, tokens, targets):
         # same internal zigzag permutation as the 2-D step
-        tokens, targets, _ = _maybe_zigzag(attn, n_sp, tokens,
-                                           targets)
+        tokens, targets, _ = _maybe_zigzag(attn, n_sp, tokens, targets,
+                                           pre_permuted=zigzag_layout)
         mapped = jax.shard_map(
             shard_step, mesh=mesh,
             in_specs=(specs_tree(params), P(dp_axis, sp_axis),

@@ -23,7 +23,10 @@ import jax
 
 # Measured on a TPU v5e (benchmarks/results/kernels.json): XLA's conv
 # lowering beats the im2col+Pallas path (45.7 vs 7.9 TF/s on the ResNet
-# 56×56 block) and its large-matmul schedule beats the Pallas one; the
+# 56×56 block) and its large-matmul schedule beats the round-2 Pallas
+# one — a 256²-tile bandwidth roofline, diagnosed quantitatively in
+# docs/DESIGN.md §8; the size-adaptive 512² schedule staged there flips
+# this entry only when a sweep-validated artifact shows ≥0.9× XLA; the
 # Pallas pooling kernel beats XLA's reduce_window ~2.7×. Flash resolves
 # to Pallas on memory grounds: the XLA composition materializes the
 # (L, L) f32 score matrix in HBM (1 GB at L=4096, h=8, b=2), the fused

@@ -7,6 +7,15 @@ B tiles streamed HBM→VMEM by the pipeline, partial products accumulated in
 a float32 VMEM scratch across the K dimension, output written once on the
 last K step. K is the innermost ("arbitrary") grid dimension so the
 accumulator is live for exactly one (i, j) tile at a time.
+
+Block sizes default to a size-adaptive schedule (see ``_auto_blocks``):
+the kernel's HBM traffic is ``2·m·n·k·itemsize·(1/bm + 1/bn)`` bytes, so
+fixed 256-tiles cap large bf16 matmuls at a ~64 TF/s bandwidth roofline
+on a v5e (measured: 20.5 ms at 8192³ ≡ the roofline's 21 ms prediction,
+benchmarks/results/kernels.json) while 512-tiles double the arithmetic
+intensity into compute-bound territory. Full analysis and the measured
+evidence trail: docs/DESIGN.md §matmul; the on-chip sweep that validates
+or overrides these defaults is benchmarks/matmul_tune.py.
 """
 
 from __future__ import annotations
@@ -44,16 +53,41 @@ def _pad_to(x, m_mult, n_mult):
     return x
 
 
+def _auto_blocks(m: int, n: int, k: int) -> tuple:
+    """Size-adaptive (bm, bn, bk).
+
+    HBM traffic is ``2·m·n·k·itemsize·(1/bm + 1/bn)`` (A re-read once
+    per N-tile, B once per M-tile; bk cancels), so the M/N tiles set the
+    arithmetic intensity: 256² tiles bound bf16 at ~64 TF/s on a v5e's
+    ~820 GB/s — under half the 197 TF/s MXU peak — while 512² tiles
+    lift the roofline to ~256 TF/s, past peak (compute-bound). VMEM at
+    (512, 512, 1024) bf16: double-buffered A+B 4 MB + f32 acc 1 MB +
+    out 0.5 MB ≈ 5.5 MB of the ~16 MB budget. Small problems keep 256²
+    (less padding waste, the pipeline still overlaps); tiny dims clamp
+    in _matmul_pallas as before."""
+    if min(m, n) >= 1024 and k >= 512:
+        # bk from {512, 1024} only: it must stay a multiple of the
+        # 128-lane native tiling (a raw k//4 could be e.g. 625 and
+        # break Mosaic lowering), and it cancels out of the traffic
+        # formula anyway — deeper only amortizes pipeline overhead
+        return 512, 512, (1024 if k >= 1024 else 512)
+    return 256, 256, 256
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("block_m", "block_n", "block_k", "out_dtype",
                      "interpret"))
-def _matmul_pallas(a, b, block_m=256, block_n=256, block_k=256,
+def _matmul_pallas(a, b, block_m=None, block_n=None, block_k=None,
                    out_dtype=None, interpret=False):
     m, k = a.shape
     k2, n = b.shape
     if k != k2:    # not assert: must survive python -O, else _pad_to
         raise ValueError(f"contracting dims differ: {k} vs {k2}")
+    auto_m, auto_n, auto_k = _auto_blocks(m, n, k)
+    block_m = block_m or auto_m
+    block_n = block_n or auto_n
+    block_k = block_k or auto_k
     out_dtype = out_dtype or jnp.promote_types(a.dtype, b.dtype)
 
     # clamp blocks to the (padded-to-tile) problem, keep MXU/VPU alignment
@@ -121,14 +155,16 @@ def _mm_bwd(cfg, res, g):
 _mm.defvjp(_mm_fwd, _mm_bwd)
 
 
-def matmul(a, b, *, backend: str = "auto", block_m: int = 256,
-           block_n: int = 256, block_k: int = 256, out_dtype=None):
+def matmul(a, b, *, backend: str = "auto", block_m: int = None,
+           block_n: int = None, block_k: int = None, out_dtype=None):
     """``a @ b`` with float32 MXU accumulation.
 
     Inputs may be any float dtype (bfloat16 recommended on TPU — the MXU
     natively consumes bf16 and accumulates f32); output defaults to the
     promoted input dtype. Differentiable via a custom VJP whose backward
-    matmuls run through the same Pallas kernel.
+    matmuls run through the same Pallas kernel. Block sizes default to
+    the size-adaptive schedule (``_auto_blocks``); explicit values
+    override (benchmarks/matmul_tune.py sweeps them on hardware).
     """
     backend = resolve_backend(backend, "matmul")
     if backend == "xla":

@@ -169,6 +169,23 @@ def _zigzag_check(seq_len: int, n_shards: int) -> None:
                          f"{seq_len} vs {2 * n_shards}")
 
 
+def to_zigzag(x, n_shards: int):
+    """Standard → zigzag sequence layout on axis 1 of ``x`` (any array,
+    numpy or jax; (B, L, ...)). Apply ONCE — e.g. host-side on a batch
+    before device_put — and run zigzag entry points with
+    ``layout="zigzag"`` so steady-state training/inference never pays a
+    per-call cross-shard resharding (the permutation of an already
+    P(dp, sp)-sharded array is an all-to-all)."""
+    _zigzag_check(x.shape[1], n_shards)
+    return x[:, _zigzag_perm(x.shape[1], n_shards)]
+
+
+def from_zigzag(x, n_shards: int):
+    """Inverse of :func:`to_zigzag` (zigzag → standard order)."""
+    _zigzag_check(x.shape[1], n_shards)
+    return x[:, _zigzag_perm(x.shape[1], n_shards).argsort()]
+
+
 def _ring_shard_zigzag(q, k, v, *, axis: str, n_shards: int,
                        causal: bool):
     """Zigzag per-device body: local rows = [low stripe ‖ high stripe]
@@ -255,7 +272,8 @@ def _ring_jit(mesh, axis: str, causal: bool, schedule: str = "contiguous"):
 
 
 def ring_attention(q, k, v, mesh, *, axis: str = "sp",
-                   causal: bool = False, schedule: str = "contiguous"):
+                   causal: bool = False, schedule: str = "contiguous",
+                   layout: str = "seq"):
     """Exact attention over a sequence sharded on ``axis`` of ``mesh``.
 
     Inputs (B, L, H, D) are resharded to P(None, axis) if not already;
@@ -265,25 +283,37 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
     at large ring sizes, numerically identical): inputs are permuted so
     each shard holds one stripe from each end of the sequence, and the
     output is un-permuted before returning — callers see standard
-    sequence order either way. L must then divide by 2×shards. (For
-    persistent training integration, keep the data in zigzag layout
-    across steps instead of paying the permutation per call.)
+    sequence order either way. L must then divide by 2×shards.
+
+    ``layout="zigzag"`` (opt-in, zigzag schedule only) declares q/k/v
+    ALREADY in zigzag order and returns the output in zigzag order too
+    — no per-call permutation (which on sharded arrays is a cross-shard
+    all-to-all that would dominate at the context lengths zigzag exists
+    for). Convert once with :func:`to_zigzag` / :func:`from_zigzag` and
+    keep long-lived tensors (training batches, decode prefill) in that
+    layout across calls.
     """
     n_shards = mesh.shape[axis]
     if schedule not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown ring schedule {schedule!r}")
+    if layout not in ("seq", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "zigzag" and schedule != "zigzag":
+        raise ValueError("layout='zigzag' requires schedule='zigzag'")
+    permute = schedule == "zigzag" and layout == "seq"
     if schedule == "zigzag":
         _zigzag_check(q.shape[1], n_shards)
-        perm = _zigzag_perm(q.shape[1], n_shards)
-        inv = perm.argsort()
-        q, k, v = (x[:, perm] for x in (q, k, v))
     elif q.shape[1] % n_shards:
         raise ValueError(
             f"seq len {q.shape[1]} not divisible by {axis}={n_shards}")
+    if permute:
+        perm = _zigzag_perm(q.shape[1], n_shards)
+        inv = perm.argsort()
+        q, k, v = (x[:, perm] for x in (q, k, v))
     sharding = NamedSharding(mesh, P(None, axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     out = _ring_jit(mesh, axis, causal, schedule)(q, k, v)
-    if schedule == "zigzag":
+    if permute:
         out = out[:, inv]
     return out
 

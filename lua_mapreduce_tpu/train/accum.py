@@ -5,7 +5,10 @@ transformer train steps (models/transformer.py) — the fold splits each
 per-device batch tile into ``accum`` equal microbatches, scans
 ``value_and_grad`` over them keeping one microbatch's activations live
 at a time, and returns the tile-mean (loss, grads): identical numbers
-to the whole tile, activation memory ÷ accum.
+to the whole tile up to float associativity, activation memory ÷ accum.
+The running sums are held in f32 regardless of the parameter dtype, so
+bf16 params do not accumulate bf16 rounding across microbatches; the
+result is cast back to each gradient leaf's natural dtype at the end.
 """
 
 from __future__ import annotations
@@ -31,8 +34,16 @@ def accum_value_and_grad(global_loss, params, arrays, accum: int):
     def body(carry, mb):
         loss_a, g_a = carry
         l, g = jax.value_and_grad(global_loss)(params, *mb)
-        return (loss_a + l, jax.tree.map(jnp.add, g_a, g)), None
+        g32 = jax.tree.map(lambda acc, x: acc + x.astype(jnp.float32),
+                           g_a, g)
+        return (loss_a + l.astype(jnp.float32), g32), None
 
-    zeros = jax.tree.map(jnp.zeros_like, params)
-    (loss_s, g_s), _ = lax.scan(body, (0.0, zeros), micro)
-    return loss_s / accum, jax.tree.map(lambda g: g / accum, g_s)
+    # zeros_like (not zeros): inside shard_map the leaves carry
+    # varying-axis types that a fresh constant would not, and the scan
+    # carry must type-match the per-microbatch grads
+    zeros = jax.tree.map(
+        lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+    (loss_s, g_s), _ = lax.scan(body, (jnp.float32(0.0), zeros), micro)
+    mean = jax.tree.map(
+        lambda g, p: (g / accum).astype(p.dtype), g_s, params)
+    return loss_s / accum, mean

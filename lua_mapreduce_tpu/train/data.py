@@ -94,3 +94,64 @@ def make_images(seed: int = 0, n_train: int = 2048, n_val: int = 512,
     x_tr, y_tr = sample(n_train)
     x_va, y_va = sample(n_val)
     return x_tr, y_tr, x_va, y_va
+
+
+def load_digits_image(path: str
+                      ) -> Tuple[np.ndarray, np.ndarray,
+                                 np.ndarray, np.ndarray]:
+    """Slice a real digits sheet image into the reference's dataset.
+
+    The exact contract of examples/APRIL-ANN/init.lua:80-123: the image
+    is a grid of 16x16 glyphs, 10 per row (one column per digit class);
+    it is read as grayscale, colors inverted (ink -> high activation),
+    scaled to [0, 1]. Training patterns are the first 80 tile-rows
+    (offset {0,0}, numSteps {80,10} = 800 patterns), validation the next
+    20 (offset {1280,0}, numSteps {20,10} = 200). Labels cycle 0-9 with
+    the column (the circular step -1 output dataset): pattern k's label
+    is k mod 10, and patterns advance column-fastest (orderStep {1,0}).
+
+    Smaller sheets are accepted for fixtures: any (16*R, 160) image with
+    R a multiple of 5 splits 4:1 by tile-rows (the same 800/200 ratio).
+    Returns (x_train (N,256) f32, y_train (N,) i32, x_val, y_val).
+    """
+    from PIL import Image
+
+    img = Image.open(path).convert("L")
+    w, h = img.size
+    if w != 160 or h % 16 or (h // 16) % 5:
+        raise ValueError(
+            f"digits sheet must be 160px wide (10 glyph columns) with a "
+            f"tile-row count divisible by 5 for the 4:1 split; got "
+            f"{w}x{h}")
+    a = np.asarray(img, np.float32) / 255.0
+    a = 1.0 - a                                   # invert_colors
+    rows = h // 16
+    # (rows, 16, 10, 16) -> (rows, 10, 256): column-fastest pattern order
+    tiles = a.reshape(rows, 16, 10, 16).transpose(0, 2, 1, 3)
+    patterns = tiles.reshape(rows * 10, 256).astype(np.float32)
+    labels = (np.arange(rows * 10) % 10).astype(np.int32)
+    n_tr = (rows * 4 // 5) * 10
+    return (patterns[:n_tr], labels[:n_tr],
+            patterns[n_tr:], labels[n_tr:])
+
+
+def write_digits_image(path: str, seed: int = 0, tile_rows: int = 100
+                       ) -> None:
+    """Render a deterministic digits sheet honoring the loader's
+    contract (used to generate the checked-in test fixture and to
+    produce a full-size 1600x160 stand-in for the reference's
+    misc/digits.png when none is at hand). Glyphs are per-class
+    prototype blobs + per-instance noise, drawn as INK on paper so the
+    loader's inversion is exercised."""
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(N_CLASSES, 16, 16) > 0.62     # ink masks
+    sheet = np.zeros((tile_rows * 16, 160), np.float32)
+    for r in range(tile_rows):
+        for c in range(10):
+            glyph = (protos[c].astype(np.float32) *
+                     (0.75 + 0.25 * rng.rand(16, 16)))
+            sheet[r * 16:(r + 1) * 16, c * 16:(c + 1) * 16] = glyph
+    paper = np.clip(1.0 - sheet, 0.0, 1.0)          # ink -> dark
+    Image.fromarray((paper * 255).astype(np.uint8), "L").save(path)

@@ -45,32 +45,38 @@ def _probe_cache_path() -> str:
                         f"lua_mr_tpu_probe_{os.getuid()}_{plat}_{boot}")
 
 
-def probe_backend(timeout_s: float = 120.0) -> bool:
+def probe_backend(timeout_s: float = 120.0, fresh: bool = False) -> bool:
     """Check from a THROWAWAY subprocess whether the default JAX backend
     initializes within ``timeout_s``. A wedged accelerator tunnel hangs
     ``jax.devices()`` inside an uninterruptible C call — the only safe
     probe is one we can kill. Results are cached in-process and on disk
-    per boot with a TTL per verdict. Returns True when usable."""
+    per boot with a TTL per verdict. Returns True when usable.
+
+    ``fresh=True`` skips BOTH cache reads (still records the result):
+    retry loops use it so a negative verdict cached minutes ago can't
+    mask a tunnel that has since recovered."""
     key = os.environ.get("JAX_PLATFORMS", "default")
-    hit = _probe_memo.get(key)
-    if hit is not None:
-        verdict, stamp = hit
-        ttl = POSITIVE_PROBE_TTL_S if verdict else NEGATIVE_PROBE_TTL_S
-        if time.monotonic() - stamp < ttl:
-            return verdict
+    if not fresh:
+        hit = _probe_memo.get(key)
+        if hit is not None:
+            verdict, stamp = hit
+            ttl = POSITIVE_PROBE_TTL_S if verdict else NEGATIVE_PROBE_TTL_S
+            if time.monotonic() - stamp < ttl:
+                return verdict
+        cache = _probe_cache_path()
+        try:
+            st = os.stat(cache)
+            if st.st_uid == os.getuid():  # ignore files planted by others
+                with open(cache) as f:
+                    verdict = f.read().strip()
+                age = time.time() - st.st_mtime
+                if verdict == "ok" and age < POSITIVE_PROBE_TTL_S:
+                    return True         # not memoized: TTL must re-check
+                if verdict == "fail" and age < NEGATIVE_PROBE_TTL_S:
+                    return False
+        except OSError:
+            pass
     cache = _probe_cache_path()
-    try:
-        st = os.stat(cache)
-        if st.st_uid == os.getuid():    # ignore files planted by others
-            with open(cache) as f:
-                verdict = f.read().strip()
-            age = time.time() - st.st_mtime
-            if verdict == "ok" and age < POSITIVE_PROBE_TTL_S:
-                return True             # not memoized: TTL must re-check
-            if verdict == "fail" and age < NEGATIVE_PROBE_TTL_S:
-                return False
-    except OSError:
-        pass
 
     code = "import jax; jax.devices(); print('ok')"
     try:
@@ -90,11 +96,17 @@ def probe_backend(timeout_s: float = 120.0) -> bool:
     return ok
 
 
-def force_cpu_if_unavailable(timeout_s: float = 120.0) -> str:
+def force_cpu_if_unavailable(timeout_s: float = 120.0, retries: int = 1,
+                             retry_wait_s: float = 60.0) -> str:
     """If the accelerator backend cannot initialize (probed from a
     killable subprocess), pin this process to CPU. Returns the platform
     chosen. Safe whether or not jax is already imported, as long as no
-    backend has been initialized yet in this process."""
+    backend has been initialized yet in this process.
+
+    ``retries > 1`` re-probes a negative verdict that many times total,
+    FRESH (cache-bypassing), ``retry_wait_s`` apart — for callers like
+    bench.py whose one driver-kept artifact justifies spending minutes
+    to catch a tunnel that recovered after the cached negative."""
     # already pinned to CPU (test conftest, an earlier fallback, or the
     # environment)? — nothing to probe, and probing would burn the full
     # subprocess timeout against a wedged tunnel for no decision
@@ -108,8 +120,14 @@ def force_cpu_if_unavailable(timeout_s: float = 120.0) -> str:
     j = sys.modules.get("jax")
     if j is not None and getattr(j.config, "jax_platforms", None) == "cpu":
         return "cpu"
-    if probe_backend(timeout_s):
-        return "accelerator"
+    for attempt in range(max(1, retries)):
+        if probe_backend(timeout_s, fresh=attempt > 0):
+            return "accelerator"
+        if attempt + 1 < retries:
+            print(f"[jax_env] accelerator probe failed "
+                  f"(attempt {attempt + 1}/{retries}); retrying in "
+                  f"{retry_wait_s:.0f}s", file=sys.stderr)
+            time.sleep(retry_wait_s)
     print("[jax_env] accelerator backend unreachable; running on CPU",
           file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"

@@ -28,6 +28,37 @@ PEAK_BF16_FLOPS = {
     "TPU v6e": 918e12,
 }
 
+# Per-chip HBM bandwidth (bytes/s), keyed like PEAK_BF16_FLOPS. Public
+# figures (jax-ml.github.io/scaling-book hardware table). Used as the
+# memory-roofline denominator for FLOP-less ops: an op cannot finish
+# faster than reading its inputs once at this rate.
+PEAK_HBM_BYTES = {
+    "TPU v2": 700e9,
+    "TPU v3": 900e9,
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,      # v5e
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,          # v5p
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,     # Trillium / v6e
+    "TPU v6e": 1640e9,
+}
+
+
+def peak_hbm_bytes_per_s(device=None) -> Optional[float]:
+    """Peak HBM bandwidth for one chip, or None when the generation is
+    unknown (no probe fallback: a bandwidth probe through the tunnel
+    measures the tunnel, and the only consumer — kernel_bench's
+    elision sanity check — simply skips the check when this is None)."""
+    env = os.environ.get("LMR_PEAK_HBM_BYTES")
+    if env:
+        return float(env)
+    import jax
+    if device is None:
+        device = jax.devices()[0]
+    return PEAK_HBM_BYTES.get(device.device_kind)
+
+
 _probe_cache: dict = {}
 
 

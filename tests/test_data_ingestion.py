@@ -1,0 +1,161 @@
+"""Real-data ingestion contract (VERDICT r2 item 6).
+
+The reference's examples consume real inputs: APRIL-ANN slices
+misc/digits.png into 16x16 patterns with an 800/200 split
+(examples/APRIL-ANN/init.lua:80-123), and WordCountBig's taskfn lists
+real Europarl split files from disk (WordCountBig/taskfn.lua:5-13).
+These tests pin the build's equivalents — an image loader honoring the
+exact slicing contract (checked-in fixture: tests/fixtures/
+digits_tiny.png) and a file-driven corpus path — end to end through the
+engine, with the synthetic generators remaining the fallback.
+"""
+
+import os
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.local import LocalExecutor
+from lua_mapreduce_tpu.train.data import load_digits_image, write_digits_image
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "digits_tiny.png")
+
+
+class TestDigitsImageLoader:
+    def test_fixture_contract(self):
+        """The checked-in sheet slices to the reference split shape:
+        (R*10) 256-dim patterns, 4:1 train/val by tile-rows, labels
+        cycling 0-9 column-fastest, values in [0,1]."""
+        x_tr, y_tr, x_va, y_va = load_digits_image(FIXTURE)
+        assert x_tr.shape == (80, 256) and x_va.shape == (20, 256)
+        assert x_tr.dtype == np.float32 and y_tr.dtype == np.int32
+        assert (np.arange(80) % 10 == y_tr).all()
+        assert (np.arange(20) % 10 == y_va).all()
+        assert x_tr.min() >= 0.0 and x_tr.max() <= 1.0
+
+    def test_inversion_and_column_layout(self):
+        """Ink pixels (dark on paper) come out HIGH, and each tile lands
+        in the pattern matching its (row, column) grid slot: glyphs in
+        column c carry label c."""
+        x_tr, y_tr, _, _ = load_digits_image(FIXTURE)
+        # the sheet is dark-ink-on-white-paper: after inversion the mean
+        # activation of inked regions exceeds the paper background (~0)
+        assert x_tr.mean() > 0.05
+        # classes differ: per-class mean patterns are not all identical
+        means = np.stack([x_tr[y_tr == c].mean(axis=0) for c in range(10)])
+        assert np.std(means, axis=0).max() > 0.05
+
+    def test_full_size_sheet_roundtrip(self, tmp_path):
+        """A full 1600x160 sheet (the reference's misc/digits.png
+        geometry) yields exactly the 800/200 split of init.lua:80-123."""
+        p = str(tmp_path / "digits_full.png")
+        write_digits_image(p, seed=3, tile_rows=100)
+        x_tr, y_tr, x_va, y_va = load_digits_image(p)
+        assert x_tr.shape == (800, 256) and x_va.shape == (200, 256)
+        assert y_tr[:10].tolist() == list(range(10))
+
+    def test_deterministic(self):
+        a = load_digits_image(FIXTURE)
+        b = load_digits_image(FIXTURE)
+        for u, v in zip(a, b):
+            assert np.array_equal(u, v)
+
+    def test_bad_geometry_rejected(self, tmp_path):
+        from PIL import Image
+        bad = str(tmp_path / "bad.png")
+        Image.fromarray(np.zeros((64, 64), np.uint8), "L").save(bad)
+        with pytest.raises(ValueError, match="160px wide"):
+            load_digits_image(bad)
+
+    def test_mr_train_consumes_image(self, tmp_path):
+        """The digits MapReduce example trains on the REAL image when
+        given one (image arg -> loader path), through the engine."""
+        import examples.digits.mr_train as mr
+
+        args = {"sizes": (256, 32, 10), "n_shards": 2, "bunch": 32,
+                "max_steps": 2, "patience": 10, "seed": 0,
+                "image": FIXTURE,
+                "model_store": f"shared:{tmp_path}/model"}
+        spec = TaskSpec(taskfn="examples.digits.mr_train",
+                        mapfn="examples.digits.mr_train",
+                        partitionfn="examples.digits.mr_train",
+                        reducefn="examples.digits.mr_train",
+                        finalfn="examples.digits.mr_train",
+                        init_args=args,
+                        storage=f"shared:{tmp_path}/spill")
+        LocalExecutor(spec, max_iterations=4).run()
+        meta = mr.read_meta(f"shared:{tmp_path}/model")
+        assert meta["step"] == 2 and np.isfinite(meta["val_loss"])
+
+    def test_mr_train_rejects_size_mismatch(self):
+        import examples.digits.mr_train as mr
+        with pytest.raises(ValueError, match="expects 128 inputs"):
+            mr.init({"sizes": (128, 32, 10), "image": FIXTURE,
+                     "model_store": "mem:ingest-mismatch"})
+
+
+class TestEuroparlFilePath:
+    def _write_corpus(self, tmp_path):
+        """Europarl format: plain text, one sentence per line."""
+        lines = {
+            "ep-00.txt": ["resumption of the session",
+                          "i declare resumed the session"],
+            "ep-01.txt": ["please rise then for this minute s silence",
+                          "the house rose and observed a minute s silence"],
+            "ep-02.txt": ["madam president on a point of order"],
+        }
+        paths = []
+        for name, ls in lines.items():
+            p = tmp_path / name
+            p.write_text("\n".join(ls) + "\n")
+            paths.append(str(p))
+        return paths
+
+    def test_files_arg_counts_real_files(self, tmp_path):
+        """bigtask consumes explicit real split files (no synthetic
+        corpus build) and golden-diffs against a naive count."""
+        paths = self._write_corpus(tmp_path)
+        spec = TaskSpec(taskfn="examples.wordcount_big.bigtask",
+                        mapfn="examples.wordcount_big.bigtask",
+                        partitionfn="examples.wordcount_big.bigtask",
+                        reducefn="examples.wordcount_big.bigtask",
+                        init_args={"files": paths},
+                        storage=f"shared:{tmp_path}/spill")
+        ex = LocalExecutor(spec)
+        ex.run()
+        got = {k: v[0] for k, v in ex.results()}
+        want = Counter()
+        for p in paths:
+            with open(p) as f:
+                want.update(f.read().split())
+        assert got == dict(want)
+        # no synthetic corpus snuck onto disk
+        assert not any(f.startswith("split") for f in os.listdir(tmp_path))
+
+    def test_missing_file_fails_loudly(self, tmp_path):
+        paths = self._write_corpus(tmp_path) + [str(tmp_path / "nope.txt")]
+        with pytest.raises(FileNotFoundError, match="nope.txt"):
+            import examples.wordcount_big.bigtask as bt
+            bt.init({"files": paths})
+
+    def test_duplicate_basenames_stay_distinct(self, tmp_path):
+        """Two dirs shipping same-named splits must both be counted —
+        the task key space disambiguates by index."""
+        d1, d2 = tmp_path / "a", tmp_path / "b"
+        d1.mkdir(); d2.mkdir()
+        (d1 / "split.txt").write_text("alpha alpha\n")
+        (d2 / "split.txt").write_text("beta\n")
+        spec = TaskSpec(taskfn="examples.wordcount_big.bigtask",
+                        mapfn="examples.wordcount_big.bigtask",
+                        partitionfn="examples.wordcount_big.bigtask",
+                        reducefn="examples.wordcount_big.bigtask",
+                        init_args={"files": [str(d1 / "split.txt"),
+                                             str(d2 / "split.txt")]},
+                        storage=f"shared:{tmp_path}/spill")
+        ex = LocalExecutor(spec)
+        ex.run()
+        got = {k: v[0] for k, v in ex.results()}
+        assert got == {"alpha": 2, "beta": 1}

@@ -131,3 +131,58 @@ class TestZigzagRing:
         assert sorted(perm.tolist()) == list(range(32))
         # shard 0 holds the first and LAST stripes
         assert perm[:8].tolist() == [0, 1, 2, 3, 28, 29, 30, 31]
+
+
+class TestZigzagPersistentLayout:
+    """layout='zigzag' (VERDICT r2 item 8): callers keeping long-lived
+    tensors in zigzag order skip the per-call permutation entirely."""
+
+    def test_pre_permuted_matches_seq_layout(self, mesh):
+        q, k, v = _qkv(10)
+        want = ra.ring_attention(q, k, v, mesh, axis="sp", causal=True,
+                                 schedule="zigzag")        # seq layout
+        n = mesh.shape["sp"]
+        qz, kz, vz = (ra.to_zigzag(x, n) for x in (q, k, v))
+        got_z = ra.ring_attention(qz, kz, vz, mesh, axis="sp",
+                                  causal=True, schedule="zigzag",
+                                  layout="zigzag")
+        # output comes back in zigzag order; un-permute once to compare
+        np.testing.assert_allclose(
+            np.asarray(ra.from_zigzag(got_z, n)), np.asarray(want),
+            rtol=2e-5, atol=2e-5)
+
+    def test_to_from_zigzag_roundtrip(self):
+        x = np.arange(4 * 32 * 2).reshape(4, 32, 2)
+        z = ra.to_zigzag(x, 4)
+        assert not np.array_equal(z, x)
+        assert np.array_equal(ra.from_zigzag(z, 4), x)
+
+    def test_no_permutation_in_compiled_program(self, mesh):
+        """The point of the flag: the zigzag-layout call's jitted HLO
+        contains no gather/permutation of the inputs — only the shard
+        body runs. Checked structurally: layout='zigzag' lowers the SAME
+        cached compiled callable as the internal body (ring_attention
+        adds the permutation OUTSIDE it), so its cost equals the body's.
+        Here we assert the permutation ops are absent from the traced
+        jaxpr of an end-to-end jit around the zigzag-layout call."""
+        import jax
+
+        n = mesh.shape["sp"]
+
+        def f(q, k, v):
+            return ra.ring_attention(q, k, v, mesh, axis="sp",
+                                     causal=True, schedule="zigzag",
+                                     layout="zigzag")
+        q, k, v = _qkv(11)
+        qz, kz, vz = (ra.to_zigzag(x, n) for x in (q, k, v))
+        jaxpr = str(jax.make_jaxpr(f)(qz, kz, vz))
+        assert "gather" not in jaxpr, "persistent layout still permutes"
+
+    def test_layout_requires_zigzag_schedule(self, mesh):
+        q, k, v = _qkv(12)
+        with pytest.raises(ValueError, match="requires schedule"):
+            ra.ring_attention(q, k, v, mesh, axis="sp", causal=True,
+                              layout="zigzag")
+        with pytest.raises(ValueError, match="unknown layout"):
+            ra.ring_attention(q, k, v, mesh, axis="sp", causal=True,
+                              schedule="zigzag", layout="weird")

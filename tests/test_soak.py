@@ -1,0 +1,151 @@
+"""Soak: 100+ training iterations through the engine with worker churn
+and a mid-reduce server restart (VERDICT r2 item 7).
+
+The reference's elastic-pool + resume semantics under sustained
+iteration (server.lua:470-492 resume matrix, worker.lua:97-103 elastic
+join/leave): the digits DP-SGD example loops 100 optimizer steps while
+short-lived workers continuously join and leave, the server process
+"crashes" mid-reduce around the halfway point and a fresh server resumes
+from the task-doc checkpoint. The run must produce the SAME loss
+trajectory and final model as an unperturbed single-process run —
+fault tolerance must be invisible in the numbers.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import examples.digits.mr_train as mr
+from lua_mapreduce_tpu import MemJobStore, Server, TaskSpec, Worker
+from lua_mapreduce_tpu.engine.local import LocalExecutor
+from lua_mapreduce_tpu.store.router import get_storage_from
+from lua_mapreduce_tpu.train import checkpoint as ckpt
+
+N_ITER = 100
+ARGS = {"sizes": (32, 16, 10), "n_shards": 2, "bunch": 16,
+        "max_steps": N_ITER, "patience": 10_000, "seed": 0}
+
+
+def _spec(model_tag, spill_tag):
+    return TaskSpec(taskfn="examples.digits.mr_train",
+                    mapfn="examples.digits.mr_train",
+                    partitionfn="examples.digits.mr_train",
+                    reducefn="examples.digits.mr_train",
+                    finalfn="examples.digits.mr_train",
+                    init_args={**ARGS, "model_store": f"mem:{model_tag}"},
+                    storage=f"mem:{spill_tag}")
+
+
+# the TRUE original, captured at import: _capture_trajectory is called
+# twice per test and wrapping the previous wrapper would keep the first
+# sink recording through the second run
+_ORIG_FINALFN = mr.finalfn
+
+
+def _capture_trajectory(monkeypatch, sink):
+    """Wrap mr_train.finalfn to record (step, tr_loss, val_loss) per
+    iteration — the meta file only keeps the last step."""
+
+    def recording(pairs):
+        verdict = _ORIG_FINALFN(pairs)
+        meta = mr.read_meta(mr._cfg["model_store"])
+        sink.append((meta["step"], meta["tr_loss"], meta["val_loss"]))
+        return verdict
+
+    monkeypatch.setattr(mr, "finalfn", recording)
+
+
+def _final_params(model_tag):
+    store = get_storage_from(f"mem:{model_tag}")
+    return ckpt.load_pytree(store, mr.MODEL_FILE, mr._template())["params"]
+
+
+def test_soak_100_iterations_churn_and_midreduce_restart(monkeypatch):
+    # ---- golden: unperturbed single-process run --------------------------
+    gold_traj = []
+    _capture_trajectory(monkeypatch, gold_traj)
+    LocalExecutor(_spec("soak-gold", "soak-gold-spill"),
+                  max_iterations=N_ITER + 2).run()
+    gold_params = _final_params("soak-gold")
+    assert len(gold_traj) == N_ITER
+    assert mr.read_meta("mem:soak-gold")["step"] == N_ITER
+
+    # ---- perturbed: elastic churn + mid-reduce server restart ------------
+    soak_traj = []
+    _capture_trajectory(monkeypatch, soak_traj)
+    store = MemJobStore()
+    spec = _spec("soak-run", "soak-run-spill")
+
+    # churn pool: every worker leaves after 25 executed jobs (~2
+    # iterations' worth) and is immediately replaced, so membership
+    # turns over continuously across the 100 iterations (the
+    # reference's join-anytime pool, recycled k8s-pod style)
+    stop = threading.Event()
+    churned = {"count": 0}
+
+    def pool():
+        while not stop.is_set():
+            w = Worker(store).configure(max_iter=60, max_sleep=0.02,
+                                        max_jobs=25)
+            try:
+                w.execute()
+            except RuntimeError:
+                pass
+            churned["count"] += 1
+
+    pool_threads = [threading.Thread(target=pool, daemon=True)
+                    for _ in range(3)]
+    for t in pool_threads:
+        t.start()
+
+    # server 1 "crashes" (exception out of loop()) mid-reduce around
+    # iteration 50 — the progress callback is the crash point, exactly
+    # like the mid-map restart e2e
+    class _Crash(Exception):
+        pass
+
+    seen_reduce = {"n": 0}
+
+    def crash_mid_soak(phase, frac):
+        if phase == "reduce" and frac >= 0.5:
+            seen_reduce["n"] += 1
+            if seen_reduce["n"] == 50:
+                raise _Crash()
+
+    server1 = Server(store, poll_interval=0.01).configure(spec)
+    with pytest.raises(_Crash):
+        server1.loop(progress=crash_mid_soak)
+    crashed_at = len(soak_traj)
+    assert crashed_at < N_ITER, "crash happened after the run finished"
+
+    # server 2 resumes from the task-doc checkpoint (no configure():
+    # the spec rides the task doc, server.lua:470-492) and finishes
+    server2 = Server(store, poll_interval=0.01)
+    server2.loop()
+    stop.set()
+    for t in pool_threads:
+        t.join(timeout=30)
+
+    # ---- the soak must be numerically invisible --------------------------
+    meta = mr.read_meta("mem:soak-run")
+    assert meta["step"] == N_ITER and meta["finished"]
+    assert len(soak_traj) == N_ITER, (crashed_at, len(soak_traj))
+    assert churned["count"] >= 10, "pool never actually churned"
+
+    # loss trajectory identical to the unperturbed run, step by step
+    for (gs, gt, gv), (ss, st, sv) in zip(gold_traj, soak_traj):
+        assert gs == ss
+        np.testing.assert_allclose(st, gt, rtol=1e-5, atol=1e-7,
+                                   err_msg=f"tr_loss diverged at step {gs}")
+        np.testing.assert_allclose(sv, gv, rtol=1e-5, atol=1e-7,
+                                   err_msg=f"val_loss diverged at step {gs}")
+    # and the losses really went somewhere (the soak trained a model)
+    assert soak_traj[-1][2] < soak_traj[0][2]
+
+    # final model bit-for-bit-close to the unperturbed run's
+    soak_params = _final_params("soak-run")
+    for name in gold_params:
+        np.testing.assert_allclose(
+            np.asarray(soak_params[name]), np.asarray(gold_params[name]),
+            rtol=1e-5, atol=1e-7, err_msg=f"param {name} diverged")

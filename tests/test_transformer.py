@@ -2,6 +2,8 @@
 against the single-device oracle, and the sequence-parallel train step
 must actually learn."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -97,6 +99,42 @@ def test_zigzag_step_is_dropin_for_ring(mesh, cfg):
         np.testing.assert_allclose(np.asarray(outs["ring"][1][k]),
                                    np.asarray(outs["zigzag"][1][k]),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_zigzag_pre_permuted_batch_matches_in_step_permutation(mesh, cfg):
+    """zigzag_layout=True + shard_batch(schedule='zigzag'): identical
+    loss/params to the default path that permutes inside the jitted
+    step — the host-side pre-permutation is numerically invisible and
+    removes the per-step cross-shard gather (VERDICT r2 item 8 /
+    ADVICE r2)."""
+    rng = np.random.RandomState(9)
+    b, l = 4, 64
+    seq = rng.randint(0, cfg.vocab, (b, l + 1))
+    tokens = jnp.asarray(seq[:, :-1], jnp.int32)
+    targets = jnp.asarray(seq[:, 1:], jnp.int32)
+    params = tfm.init_transformer(jax.random.PRNGKey(3), cfg)
+    opt = optax.sgd(0.1)
+
+    step_in = tfm.make_train_step(cfg, mesh, opt, attn="zigzag")
+    p0 = jax.tree.map(jnp.copy, params)
+    p_in, _, loss_in = step_in(p0, opt.init(p0),
+                               *tfm.shard_batch(mesh, tokens, targets))
+
+    step_pre = tfm.make_train_step(cfg, mesh, opt, attn="zigzag",
+                                   zigzag_layout=True)
+    p0 = jax.tree.map(jnp.copy, params)
+    p_pre, _, loss_pre = step_pre(
+        p0, opt.init(p0),
+        *tfm.shard_batch(mesh, tokens, targets, schedule="zigzag"))
+
+    assert abs(float(loss_in) - float(loss_pre)) < 1e-6
+    for k in p_in:
+        np.testing.assert_allclose(np.asarray(p_in[k]),
+                                   np.asarray(p_pre[k]),
+                                   rtol=1e-6, atol=1e-7, err_msg=k)
+    with pytest.raises(ValueError, match="requires attn"):
+        tfm.make_train_step(cfg, mesh, opt, attn="ring",
+                            zigzag_layout=True)
 
 
 def test_train_step_learns_copy_task(mesh, cfg):
@@ -561,12 +599,16 @@ class TestGreedyDecode:
         with pytest.raises(ValueError, match="PRNG"):
             tfm.greedy_decode(params, prompt, 2, cfg=cfg, temperature=0.5)
 
-    def test_moe_rejected(self):
+    def test_moe_capacity_required(self):
+        """A capacity-less MoE config must fail loudly at decode time
+        just as it does at init/train time (the decode MoE path itself
+        is golden-diffed in tests/test_moe.py)."""
         moe_cfg = tfm.TransformerConfig(vocab=16, d_model=16, n_heads=2,
                                         n_layers=1, d_ff=32, max_seq=32,
-                                        moe_experts=2, moe_capacity=8)
-        params = tfm.init_transformer(jax.random.PRNGKey(0), moe_cfg)
-        with pytest.raises(ValueError, match="dense"):
+                                        moe_experts=2, moe_capacity=0)
+        ok_cfg = dataclasses.replace(moe_cfg, moe_capacity=8)
+        params = tfm.init_transformer(jax.random.PRNGKey(0), ok_cfg)
+        with pytest.raises(ValueError, match="moe_capacity"):
             tfm.greedy_decode(params, jnp.zeros((1, 4), jnp.int32), 2,
                               cfg=moe_cfg)
 
