@@ -167,6 +167,21 @@ def main() -> None:
     peak = peak_flops_per_s()
     mfu_digits = mfu(native_per_chip * flops_per_example(DIGITS_SIZES), 1.0)
     mfu_wide, wide_flops, mfu_config = bench_mfu_wide()
+    # a REAL-workload MFU next to the synthetic-MLP one: the LM train
+    # step (flash attention + fused grad all-reduce + optimizer). TPU
+    # only — at this size a CPU fallback run would take hours and the
+    # number would mean nothing.
+    lm = {}
+    if jax.devices()[0].platform == "tpu":
+        try:
+            from benchmarks.kernel_bench import bench_transformer_step
+            r = bench_transformer_step()
+            lm = {"lm_train_mfu": r["mfu"],
+                  "lm_train_ms_per_step": r["ms_per_step"],
+                  "lm_train_tokens_per_sec": r["tokens_per_sec"],
+                  "lm_train_config": r["config"]}
+        except Exception as e:     # never sink the flagship metric
+            lm = {"lm_train_error": f"{type(e).__name__}: {e}"[:200]}
     print(json.dumps({
         "metric": "digits_mlp_dp_training_images_per_sec_per_chip",
         "value": round(native_per_chip, 1),
@@ -185,6 +200,7 @@ def main() -> None:
         "mfu_digits_mlp": round(mfu_digits, 6),
         "peak_bf16_flops_per_s": peak,
         "device_kind": jax.devices()[0].device_kind,
+        **lm,
     }))
 
 

@@ -243,6 +243,45 @@ def bench_flash(b, heads, seq, d, causal, dtype):
     return _bench_pair(make)
 
 
+def bench_flash_grad(b, heads, seq, d, causal, dtype):
+    """Fwd+bwd through flash attention — the training path. Pallas side
+    runs the fused FlashAttention-2 backward (ops/attention.py
+    _flash_bwd_pallas); XLA side differentiates the reference
+    composition (materializes (L, L) both directions). FLOPs: fwd
+    4·L²·d/head + bwd 10·L²·d/head (s recompute, dp, dq, dk, dv) =
+    3.5× forward, halved when causal."""
+    import jax
+    import jax.numpy as jnp
+
+    from lua_mapreduce_tpu import ops
+
+    def make():
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, seq, heads, d),
+                              dtype)
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, seq, heads, d),
+                              dtype)
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, seq, heads, d),
+                              dtype)
+
+        def grad_fn(backend):
+            def loss(q, k, v):
+                out = ops.flash_attention(q, k, v, causal=causal,
+                                          backend=backend)
+                return jnp.sum(out.astype(jnp.float32) ** 2)
+
+            def run(q, k, v):
+                g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+                # one consumable array for the measurement harness
+                return sum(x.astype(jnp.float32).sum() for x in g
+                           ).reshape(1)
+            return run
+
+        flops = (14.0 * b * heads * seq * seq * d *
+                 (0.5 if causal else 1.0))
+        return grad_fn("pallas"), grad_fn("xla"), (q, k, v), flops
+    return _bench_pair(make)
+
+
 def bench_softmax(rows, cols, dtype, block_rows=256):
     # block_rows * cols * dtype must fit scoped VMEM (16MB on v5e);
     # vocab-wide rows (32k) need a shorter block
@@ -495,6 +534,9 @@ def main() -> None:
                 4, 8, 2048, 128, True, bf16),
             "flash_s4096_h8_d128_causal": lambda: bench_flash(
                 2, 8, 4096, 128, True, bf16),
+            # training path: fused Pallas backward vs XLA's O(L²) VJP
+            "flash_grad_s2048_h8_d128_causal": lambda: bench_flash_grad(
+                4, 8, 2048, 128, True, bf16),
             # vocab-wide rows need short blocks to fit scoped VMEM
             "log_softmax_8192x32768": lambda: bench_softmax(
                 8192, 32768, bf16, block_rows=64),

@@ -29,9 +29,11 @@ import jax
 # this entry only when a sweep-validated artifact shows ≥0.9× XLA; the
 # Pallas pooling kernel beats XLA's reduce_window ~2.7×. Flash resolves
 # to Pallas on memory grounds: the XLA composition materializes the
-# (L, L) f32 score matrix in HBM (1 GB at L=4096, h=8, b=2), the fused
-# kernel never does — its head-to-head speed entry is pending a clean
-# real-chip run (see kernels.json note). Softmax is a wash; XLA wins on
+# (L, L) f32 score matrix in HBM (1 GB at L=4096, h=8, b=2) in BOTH
+# directions, while the fused kernel pair (forward + FlashAttention-2
+# backward re-materializing p from the saved logsumexp) never does —
+# head-to-head speed entries (flash_* and flash_grad_* in kernels.json)
+# are pending a clean real-chip run. Softmax is a wash; XLA wins on
 # fusion-with-neighbors grounds.
 _TPU_AUTO_POLICY = {
     "matmul": "xla",

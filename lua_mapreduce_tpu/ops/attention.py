@@ -15,10 +15,14 @@ normalized output tile on its last step — the accumulator discipline of
 ops/matmul.py. Causal masking compares global row/column indices built
 from the program ids; padded tail rows/columns are masked the same way.
 
-Backward: Pallas calls carry no JVP; the custom VJP differentiates the
-XLA reference (O(L²) memory — fine at the L this kernel targets for
-training on one chip; gradient-heavy long-context training should use the
-ring form, whose backward is blockwise by construction).
+Backward: fused too (FlashAttention-2 shape). The forward saves only
+(q, k, v, o, per-row logsumexp); the backward re-materializes each
+(block_q, block_k) probability tile in VMEM from those — p = exp(s −
+lse) — and accumulates dq in one kernel (kv innermost) and dk/dv in a
+second (q innermost). No (L, L) matrix ever touches HBM in EITHER
+direction, so training through the kernel is O(L·d) memory like
+inference — previously the custom VJP re-ran the XLA composition,
+paying the O(L²) HBM the forward existed to avoid.
 """
 
 from __future__ import annotations
@@ -47,8 +51,8 @@ def _attn_reference_xla(q, k, v, causal: bool, scale: float):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale: float, causal: bool, seq_len: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *, scale: float, causal: bool, seq_len: int,
                   block_q: int, block_k: int, n_kv: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -105,32 +109,44 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _():
         o_ref[0] = (acc_scr[:] /
                     jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+        # per-row logsumexp: the ONLY softmax state the fused backward
+        # needs (p re-materializes as exp(s - lse) per tile)
+        lse = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
+        lse_ref[...] = lse.reshape(1, block_q)
+
+
+def _clamp_blocks(l: int, block_q: int, block_k: int):
+    """Shared fwd/bwd block clamping — the backward re-derives the
+    forward's padded geometry from (l, block_q, block_k) and the two
+    must agree exactly (the saved lse is laid out in these blocks)."""
+    return (min(block_q, max(8, -(-l // 8) * 8)),
+            min(block_k, max(128, -(-l // 128) * 128)))
+
+
+def _pad_seq(x, block: int):
+    p = -x.shape[1] % block
+    return jnp.pad(x, ((0, 0), (0, p), (0, 0))) if p else x
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret",
+                              "with_lse"))
 def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
-                  interpret=False):
+                  interpret=False, with_lse=False):
     b, l, h, d = q.shape
     scale = 1.0 / float(d) ** 0.5
     # (B, L, H, D) → (B·H, L, D): one grid row per (batch, head)
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
 
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
-    block_q = min(block_q, max(8, -(-l // 8) * 8))
-    block_k = min(block_k, max(128, -(-l // 128) * 128))
-    pl_q = -l % block_q
-    pl_k = -l % block_k
-    if pl_q:
-        qb = jnp.pad(qb, ((0, 0), (0, pl_q), (0, 0)))
-    if pl_k:
-        kb = jnp.pad(kb, ((0, 0), (0, pl_k), (0, 0)))
-        vb = jnp.pad(vb, ((0, 0), (0, pl_k), (0, 0)))
+    block_q, block_k = _clamp_blocks(l, block_q, block_k)
+    qb = _pad_seq(to_bh(q), block_q)
+    kb = _pad_seq(to_bh(k), block_k)
+    vb = _pad_seq(to_bh(v), block_k)
     n_q = qb.shape[1] // block_q
     n_kv = kb.shape[1] // block_k
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
                           seq_len=l, block_q=block_q, block_k=block_k,
                           n_kv=n_kv),
@@ -143,10 +159,16 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, ki: (bh, qi, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qb.shape, q.dtype),
+            jax.ShapeDtypeStruct((b * h, qb.shape[1]), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),      # running max
             pltpu.VMEM((block_q, 1), jnp.float32),      # running denom
@@ -155,8 +177,174 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
         interpret=interpret,
     )(qb, kb, vb)
 
-    out = out[:, :l, :].reshape(b, h, l, d)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    out = jnp.transpose(out[:, :l, :].reshape(b, h, l, d), (0, 2, 1, 3))
+    return (out, lse) if with_lse else out
+
+
+def _bwd_tile(q, k, v, do, lse_ref, delta_ref, qi, ki, *, scale, causal,
+              seq_len, block_q, block_k):
+    """Re-materialize one (block_q, block_k) tile's p and ds in VMEM —
+    the shared core of both backward kernels. Returns (p, ds) in f32.
+
+    ds = p ∘ (do·vᵀ − Δ) · scale, with Δ_i = Σ_d do_id·o_id computed
+    once outside (the standard FlashAttention-2 identity: the softmax
+    jacobian term Σ_j p_ij dp_ij equals Δ_i because o = p·v)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = cols < seq_len
+    if causal:
+        valid = valid & (rows >= cols)
+    lse = lse_ref[...].reshape(block_q, 1)
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    delta = delta_ref[...].reshape(block_q, 1)
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, scale, causal, seq_len,
+                         block_q, block_k, n_kv):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def fold():
+        k = k_ref[0]
+        _, ds = _bwd_tile(q_ref[0], k, v_ref[0], do_ref[0], lse_ref,
+                          delta_ref, qi, ki, scale=scale, causal=causal,
+                          seq_len=seq_len, block_q=block_q,
+                          block_k=block_k)
+        # dq_i += ds_ij · k_j  (scale already folded into ds)
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # same diagonal-block pruning as the forward
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(fold)
+    else:
+        fold()
+
+    @pl.when(ki == n_kv - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
+                          causal, seq_len, block_q, block_k, n_q):
+    ki, qi = pl.program_id(1), pl.program_id(2)   # q innermost here
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def fold():
+        q = q_ref[0]
+        do = do_ref[0]
+        p, ds = _bwd_tile(q, k_ref[0], v_ref[0], do, lse_ref, delta_ref,
+                          qi, ki, scale=scale, causal=causal,
+                          seq_len=seq_len, block_q=block_q,
+                          block_k=block_k)
+        # dv_j += p_ijᵀ · do_i ; dk_j += ds_ijᵀ · q_i
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(fold)
+    else:
+        fold()
+
+    @pl.when(qi == n_q - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
+                      block_k=128, interpret=False):
+    """Fused backward: (dq, dk, dv) with only O(L·d) HBM traffic.
+
+    ``lse`` is the forward's saved per-row logsumexp, already in the
+    padded (B·H, Lq_pad) layout. Δ = Σ_d do∘o is computed here in one
+    fused XLA elementwise pass (O(L·d), not worth a kernel)."""
+    b, l, h, d = q.shape
+    scale = 1.0 / float(d) ** 0.5
+
+    def to_bh(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+
+    block_q, block_k = _clamp_blocks(l, block_q, block_k)
+    qb = _pad_seq(to_bh(q), block_q)
+    kb = _pad_seq(to_bh(k), block_k)
+    vb = _pad_seq(to_bh(v), block_k)
+    dob = _pad_seq(to_bh(g), block_q)
+    ob = _pad_seq(to_bh(o), block_q)
+    delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32),
+                    axis=-1)                        # (B·H, Lq_pad)
+    n_q = qb.shape[1] // block_q
+    n_kv = kb.shape[1] // block_k
+    kw = dict(scale=scale, causal=causal, seq_len=l,
+              block_q=block_q, block_k=block_k)
+
+    spec_q = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
+                          memory_space=pltpu.VMEM)
+    spec_row = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i),
+                            memory_space=pltpu.VMEM)
+    spec_kv = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
+                           memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, n_kv=n_kv, **kw),
+        grid=(b * h, n_q, n_kv),
+        in_specs=[spec_q, spec_kv, spec_kv, spec_q, spec_row, spec_row],
+        out_specs=spec_q,
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+
+    # dkv grid: kv-block outer, q-block inner (accumulators live per
+    # kv tile); index maps mirror the dq call's with i↔j swapped
+    spec_q2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0),
+                           memory_space=pltpu.VMEM)
+    spec_row2 = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i),
+                             memory_space=pltpu.VMEM)
+    spec_kv2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0),
+                            memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, n_q=n_q, **kw),
+        grid=(b * h, n_kv, n_q),
+        in_specs=[spec_q2, spec_kv2, spec_kv2, spec_q2, spec_row2,
+                  spec_row2],
+        out_specs=[spec_kv2, spec_kv2],
+        out_shape=[jax.ShapeDtypeStruct(kb.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vb.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+
+    def from_bh(x, ln):
+        return jnp.transpose(x[:, :ln, :].reshape(b, h, ln, d),
+                             (0, 2, 1, 3))
+
+    return from_bh(dq, l), from_bh(dk, l), from_bh(dv, l)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -167,17 +355,19 @@ def _flash_p(q, k, v, cfg):
 
 
 def _flash_fwd(q, k, v, cfg):
-    return _flash_p(q, k, v, cfg), (q, k, v)
+    causal, block_q, block_k, interpret = cfg
+    o, lse = _flash_pallas(q, k, v, causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret,
+                           with_lse=True)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(cfg, res, g):
-    causal = cfg[0]
-    q, k, v = res
-    scale = 1.0 / float(q.shape[-1]) ** 0.5
-    _, vjp = jax.vjp(
-        lambda q, k, v: _attn_reference_xla(q, k, v, causal, scale),
-        q, k, v)
-    return vjp(g)
+    causal, block_q, block_k, interpret = cfg
+    q, k, v, o, lse = res
+    return _flash_bwd_pallas(q, k, v, o, lse, g, causal,
+                             block_q=block_q, block_k=block_k,
+                             interpret=interpret)
 
 
 _flash_p.defvjp(_flash_fwd, _flash_bwd)
