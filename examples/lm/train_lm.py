@@ -106,10 +106,10 @@ def main() -> None:
           f"({args.attn} attention, dp={args.dp} sp={args.sp}, "
           f"grad_accum={args.grad_accum}, remat=on)")
 
-    # generate: KV-cached greedy decode continues the learned pattern
+    # generate: parallel prompt prefill + KV-cached greedy decode
     prompt = np.arange(8, dtype=np.int32)[None, :] % cfg.vocab
     out = np.asarray(tfm.greedy_decode(
-        params, jnp.asarray(prompt), 8, cfg=cfg))[0]
+        params, jnp.asarray(prompt), 8, cfg=cfg, use_prefill=True))[0]
     print(f"prompt {prompt[0].tolist()} -> continuation "
           f"{out[8:].tolist()} (stride-1 truth: "
           f"{[(8 + i) % cfg.vocab for i in range(8)]})")

@@ -34,6 +34,7 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from lua_mapreduce_tpu.ops.attention import flash_attention
 from lua_mapreduce_tpu.parallel import moe as _moe
 from lua_mapreduce_tpu.parallel.pipeline import pipeline_apply
 from lua_mapreduce_tpu.parallel.ring_attention import (
@@ -168,9 +169,12 @@ def _ffn(params: Params, p: str, y, cfg: TransformerConfig,
 
 
 def _block(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
-           moe_axis: Optional[str] = None):
+           moe_axis: Optional[str] = None, kv_sink: Optional[list] = None):
     """One pre-LN decoder block; ``attn_fn(q, k, v) -> out`` supplies the
-    (possibly sequence-parallel) attention. Returns (x, moe_aux)."""
+    (possibly sequence-parallel) attention. Returns (x, moe_aux).
+
+    ``kv_sink`` (a list) captures this block's (k, v) projections —
+    the prefill path harvests them as the decode KV cache."""
     p = f"L{i}"
     b, l, d = x.shape
     h, hd = cfg.n_heads, d // cfg.n_heads
@@ -178,6 +182,8 @@ def _block(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
     qkv = y @ params[f"{p}_qkv_W"]                      # (B, L, 3D) MXU
     q, k, v = (t.reshape(b, l, h, hd)
                for t in jnp.split(qkv, 3, axis=-1))
+    if kv_sink is not None:
+        kv_sink.append((k, v))
     a = attn_fn(q, k, v).reshape(b, l, d)
     x = x + a @ params[f"{p}_out_W"]
     y = _layer_norm(x, params[f"{p}_ln2_g"], params[f"{p}_ln2_b"])
@@ -217,11 +223,98 @@ def _forward(params: Params, tokens, pos, cfg: TransformerConfig,
     return x @ params["tok_emb"].T, aux_total           # tied head
 
 
+def prefill(params: Params, prompt, *,
+            cfg: TransformerConfig = TransformerConfig(),
+            total: Optional[int] = None, mesh=None, attn: str = "ring",
+            dp_axis: str = "dp", sp_axis: str = "sp"):
+    """Parallel prompt ingestion: ONE causal forward over the (B, P)
+    prompt yields every layer's (k, v) projections — the decode KV
+    cache — plus the last position's logits, instead of the O(P)
+    sequential scan the from-scratch decode pays. With ``mesh``, the
+    forward runs SEQUENCE-PARALLEL (ring/zigzag/ulysses over
+    ``sp_axis``), so prompts longer than one device's memory prefill
+    across the mesh — the long-context inference counterpart of the
+    sharded train step.
+
+    Returns ``(caches, last_logits)``: caches is the
+    ``L{i}_{k,v} -> (B, total, H, Dh)`` dict :func:`greedy_decode`
+    uses (zero-padded to ``total``, default P), last_logits is
+    (B, vocab). Dense and MoE configs single-device; the sharded path
+    is dense-only (expert sharding composes with training's dp, not
+    with replicated-param prefill)."""
+    b, p_len = prompt.shape
+    if p_len < 1:
+        raise ValueError("prompt must contain at least one token")
+    total = p_len if total is None else total
+    if total < p_len:
+        raise ValueError(f"total={total} shorter than the prompt {p_len}")
+    _check_seq(total, cfg)
+    cfg_fwd = dataclasses.replace(cfg, remat=False)  # capture ≠ remat
+    tokens = prompt.astype(jnp.int32)
+    h, hd = cfg.n_heads, cfg.d_model // cfg.n_heads
+
+    if mesh is None:
+        sink: list = []
+        # backend="auto": the fused flash kernel on TPU — prefilling a
+        # long prompt is exactly the workload whose (P, P) score matrix
+        # must not land in HBM; off-TPU this resolves to the XLA oracle
+        logits, _ = _forward(
+            params, tokens, jnp.arange(p_len), cfg_fwd,
+            lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            backend="auto"),
+            block=functools.partial(_block, kv_sink=sink))
+        kvs = sink
+    else:
+        if cfg.moe_experts:
+            raise ValueError("sequence-parallel prefill supports dense "
+                             "configs; MoE prefills single-device")
+        n_sp = mesh.shape[sp_axis]
+        attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
+
+        def shard_fwd(params, toks):
+            l_loc = toks.shape[1]
+            pos = _shard_pos(attn, sp_axis, n_sp, l_loc)
+            sink: list = []
+            logits, _ = _forward(
+                params, toks, pos, cfg_fwd, attn_shard,
+                block=functools.partial(_block, kv_sink=sink))
+            ks = jnp.stack([kk for kk, _ in sink])   # (nl, B, Lloc, H, hd)
+            vs = jnp.stack([vv for _, vv in sink])
+            return logits, ks, vs
+
+        tokens_z, perm = _maybe_zigzag(attn, n_sp, tokens)
+        fn = jax.shard_map(
+            shard_fwd, mesh=mesh,
+            in_specs=(P(), P(dp_axis, sp_axis)),
+            out_specs=(P(dp_axis, sp_axis),
+                       P(None, dp_axis, sp_axis),
+                       P(None, dp_axis, sp_axis)))
+        logits, ks, vs = fn(params, tokens_z)
+        if perm is not None:                 # back to standard order
+            inv = perm.argsort()
+            logits = logits[:, inv]
+            ks, vs = ks[:, :, inv], vs[:, :, inv]
+        kvs = [(ks[i], vs[i]) for i in range(cfg.n_layers)]
+
+    caches = {}
+    for i, (k, v) in enumerate(kvs):
+        pad = total - p_len
+        caches[f"L{i}_k"] = jnp.pad(
+            k, ((0, 0), (0, pad), (0, 0), (0, 0))).astype(
+                params["tok_emb"].dtype)
+        caches[f"L{i}_v"] = jnp.pad(
+            v, ((0, 0), (0, pad), (0, 0), (0, 0))).astype(
+                params["tok_emb"].dtype)
+    return caches, logits[:, -1].astype(jnp.float32)
+
+
 def greedy_decode(params: Params, prompt, n_new: int, *,
                   cfg: TransformerConfig = TransformerConfig(),
                   temperature: float = 0.0,
                   top_k: Optional[int] = None,
-                  key=None) -> jnp.ndarray:
+                  key=None, use_prefill: bool = False, mesh=None,
+                  attn: str = "ring", dp_axis: str = "dp",
+                  sp_axis: str = "sp") -> jnp.ndarray:
     """KV-cached decoding: (B, P) int32 prompt → (B, P+n_new).
 
     The inference half of the LM family (training: make_train_step).
@@ -245,7 +338,20 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     token-exact against the full-forward oracle; under overflow the
     drop ORDER differs (the oracle's cumulative token order runs over
     the whole (B, L) tile, a step's over its B tokens), matching the
-    train-time rule that capacity semantics follow the routing group."""
+    train-time rule that capacity semantics follow the routing group.
+
+    ``use_prefill=True`` ingests the prompt with :func:`prefill` — one
+    parallel causal forward instead of P sequential steps — then scans
+    only the ``n_new`` generation positions. With ``mesh`` the prefill
+    runs sequence-parallel (``attn`` selects ring/zigzag/ulysses over
+    ``dp_axis``/``sp_axis``), so prompts at training-scale context
+    lengths decode without ever holding full attention on one device.
+    Dense configs produce the same tokens either way (the prompt caches
+    are the same projections computed batched); MoE configs match as
+    long as no routing bucket overflows — prefill routes the whole
+    (B, P) prompt as one group (the oracle grouping) while the scan
+    routes B tokens per step, so under overflow the two drop DIFFERENT
+    tokens and may diverge, the same caveat as decode-vs-oracle."""
     if cfg.moe_experts:
         _check_moe(cfg)
     if temperature < 0:
@@ -309,16 +415,35 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
             x = x + ff
         x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
         logits = (x @ params["tok_emb"].T)[:, 0]        # (B, vocab)
-        if temperature == 0.0:
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        else:
-            lg = logits.astype(jnp.float32) / temperature
-            if top_k is not None and top_k < cfg.vocab:
-                kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-                lg = jnp.where(lg >= kth, lg, _NEG_INF)
-            nxt = jax.random.categorical(
-                jax.random.fold_in(key, t), lg, axis=-1).astype(jnp.int32)
+        nxt = select(logits, t)
         return (caches, nxt), nxt
+
+    def select(logits, t):
+        """Next token from (B, vocab) logits at position t — shared by
+        the scan step and the prefill fast path (same fold_in(key, t)
+        stream, so both paths sample identical tokens)."""
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits.astype(jnp.float32) / temperature
+        if top_k is not None and top_k < cfg.vocab:
+            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+            lg = jnp.where(lg >= kth, lg, _NEG_INF)
+        return jax.random.categorical(
+            jax.random.fold_in(key, t), lg, axis=-1).astype(jnp.int32)
+
+    if use_prefill:
+        if n_new == 0:
+            return prompt.astype(jnp.int32)
+        caches, last_logits = prefill(params, prompt, cfg=cfg,
+                                      total=total, mesh=mesh, attn=attn,
+                                      dp_axis=dp_axis, sp_axis=sp_axis)
+        tok1 = select(last_logits, p_len - 1)
+        # remaining n_new - 1 positions ride the ordinary step scan
+        (_, _), emitted = lax.scan(step, (caches, tok1),
+                                   jnp.arange(p_len, total - 1))
+        gen = jnp.concatenate(
+            [tok1[:, None], jnp.transpose(emitted, (1, 0))], axis=1)
+        return jnp.concatenate([prompt.astype(jnp.int32), gen], axis=1)
 
     (_, _), emitted = lax.scan(step, (caches, given[:, 0]),
                                jnp.arange(total))

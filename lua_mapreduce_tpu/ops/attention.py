@@ -4,10 +4,11 @@ Completes the kernel library (SURVEY.md §2.4's APRIL-ANN-kernel role) for
 the transformer family: one `pallas_call` computes softmax(QKᵀ·scale)·V
 without ever materializing the (L, L) score matrix in HBM — scores live
 in VMEM one (block_q, block_k) tile at a time, folded into running
-(max, denominator, output) accumulators in f32 scratch. This is the
-single-device form of the SAME online-softmax fold the ring schedule runs
-across chips (parallel/ring_attention.py::_block_fold): ring = flash with
-the KV loop distributed over ICI.
+(max, denominator, output) accumulators in f32 scratch. The ring
+schedule (parallel/ring_attention.py) runs THIS kernel as its local
+fold — ``return_lse`` exposes the mergeable-softmax state, and partial
+attentions over disjoint KV shards combine by logaddexp weights — so
+ring = flash with the KV loop distributed over ICI, literally.
 
 Grid: (batch·heads, q-blocks, kv-blocks); the kv axis is the innermost
 (sequential) dimension, accumulating into scratch and writing the
@@ -39,7 +40,8 @@ from lua_mapreduce_tpu.ops import resolve_backend
 _NEG_INF = -1e30
 
 
-def _attn_reference_xla(q, k, v, causal: bool, scale: float):
+def _attn_reference_xla(q, k, v, causal: bool, scale: float,
+                        with_lse: bool = False):
     s = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
@@ -47,8 +49,12 @@ def _attn_reference_xla(q, k, v, causal: bool, scale: float):
         mask = jnp.tril(jnp.ones((lq, lk), bool))
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhlm,bmhd->blhd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    out32 = jnp.einsum("bhlm,bmhd->blhd", p, v.astype(jnp.float32))
+    if not with_lse:
+        return out32.astype(q.dtype)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)       # (B, H, L)
+    # f32 out, matching the pallas lse path's partial-merge contract
+    return out32, jnp.transpose(lse, (0, 2, 1))         # (B, L, H)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
@@ -166,7 +172,11 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(qb.shape, q.dtype),
+            # the lse path serves partial-merge callers (ring folds):
+            # its out stays f32 so P merged partials round ONCE at the
+            # caller's final cast, not once per ring step
+            jax.ShapeDtypeStruct(qb.shape,
+                                 jnp.float32 if with_lse else q.dtype),
             jax.ShapeDtypeStruct((b * h, qb.shape[1]), jnp.float32),
         ],
         scratch_shapes=[
@@ -277,12 +287,18 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
 def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
-                      block_k=128, interpret=False):
+                      block_k=128, interpret=False, g_lse=None):
     """Fused backward: (dq, dk, dv) with only O(L·d) HBM traffic.
 
     ``lse`` is the forward's saved per-row logsumexp, already in the
     padded (B·H, Lq_pad) layout. Δ = Σ_d do∘o is computed here in one
-    fused XLA elementwise pass (O(L·d), not worth a kernel)."""
+    fused XLA elementwise pass (O(L·d), not worth a kernel).
+
+    ``g_lse`` (B, L, H), when given, is the cotangent of the lse OUTPUT
+    (callers like the ring fold differentiate through it): since
+    ∂lse_i/∂s_ij = p_ij, its whole contribution is ds += g_lse∘p — the
+    same rank-1 row term as Δ with the opposite sign, so it folds into
+    the delta operand and the kernels need no change at all."""
     b, l, h, d = q.shape
     scale = 1.0 / float(d) ** 0.5
 
@@ -297,6 +313,16 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
     ob = _pad_seq(to_bh(o), block_q)
     delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32),
                     axis=-1)                        # (B·H, Lq_pad)
+    # kernel dots need matching operand dtypes: the lse path's cotangent
+    # arrives f32 (its out is f32); Δ above already banked the f32
+    # precision, so the per-tile dp/dv dots run MXU-native in q.dtype
+    dob = dob.astype(q.dtype)
+    if g_lse is not None:
+        gl = jnp.transpose(g_lse, (0, 2, 1)).reshape(b * h, l)
+        pad = delta.shape[1] - l
+        if pad:
+            gl = jnp.pad(gl, ((0, 0), (0, pad)))
+        delta = delta - gl.astype(jnp.float32)
     n_q = qb.shape[1] // block_q
     n_kv = kb.shape[1] // block_k
     kw = dict(scale=scale, causal=causal, seq_len=l,
@@ -359,7 +385,9 @@ def _flash_fwd(q, k, v, cfg):
     o, lse = _flash_pallas(q, k, v, causal, block_q=block_q,
                            block_k=block_k, interpret=interpret,
                            with_lse=True)
-    return o, (q, k, v, o, lse)
+    # primal must match _flash_p's eval dtype (q.dtype) — the with_lse
+    # kernel emits f32; keep THAT in the residuals (sharper delta)
+    return o.astype(q.dtype), (q, k, v, o, lse)
 
 
 def _flash_bwd(cfg, res, g):
@@ -373,14 +401,59 @@ def _flash_bwd(cfg, res, g):
 _flash_p.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _lse_public(lse, b, l, h):
+    """Padded (B·H, Lq_pad) → public (B, L, H) f32."""
+    return jnp.transpose(lse[:, :l].reshape(b, h, l), (0, 2, 1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_p_lse(q, k, v, cfg):
+    """(out, lse (B, L, H)) — the two-output form ring folds consume;
+    gradients flow through BOTH outputs (see _flash_bwd_pallas g_lse)."""
+    causal, block_q, block_k, interpret = cfg
+    b, l, h, _ = q.shape
+    o, lse = _flash_pallas(q, k, v, causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret,
+                           with_lse=True)
+    return o, _lse_public(lse, b, l, h)
+
+
+def _flash_lse_fwd(q, k, v, cfg):
+    causal, block_q, block_k, interpret = cfg
+    b, l, h, _ = q.shape
+    o, lse = _flash_pallas(q, k, v, causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret,
+                           with_lse=True)
+    return (o, _lse_public(lse, b, l, h)), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(cfg, res, g):
+    causal, block_q, block_k, interpret = cfg
+    g_out, g_lse = g
+    q, k, v, o, lse = res
+    return _flash_bwd_pallas(q, k, v, o, lse, g_out, causal,
+                             block_q=block_q, block_k=block_k,
+                             interpret=interpret, g_lse=g_lse)
+
+
+_flash_p_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 def flash_attention(q, k, v, *, causal: bool = False,
                     backend: str = "auto", block_q: int = 128,
-                    block_k: int = 128):
+                    block_k: int = 128, return_lse: bool = False):
     """Exact softmax attention, (B, L, H, D) → (B, L, H, D).
 
     ``backend="pallas"``/``"pallas_interpret"`` runs the fused VMEM
     kernel; ``"xla"`` is the reference composition (correctness oracle,
-    non-TPU platforms)."""
+    non-TPU platforms).
+
+    ``return_lse=True`` also returns the per-row logsumexp of the
+    masked scores, shape (B, L, H) f32 — the mergeable-softmax state
+    that lets callers combine partial attentions over disjoint KV sets
+    (the ring fold's contract). The out is then f32 too (partials must
+    round once at the caller's final cast, not per merge step).
+    Differentiable through BOTH outputs."""
     backend = resolve_backend(backend, "flash_attention")
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} "
@@ -392,7 +465,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
     q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
     if backend == "xla":
         scale = 1.0 / float(q.shape[-1]) ** 0.5
-        return _attn_reference_xla(q, k, v, causal, scale)
-    return _flash_p(q, k, v,
-                    (causal, block_q, block_k,
-                     backend == "pallas_interpret"))
+        return _attn_reference_xla(q, k, v, causal, scale,
+                                   with_lse=return_lse)
+    cfg = (causal, block_q, block_k, backend == "pallas_interpret")
+    if return_lse:
+        return _flash_p_lse(q, k, v, cfg)
+    return _flash_p(q, k, v, cfg)

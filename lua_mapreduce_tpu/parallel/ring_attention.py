@@ -11,8 +11,11 @@ as first-class:
   neighbor hop per step) while each device folds incoming blocks into an
   online-softmax accumulator — compute overlaps the next block's DMA,
   the same overlap the reference gets by merging file streams lazily.
-  Memory per device is O(L/P), enabling context lengths that cannot fit
-  on one chip.
+  Each local fold runs the FUSED flash kernel (ops/attention.py) via
+  its ``return_lse`` contract and merges by logaddexp weights, so
+  per-device memory is O(L/P · d) — scores never materialize even
+  device-locally, in forward OR backward — enabling context lengths
+  that cannot fit on one chip.
 
 - **Ulysses** (:func:`ulysses_attention`) is the *partitionfn →
   all_to_all* shuffle shape (SURVEY.md §2.6): one collective reshards
@@ -53,95 +56,95 @@ def attention_reference(q, k, v, *, causal: bool = False):
     return flash_attention(q, k, v, causal=causal, backend="xla")
 
 
-def _block_fold(o, m, l, q, k, v, mask, scale):
-    """Fold one KV block into the online-softmax accumulator (o, m, l):
-    the flash-attention update, shapes (B,H,Lq,D), (B,H,Lq), (B,H,Lq).
-
-    Dots run in the operand dtype (bf16×bf16→f32 is the MXU's native
-    mode; upcasting operands first quarters matmul throughput, the same
-    fix as ops/attention.py); accumulators and softmax bookkeeping stay
-    f32 via ``preferred_element_type`` regardless of input dtype."""
-    s = jnp.einsum("blhd,bmhd->bhlm", q, k,
-                   preferred_element_type=jnp.float32) * scale  # MXU
-    s = jnp.where(mask, s, _NEG_INF)
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-    # p is explicitly re-masked: when a whole block is masked, s - m_new
-    # is 0 (both _NEG_INF) and exp would contribute 1s without it
-    p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
-    alpha = jnp.exp(m - m_new)
-    l_new = l * alpha + jnp.sum(p, axis=-1)
-    o_new = o * alpha[..., None] + jnp.einsum(
-        "bhlm,bmhd->bhld", p.astype(v.dtype), v,
-        preferred_element_type=jnp.float32)
-    return o_new, m_new, l_new
+def _flash_block(q, kb, vb, causal: bool):
+    """One device-local attention block through the FUSED kernel
+    (``ops.flash_attention``: Pallas on TPU, the XLA composition
+    elsewhere), returning (out, lse) — the mergeable-softmax state.
+    This makes the flash kernel the hot inner loop of the whole
+    sequence-parallel stack: scores live one VMEM tile at a time, so
+    per-device memory is O(L_loc·d) instead of the O(L_loc²) tile the
+    previous hand-inlined fold materialized per ring step, and its
+    fused FlashAttention-2 backward keeps the same bound in training."""
+    return flash_attention(q, kb, vb, causal=causal, backend="auto",
+                           return_lse=True)
 
 
-def _cond_fold(pred, o, m, l, q, k, v, mask, scale):
-    """_block_fold gated on a traced predicate: fully-masked causal
-    blocks are SKIPPED via lax.cond rather than folded-as-masked — the
-    same pruning the flash kernel does with pl.when, and AD-transparent
-    (both cond branches differentiate). A skipped block contributes
-    nothing to (o, m, l), so numerics are identical."""
-    return lax.cond(
-        pred,
-        lambda t: _block_fold(*t, mask, scale),
-        lambda t: t[:3],
-        (o, m, l, q, k, v))
+def _merge_block(o, lse, blk):
+    """Merge a block's (out_b, lse_b) into the running normalized
+    (o, lse): softmax over disjoint key sets combines by logaddexp
+    weights — o stays NORMALIZED at every step (weights sum to 1), so
+    no final division. All f32; shapes (B, Lq, H, D) / (B, Lq, H)."""
+    ob, lseb = blk
+    lse_new = jnp.logaddexp(lse, lseb)
+    w_old = jnp.exp(lse - lse_new)[..., None]
+    w_new = jnp.exp(lseb - lse_new)[..., None]
+    return o * w_old + ob.astype(jnp.float32) * w_new, lse_new
+
+
+def _causal_switch(src, my, o, lse, full_fn, diag_fn):
+    """Three-way fold for an aligned causal block pair: src < my →
+    every key precedes every query (full attention); src == my → the
+    diagonal block (causal mask); src > my → wholly masked, SKIPPED
+    (AD-transparent, ~half the attention FLOPs at large ring sizes —
+    the pruning the old masked fold did with lax.cond)."""
+    branch = (src >= my).astype(jnp.int32) + (src > my).astype(jnp.int32)
+    return lax.switch(branch,
+                      [lambda c: _merge_block(*c, full_fn()),
+                       lambda c: _merge_block(*c, diag_fn()),
+                       lambda c: c],
+                      (o, lse))
+
+
+def _ring_init(q):
+    """(o, lse) accumulators derived from q (zeroed) rather than
+    jnp.zeros so they inherit q's varying-axes type: fresh constants
+    are replicated in shard_map's vma typing and would mismatch the
+    scan carry — and deriving from q stays correct however many mesh
+    axes the CALLER's shard_map adds around this body (e.g. dp × sp
+    in the transformer)."""
+    o = q.astype(jnp.float32) * 0.0                     # (B, Lq, H, D)
+    lse = jnp.sum(o, axis=-1) + _NEG_INF                # (B, Lq, H)
+    return o, lse
 
 
 def _ring_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
     """Per-device body (inside shard_map): local q stays put, (k, v)
     rotate the ring; after step i this device holds the KV shard of
-    device (my - i) mod P."""
-    b, l_loc, h, d = q.shape
-    scale = 1.0 / jnp.sqrt(d)
+    device (my - i) mod P. Every fold runs the fused flash kernel
+    (_flash_block) and merges via logaddexp weights (_merge_block)."""
     my = lax.axis_index(axis)
-    pos_q = my * l_loc + jnp.arange(l_loc)              # global q rows
+    o, lse = _ring_init(q)
 
-    # accumulators are derived from q (zeroed) rather than jnp.zeros so
-    # they inherit q's varying-axes type: fresh constants are replicated
-    # in shard_map's vma typing and would mismatch the scan carry — and
-    # deriving from q stays correct however many mesh axes the CALLER's
-    # shard_map adds around this body (e.g. dp × sp in the transformer).
-    # Accumulators are f32 regardless of input dtype; q/k/v keep their
-    # dtype so the _block_fold dots hit the MXU's native bf16 mode.
-    z = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32) * 0.0
-    o = z                                               # (B,H,Lq,D)
-    m = z[..., 0] + _NEG_INF
-    l = z[..., 0]
-
-    def fold(o, m, l, kb, vb, src):
-        """Fold the KV block belonging to global shard ``src``. Causal
-        blocks wholly above the diagonal (src > my: every score masked)
-        are skipped via _cond_fold — worth ~half the attention FLOPs at
-        large ring sizes."""
-        pos_k = src * l_loc + jnp.arange(l_loc)
+    def fold(o, lse, kb, vb, src):
         if causal:
-            mask = pos_q[:, None] >= pos_k[None, :]     # (Lq, Lk)
-            return _cond_fold(src <= my, o, m, l, q, kb, vb, mask, scale)
-        mask = jnp.ones((l_loc, l_loc), bool)
-        return _block_fold(o, m, l, q, kb, vb, mask, scale)
+            # contiguous shards are position-aligned: the (my, src)
+            # block is full / diagonal-causal / skipped — never a
+            # partial mask, so the kernel's static causal flag suffices
+            return _causal_switch(
+                src, my, o, lse,
+                lambda: _flash_block(q, kb, vb, False),
+                lambda: _flash_block(q, kb, vb, True))
+        return _merge_block(o, lse, _flash_block(q, kb, vb, False))
 
     # step 0 folds the LOCAL block before any communication, so the ring
     # makes exactly n_shards - 1 sends — the final fold needs no rotate
-    o, m, l = fold(o, m, l, k, v, my)
+    o, lse = fold(o, lse, k, v, my)
 
     def step(carry, i):
-        o, m, l, kb, vb = carry
+        o, lse, kb, vb = carry
         # ppermute j→j+1 receives from the anticlockwise neighbor: after
         # i rotations this device holds the KV of shard (my - i) mod P
         perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
         kb = lax.ppermute(kb, axis, perm)
         vb = lax.ppermute(vb, axis, perm)
-        o, m, l = fold(o, m, l, kb, vb, (my - i) % n_shards)
-        return (o, m, l, kb, vb), None
+        o, lse = fold(o, lse, kb, vb, (my - i) % n_shards)
+        return (o, lse, kb, vb), None
 
     # scan, not fori_loop: the trip count is static and scan supports
     # reverse-mode AD (training needs d(attention)/d(qkv) through the ring)
-    (o, m, l, _, _), _ = lax.scan(step, (o, m, l, k, v),
-                                  jnp.arange(1, n_shards))
-    out = o / jnp.maximum(l, 1e-30)[..., None]          # (B,H,Lq,D)
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    (o, lse, _, _), _ = lax.scan(step, (o, lse, k, v),
+                                 jnp.arange(1, n_shards))
+    return o.astype(q.dtype)
 
 
 def _zigzag_perm(seq_len: int, n_shards: int):
@@ -189,72 +192,65 @@ def from_zigzag(x, n_shards: int):
 def _ring_shard_zigzag(q, k, v, *, axis: str, n_shards: int,
                        causal: bool):
     """Zigzag per-device body: local rows = [low stripe ‖ high stripe]
-    (see _zigzag_perm). Each incoming KV block is folded per quadrant:
-    (q_low, k_high) is fully masked ALWAYS (low queries precede every
-    high key — statically omitted); (q_high, k_low) is never masked;
-    the two diagonal-ish quadrants are lax.cond-skipped by shard index.
-    Per step each device folds exactly 2 of 4 quadrants (3 for the
-    local block) — the balance the contiguous schedule lacks."""
-    b, l_loc, hh, d = q.shape
-    h = l_loc // 2
-    scale = 1.0 / jnp.sqrt(d)
+    (see _zigzag_perm). Each incoming KV block is folded per quadrant
+    through the fused flash kernel: (q_low, k_high) is fully masked
+    ALWAYS (low queries precede every high key — statically omitted);
+    (q_high, k_low) is never masked; the two diagonal-ish quadrants
+    are switch-skipped by shard index. Every quadrant is position-
+    ALIGNED (stripe s of queries vs stripe s' of keys is full, causal-
+    diagonal, or empty), so the kernel's static causal flag covers all
+    cases. Per step each device folds exactly 2 of 4 quadrants (3 for
+    the local block) — the balance the contiguous schedule lacks."""
+    h = q.shape[1] // 2
     my = lax.axis_index(axis)
-    pos_lo = my * h + jnp.arange(h)
-    pos_hi = (2 * n_shards - 1 - my) * h + jnp.arange(h)
-
-    z = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32) * 0.0
-    o = z
-    m = z[..., 0] + _NEG_INF
-    l = z[..., 0]
     q_lo, q_hi = q[:, :h], q[:, h:]
 
-    def fold(o, m, l, kb, vb, src):
+    o, lse = _ring_init(q)
+
+    def fold(o, lse, kb, vb, src):
         if not causal:
             # quadrant splitting only buys anything under a causal
             # mask — full attention is one ordinary block fold
-            return _block_fold(o, m, l, q, kb, vb,
-                               jnp.ones((l_loc, l_loc), bool), scale)
+            return _merge_block(o, lse, _flash_block(q, kb, vb, False))
 
         k_lo, k_hi = kb[:, :h], kb[:, h:]
         v_lo, v_hi = vb[:, :h], vb[:, h:]
-        o_lo, o_hi = o[..., :h, :], o[..., h:, :]
-        m_lo, m_hi = m[..., :h], m[..., h:]
-        l_lo, l_hi = l[..., :h], l[..., h:]
-        pk_lo = src * h + jnp.arange(h)
-        pk_hi = (2 * n_shards - 1 - src) * h + jnp.arange(h)
+        o_lo, o_hi = o[:, :h], o[:, h:]
+        lse_lo, lse_hi = lse[:, :h], lse[:, h:]
 
-        # (q_low, k_low): on the diagonal band; compute iff src ≤ my
-        o_lo, m_lo, l_lo = _cond_fold(
-            src <= my, o_lo, m_lo, l_lo, q_lo, k_lo, v_lo,
-            pos_lo[:, None] >= pk_lo[None, :], scale)
+        # (q_low, k_low): stripe my vs stripe src of the LOW half —
+        # diagonal band; full iff src < my, causal iff src == my
+        o_lo, lse_lo = _causal_switch(
+            src, my, o_lo, lse_lo,
+            lambda: _flash_block(q_lo, k_lo, v_lo, False),
+            lambda: _flash_block(q_lo, k_lo, v_lo, True))
         # (q_high, k_low): high queries see every low key — always
-        o_hi, m_hi, l_hi = _block_fold(
-            o_hi, m_hi, l_hi, q_hi, k_lo, v_lo,
-            pos_hi[:, None] >= pk_lo[None, :], scale)
-        # (q_high, k_high): mirrored diagonal; compute iff src ≥ my
-        o_hi, m_hi, l_hi = _cond_fold(
-            src >= my, o_hi, m_hi, l_hi, q_hi, k_hi, v_hi,
-            pos_hi[:, None] >= pk_hi[None, :], scale)
+        o_hi, lse_hi = _merge_block(
+            o_hi, lse_hi, _flash_block(q_hi, k_lo, v_lo, False))
+        # (q_high, k_high): mirrored diagonal (stripe 2P-1-my vs
+        # 2P-1-src): full iff src > my, causal iff src == my
+        o_hi, lse_hi = _causal_switch(
+            my, src, o_hi, lse_hi,
+            lambda: _flash_block(q_hi, k_hi, v_hi, False),
+            lambda: _flash_block(q_hi, k_hi, v_hi, True))
         # (q_low, k_high): low queries precede every high key —
         # fully masked for every (src, my) pair, statically omitted
-        return (jnp.concatenate([o_lo, o_hi], axis=-2),
-                jnp.concatenate([m_lo, m_hi], axis=-1),
-                jnp.concatenate([l_lo, l_hi], axis=-1))
+        return (jnp.concatenate([o_lo, o_hi], axis=1),
+                jnp.concatenate([lse_lo, lse_hi], axis=1))
 
-    o, m, l = fold(o, m, l, k, v, my)
+    o, lse = fold(o, lse, k, v, my)
 
     def step(carry, i):
-        o, m, l, kb, vb = carry
+        o, lse, kb, vb = carry
         perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
         kb = lax.ppermute(kb, axis, perm)
         vb = lax.ppermute(vb, axis, perm)
-        o, m, l = fold(o, m, l, kb, vb, (my - i) % n_shards)
-        return (o, m, l, kb, vb), None
+        o, lse = fold(o, lse, kb, vb, (my - i) % n_shards)
+        return (o, lse, kb, vb), None
 
-    (o, m, l, _, _), _ = lax.scan(step, (o, m, l, k, v),
-                                  jnp.arange(1, n_shards))
-    out = o / jnp.maximum(l, 1e-30)[..., None]          # (B,H,Lq,D)
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    (o, lse, _, _), _ = lax.scan(step, (o, lse, k, v),
+                                 jnp.arange(1, n_shards))
+    return o.astype(q.dtype)
 
 
 @functools.lru_cache(maxsize=None)

@@ -162,6 +162,39 @@ class TestFusedBackward:
             np.testing.assert_allclose(np.asarray(a, np.float32),
                                        np.asarray(b), rtol=0.1, atol=0.1)
 
+    @pytest.mark.parametrize("causal", [False, True],
+                             ids=["full", "causal"])
+    def test_return_lse_parity_and_grads(self, causal):
+        """return_lse=True: out AND lse agree between backends, and
+        gradients flow correctly through BOTH outputs (the lse
+        cotangent folds into the backward's delta term)."""
+        q, k, v = _qkv(10, l=200)
+
+        def loss(backend):
+            def f(q, k, v):
+                out, lse = flash_attention(q, k, v, causal=causal,
+                                           backend=backend,
+                                           return_lse=True)
+                return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+            return f
+
+        op, lp = flash_attention(q, k, v, causal=causal,
+                                 backend="pallas_interpret",
+                                 return_lse=True)
+        ox, lx = flash_attention(q, k, v, causal=causal, backend="xla",
+                                 return_lse=True)
+        assert lp.shape == (q.shape[0], q.shape[1], q.shape[2])
+        np.testing.assert_allclose(np.asarray(op), np.asarray(ox),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(lx),
+                                   rtol=1e-5, atol=1e-5)
+        g = jax.grad(loss("pallas_interpret"), argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss("xla"), argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{name}")
+
     def test_saved_lse_is_correct(self):
         """The forward's saved logsumexp equals the oracle's row-wise
         logsumexp of the masked scores (the quantity the backward

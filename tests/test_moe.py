@@ -202,6 +202,21 @@ class TestMoEDecode:
             toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
         assert np.array_equal(np.asarray(got), np.asarray(toks))
 
+    def test_prefill_decode_matches_scan_when_no_overflow(self):
+        """MoE + use_prefill: token-exact vs the scan decode when no
+        routing bucket overflows (capacity >= every group's worst
+        case); under overflow the two grouping schemes drop different
+        tokens by design (documented caveat)."""
+        from lua_mapreduce_tpu.models import transformer as tfm
+        moe_cfg, _ = self._cfgs(capacity=3 * 32)       # >= B*P: no drops
+        params = tfm.init_transformer(jax.random.PRNGKey(2), moe_cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(6).randint(0, 32, (3, 6)), jnp.int32)
+        a = tfm.greedy_decode(params, prompt, 5, cfg=moe_cfg)
+        b = tfm.greedy_decode(params, prompt, 5, cfg=moe_cfg,
+                              use_prefill=True)
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
     def test_decode_sampling_moe(self):
         """Temperature sampling works on the MoE path and is
         deterministic per key."""

@@ -599,6 +599,73 @@ class TestGreedyDecode:
         with pytest.raises(ValueError, match="PRNG"):
             tfm.greedy_decode(params, prompt, 2, cfg=cfg, temperature=0.5)
 
+    def test_prefill_matches_scan_decode(self, cfg):
+        """use_prefill=True (batched prompt ingestion) produces the
+        same tokens as the from-scratch position scan — greedy AND
+        sampled (shared fold_in(key, t) stream)."""
+        rng = np.random.RandomState(21)
+        params = tfm.init_transformer(jax.random.PRNGKey(21), cfg)
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab, (3, 7)), jnp.int32)
+        a = tfm.greedy_decode(params, prompt, 6, cfg=cfg)
+        b = tfm.greedy_decode(params, prompt, 6, cfg=cfg,
+                              use_prefill=True)
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        k = jax.random.PRNGKey(3)
+        c = tfm.greedy_decode(params, prompt, 6, cfg=cfg,
+                              temperature=0.9, key=k)
+        d = tfm.greedy_decode(params, prompt, 6, cfg=cfg,
+                              temperature=0.9, key=k, use_prefill=True)
+        assert np.array_equal(np.asarray(c), np.asarray(d))
+        # n_new edge cases
+        assert tfm.greedy_decode(params, prompt, 0, cfg=cfg,
+                                 use_prefill=True).shape == (3, 7)
+        e = tfm.greedy_decode(params, prompt, 1, cfg=cfg,
+                              use_prefill=True)
+        assert np.array_equal(np.asarray(e), np.asarray(
+            tfm.greedy_decode(params, prompt, 1, cfg=cfg)))
+
+    def test_prefill_sharded_matches_single_device(self, mesh, cfg):
+        """Sequence-parallel prefill (ring + zigzag over the mesh)
+        yields the same caches/logits — and therefore tokens — as the
+        single-device prefill."""
+        rng = np.random.RandomState(22)
+        params = tfm.init_transformer(jax.random.PRNGKey(22), cfg)
+        # zigzag needs p_len % (2*sp) == 0
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab, (2, 16)),
+                             jnp.int32)
+        want_c, want_l = tfm.prefill(params, prompt, cfg=cfg, total=20)
+        for attn in ("ring", "zigzag"):
+            got_c, got_l = tfm.prefill(params, prompt, cfg=cfg,
+                                       total=20, mesh=mesh, attn=attn)
+            np.testing.assert_allclose(np.asarray(got_l),
+                                       np.asarray(want_l),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=attn)
+            for name in want_c:
+                np.testing.assert_allclose(
+                    np.asarray(got_c[name]), np.asarray(want_c[name]),
+                    rtol=2e-4, atol=2e-4, err_msg=f"{attn}:{name}")
+        out = tfm.greedy_decode(params, prompt, 4, cfg=cfg,
+                                use_prefill=True, mesh=mesh, attn="ring")
+        ref = tfm.greedy_decode(params, prompt, 4, cfg=cfg)
+        assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_prefill_moe_sharded_rejected(self, mesh):
+        moe_cfg = tfm.TransformerConfig(vocab=16, d_model=16, n_heads=2,
+                                        n_layers=1, d_ff=32, max_seq=32,
+                                        moe_experts=2, moe_capacity=64)
+        params = tfm.init_transformer(jax.random.PRNGKey(0), moe_cfg)
+        prompt = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="dense"):
+            tfm.prefill(params, prompt, cfg=moe_cfg, mesh=mesh)
+        # single-device MoE prefill works (whole-prompt routing group)
+        caches, logits = tfm.prefill(params, prompt, cfg=moe_cfg)
+        assert logits.shape == (1, 16)
+        assert caches["L0_k"].shape == (1, 8, 2, 8)
+        # explicit total=0 must hit the guard, not silently mean p_len
+        with pytest.raises(ValueError, match="shorter than the prompt"):
+            tfm.prefill(params, prompt, cfg=moe_cfg, total=0)
+
     def test_moe_capacity_required(self):
         """A capacity-less MoE config must fail loudly at decode time
         just as it does at init/train time (the decode MoE path itself
