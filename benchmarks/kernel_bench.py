@@ -438,6 +438,62 @@ def bench_conv_train(model: str, batch: int, steps: int = 10) -> dict:
     }
 
 
+def bench_decode(d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
+                 vocab=32768, max_seq=4096, prompt_len=3968, n_new=128,
+                 batch=4) -> dict:
+    """LM inference bench: long-prompt generation, prefill vs the
+    from-scratch position scan. Reports prompt-ingestion speedup and
+    decode tokens/sec — the serving-side counterpart of
+    bench_transformer_step (training) for the same model family."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lua_mapreduce_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab=vocab, d_model=d_model,
+                                n_heads=n_heads, n_layers=n_layers,
+                                d_ff=d_ff, max_seq=max_seq)
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        tfm.init_transformer(jax.random.PRNGKey(0), cfg))
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, vocab, (batch, prompt_len)),
+                         jnp.int32)
+
+    def run(use_prefill):
+        out = tfm.greedy_decode(params, prompt, n_new, cfg=cfg,
+                                use_prefill=use_prefill)
+        return np.asarray(out)
+
+    def run_prefill_only():
+        c, lg = tfm.prefill(params, prompt, cfg=cfg,
+                            total=prompt_len + n_new)
+        return np.asarray(lg)
+
+    run(True)                                       # compile + warm
+    dt_pre = best_of(lambda: run(True), reps=3) - _call_overhead()
+    run(False)
+    dt_scan = best_of(lambda: run(False), reps=3) - _call_overhead()
+    run_prefill_only()
+    dt_ingest = best_of(run_prefill_only, reps=3) - _call_overhead()
+    toks = batch * n_new
+    # decode rate = generated tokens over the post-ingestion tail; the
+    # end-to-end rate includes prompt ingestion and so shifts with
+    # prompt_len by construction (labeled accordingly)
+    decode_tail = max(dt_pre - dt_ingest, 1e-9)
+    return {
+        "config": (f"d{d_model} h{n_heads} L{n_layers} v{vocab} "
+                   f"prompt{prompt_len} new{n_new} b{batch} bf16"),
+        "prefill_total_s": round(dt_pre, 3),
+        "scan_total_s": round(dt_scan, 3),
+        "prompt_ingest_s": round(dt_ingest, 3),
+        "speedup_prefill_vs_scan": round(dt_scan / dt_pre, 2),
+        "decode_tokens_per_sec": round(toks / decode_tail, 1),
+        "end_to_end_tokens_per_sec": round(toks / dt_pre, 1),
+    }
+
+
 def bench_native_merge(n_runs=16, keys_per_run=50_000) -> dict:
     """C++ single-pass shuffle merge vs the Python heap merge (the
     luamongo/mongo-cxx role, SURVEY.md §2.4)."""
@@ -544,6 +600,8 @@ def main() -> None:
                                                         bf16),
             # whole-train-step: the long-context LM family end to end
             "transformer_step_d1024_L8_s2048": bench_transformer_step,
+            # inference: long-prompt prefill vs from-scratch scan
+            "decode_prompt3968_new128": bench_decode,
             # end-to-end conv training (BASELINE configs 3-4)
             "lenet5_cifar_train_b1024": lambda: bench_conv_train(
                 "lenet5_cifar", 1024),

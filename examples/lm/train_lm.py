@@ -46,6 +46,9 @@ def main() -> None:
     ap.add_argument("--grad-accum", type=int, default=2)
     ap.add_argument("--attn", default="zigzag",
                     choices=["ring", "zigzag", "ulysses"])
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="grouped-query attention kv heads "
+                         "(0 = n_heads, plain MHA)")
     ap.add_argument("--ckpt", default=None,
                     help="storage spec for checkpoints, e.g. shared:/tmp/lm")
     ap.add_argument("--ckpt-every", type=int, default=10)
@@ -75,7 +78,7 @@ def main() -> None:
 
     cfg = tfm.TransformerConfig(vocab=64, d_model=64, n_heads=4,
                                 n_layers=2, d_ff=128, max_seq=args.seq,
-                                remat=True)
+                                remat=True, n_kv_heads=args.kv_heads)
     params = tfm.init_transformer(jax.random.PRNGKey(0), cfg)
     opt = optax.adam(3e-3)
     # zigzag batches are pre-permuted HOST-side (shard_batch below), so

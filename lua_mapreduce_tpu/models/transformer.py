@@ -53,6 +53,12 @@ class TransformerConfig:
     n_layers: int = 2
     d_ff: int = 512
     max_seq: int = 512
+    # grouped-query attention: 0 (default) = n_heads (plain MHA); a
+    # divisor of n_heads shares each kv head across n_heads/n_kv_heads
+    # query heads — the KV cache and the kv projection shrink by that
+    # factor (the modern long-context serving lever; the flash kernels
+    # regroup via index maps, ops/attention.py)
+    n_kv_heads: int = 0
     # mixture-of-experts: >0 replaces every block's dense FFN with a
     # switch-routed expert FFN (parallel/moe.py); 0 = dense. capacity is
     # REQUIRED with experts and is per routing group (the device tile in
@@ -79,17 +85,30 @@ def flops_per_token(cfg: TransformerConfig, seq_len: int,
                     causal: bool = True) -> float:
     """Matmul FLOPs per token for one TRAIN step (fwd + bwd ≈ 3× fwd) —
     the MFU numerator (same accounting role as models/mlp.py
-    ``flops_per_example``). Counted: qkv+out projections (8d²/token),
-    attention score+value contractions (4·L·d, halved when causal),
-    dense FFN (4·d·d_ff), tied LM head (2·d·V). Uncounted (understates
+    ``flops_per_example``). Counted: qkv+out projections
+    (2·d·(H+2H_kv)·hd + 2d² per token — 8d² at MHA, less under GQA),
+    attention score+value contractions (4·L·d, halved when causal;
+    unchanged by GQA — every QUERY head still contracts), dense FFN
+    (4·d·d_ff), tied LM head (2·d·V). Uncounted (understates
     utilization): layernorms, softmax, embeddings, and the extra block
     forward under ``cfg.remat``. MoE FFN FLOPs follow the per-token
     routed expert (same as dense for top-1 switch routing)."""
     d, dff = cfg.d_model, cfg.d_ff
+    hd = d // cfg.n_heads
+    qkv_proj = 2.0 * d * (cfg.n_heads + 2 * kv_heads(cfg)) * hd
     attn = 4.0 * seq_len * d * (0.5 if causal else 1.0)
-    per_layer = 8.0 * d * d + attn + 4.0 * d * dff
+    per_layer = qkv_proj + 2.0 * d * d + attn + 4.0 * d * dff
     fwd = cfg.n_layers * per_layer + 2.0 * d * cfg.vocab
     return 3.0 * fwd
+
+
+def kv_heads(cfg: TransformerConfig) -> int:
+    """Effective kv head count (n_kv_heads, defaulting to n_heads)."""
+    hkv = cfg.n_kv_heads or cfg.n_heads
+    if cfg.n_heads % hkv:
+        raise ValueError(f"n_kv_heads={hkv} must divide "
+                         f"n_heads={cfg.n_heads}")
+    return hkv
 
 
 def _check_moe(cfg: TransformerConfig, n_ep: Optional[int] = None) -> None:
@@ -109,6 +128,8 @@ def init_transformer(key, cfg: TransformerConfig = TransformerConfig(),
     the token embedding (standard weight tying)."""
     _check_moe(cfg)
     d, ff = cfg.d_model, cfg.d_ff
+    hd = d // cfg.n_heads
+    qkv_cols = (cfg.n_heads + 2 * kv_heads(cfg)) * hd
     params: Params = {}
     keys = iter(jax.random.split(key, 2 + 4 * cfg.n_layers))
     params["tok_emb"] = 0.02 * jax.random.normal(
@@ -118,7 +139,7 @@ def init_transformer(key, cfg: TransformerConfig = TransformerConfig(),
     for i in range(cfg.n_layers):
         p = f"L{i}"
         params[f"{p}_qkv_W"] = jax.random.normal(
-            next(keys), (d, 3 * d), dtype) / np.sqrt(d)
+            next(keys), (d, qkv_cols), dtype) / np.sqrt(d)
         params[f"{p}_out_W"] = jax.random.normal(
             next(keys), (d, d), dtype) / np.sqrt(d)
         if cfg.moe_experts:
@@ -178,10 +199,12 @@ def _block(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
     p = f"L{i}"
     b, l, d = x.shape
     h, hd = cfg.n_heads, d // cfg.n_heads
+    hkv = kv_heads(cfg)
     y = _layer_norm(x, params[f"{p}_ln1_g"], params[f"{p}_ln1_b"])
-    qkv = y @ params[f"{p}_qkv_W"]                      # (B, L, 3D) MXU
-    q, k, v = (t.reshape(b, l, h, hd)
-               for t in jnp.split(qkv, 3, axis=-1))
+    qkv = y @ params[f"{p}_qkv_W"]              # (B, L, (H+2Hkv)·hd) MXU
+    q = qkv[..., :h * hd].reshape(b, l, h, hd)
+    k = qkv[..., h * hd:(h + hkv) * hd].reshape(b, l, hkv, hd)
+    v = qkv[..., (h + hkv) * hd:].reshape(b, l, hkv, hd)
     if kv_sink is not None:
         kv_sink.append((k, v))
     a = attn_fn(q, k, v).reshape(b, l, d)
@@ -237,11 +260,12 @@ def prefill(params: Params, prompt, *,
     sharded train step.
 
     Returns ``(caches, last_logits)``: caches is the
-    ``L{i}_{k,v} -> (B, total, H, Dh)`` dict :func:`greedy_decode`
-    uses (zero-padded to ``total``, default P), last_logits is
-    (B, vocab). Dense and MoE configs single-device; the sharded path
-    is dense-only (expert sharding composes with training's dp, not
-    with replicated-param prefill)."""
+    ``L{i}_{k,v} -> (B, total, H_kv, Dh)`` dict :func:`greedy_decode`
+    uses (H_kv = ``kv_heads(cfg)``, which is where GQA's group-factor
+    cache shrink shows up; zero-padded to ``total``, default P),
+    last_logits is (B, vocab). Dense and MoE configs single-device; the
+    sharded path is dense-only (expert sharding composes with
+    training's dp, not with replicated-param prefill)."""
     b, p_len = prompt.shape
     if p_len < 1:
         raise ValueError("prompt must contain at least one token")
@@ -278,7 +302,7 @@ def prefill(params: Params, prompt, *,
             logits, _ = _forward(
                 params, toks, pos, cfg_fwd, attn_shard,
                 block=functools.partial(_block, kv_sink=sink))
-            ks = jnp.stack([kk for kk, _ in sink])   # (nl, B, Lloc, H, hd)
+            ks = jnp.stack([kk for kk, _ in sink])  # (nl, B, Lloc, Hkv, hd)
             vs = jnp.stack([vv for _, vv in sink])
             return logits, ks, vs
 
@@ -318,8 +342,9 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     """KV-cached decoding: (B, P) int32 prompt → (B, P+n_new).
 
     The inference half of the LM family (training: make_train_step).
-    One ``lax.scan`` over positions with per-layer (B, L, H, Dh) caches
-    in the carry — static shapes throughout, so the whole decode is one
+    One ``lax.scan`` over positions with per-layer (B, L, H_kv, Dh)
+    caches in the carry (H_kv < H under GQA — the cache shrinks by the
+    group factor) — static shapes throughout, so the whole decode is one
     compiled program; each step attends its single query against the
     cache under an iota≤t mask. Inside the prompt the next input is the
     given token (prefill and generation share one code path); after it,
@@ -368,13 +393,18 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     total = p_len + n_new
     _check_seq(total, cfg)
     h, hd = cfg.n_heads, cfg.d_model // cfg.n_heads
+    hkv = kv_heads(cfg)
+    g = h // hkv            # query heads per kv head (1 = plain MHA)
     # per-step routing group = B tokens; clamp dispatch capacity to it
     step_cfg = (dataclasses.replace(cfg, moe_capacity=min(cfg.moe_capacity,
                                                           b))
                 if cfg.moe_experts else cfg)
 
+    # GQA: the cache holds H_kv heads — the group-factor cache shrink
+    # is the point of n_kv_heads at decode time
     caches = {
-        f"L{i}_{kv}": jnp.zeros((b, total, h, hd), params["tok_emb"].dtype)
+        f"L{i}_{kv}": jnp.zeros((b, total, hkv, hd),
+                                params["tok_emb"].dtype)
         for i in range(cfg.n_layers) for kv in ("k", "v")
     }
     # position t reads its input from `prompt` while t < p_len, else the
@@ -392,20 +422,24 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
             y = _layer_norm(x, params[f"{pfx}_ln1_g"],
                             params[f"{pfx}_ln1_b"])
             qkv = y @ params[f"{pfx}_qkv_W"]
-            q, k, v = (s.reshape(b, 1, h, hd)
-                       for s in jnp.split(qkv, 3, axis=-1))
+            q = qkv[..., :h * hd].reshape(b, 1, hkv, g, hd)
+            k = qkv[..., h * hd:(h + hkv) * hd].reshape(b, 1, hkv, hd)
+            v = qkv[..., (h + hkv) * hd:].reshape(b, 1, hkv, hd)
             ck = lax.dynamic_update_slice(
                 caches[f"{pfx}_k"], k, (0, t, 0, 0))
             cv = lax.dynamic_update_slice(
                 caches[f"{pfx}_v"], v, (0, t, 0, 0))
             caches = {**caches, f"{pfx}_k": ck, f"{pfx}_v": cv}
-            s = jnp.einsum("bqhd,bmhd->bhqm", q, ck,
+            # grouped contraction: the g query heads of each kv head
+            # share its cache rows (g = 1 is exactly the MHA einsum)
+            s = jnp.einsum("bqkgd,bmkd->bkgqm", q, ck,
                            preferred_element_type=jnp.float32)
             s = s / jnp.sqrt(jnp.float32(hd))
-            s = jnp.where(jnp.arange(total)[None, None, None, :] <= t,
-                          s, _NEG_INF)
+            s = jnp.where(
+                jnp.arange(total)[None, None, None, None, :] <= t,
+                s, _NEG_INF)
             w = jax.nn.softmax(s, axis=-1)
-            a = jnp.einsum("bhqm,bmhd->bqhd", w.astype(cv.dtype), cv,
+            a = jnp.einsum("bkgqm,bmkd->bqkgd", w.astype(cv.dtype), cv,
                            preferred_element_type=jnp.float32)
             a = a.astype(x.dtype).reshape(b, 1, cfg.d_model)
             x = x + a @ params[f"{pfx}_out_W"]
@@ -484,6 +518,11 @@ def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int,
             raise ValueError(
                 f"ulysses needs n_heads divisible by the {sp_axis} axis: "
                 f"{n_heads} heads over {n_sp} devices")
+        if kv_heads(cfg) % n_sp:
+            raise ValueError(
+                f"ulysses needs n_kv_heads divisible by the {sp_axis} "
+                f"axis: {kv_heads(cfg)} kv heads over {n_sp} devices "
+                f"(ring/zigzag have no such constraint)")
         return functools.partial(_ulysses_shard, axis=sp_axis,
                                  n_shards=n_sp, causal=True)
     raise ValueError(f"unknown attn {attn!r} "
@@ -738,6 +777,10 @@ def shard_params_3d(params: Params, mesh, cfg: TransformerConfig, *,
     """Reshape tp weights to head-structured layouts and device_put every
     param with its 3-D sharding (inverse: :func:`unshard_params_3d`)."""
     d, h = cfg.d_model, cfg.n_heads
+    if kv_heads(cfg) != h:
+        raise ValueError("the 3-D tp path shards the fused qkv by head "
+                         "and supports MHA only; GQA composes with "
+                         "dp/sp (make_train_step) in the current build")
     hd = d // h
     specs = param_specs_3d(mp_axis)
     out: Params = {}
@@ -798,6 +841,10 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
     if cfg.n_heads % n_mp:
         raise ValueError(f"n_heads={cfg.n_heads} not divisible by "
                          f"{mp_axis}={n_mp}")
+    if kv_heads(cfg) != cfg.n_heads:
+        raise ValueError("the 3-D tp path shards the fused qkv by head "
+                         "and supports MHA only; GQA composes with "
+                         "dp/sp (make_train_step) in the current build")
     if cfg.moe_experts:
         raise ValueError("MoE blocks are not supported on the 3-D tp "
                          "path; use make_train_step (experts over dp)")

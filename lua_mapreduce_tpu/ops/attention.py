@@ -42,6 +42,10 @@ _NEG_INF = -1e30
 
 def _attn_reference_xla(q, k, v, causal: bool, scale: float,
                         with_lse: bool = False):
+    group = q.shape[2] // k.shape[2]
+    if group > 1:                   # GQA: each kv head serves a group
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     s = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
@@ -134,21 +138,34 @@ def _pad_seq(x, block: int):
     return jnp.pad(x, ((0, 0), (0, p), (0, 0))) if p else x
 
 
+def _to_bh(x):
+    """(B, L, H, D) → (B·H, L, D): one grid row per (batch, head)."""
+    b, l, h, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+
+
+def _kv_row(bh, h: int, hkv: int):
+    """Grid row of the kv head serving q-grid-row ``bh`` (GQA): q head
+    ``hq`` reads kv head ``hq // (h//hkv)``; identity when h == hkv."""
+    if h == hkv:
+        return bh
+    group = h // hkv
+    return (bh // h) * hkv + (bh % h) // group
+
+
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret",
                               "with_lse"))
 def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
                   interpret=False, with_lse=False):
     b, l, h, d = q.shape
+    hkv = k.shape[2]
     scale = 1.0 / float(d) ** 0.5
-    # (B, L, H, D) → (B·H, L, D): one grid row per (batch, head)
-    def to_bh(x):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
 
     block_q, block_k = _clamp_blocks(l, block_q, block_k)
-    qb = _pad_seq(to_bh(q), block_q)
-    kb = _pad_seq(to_bh(k), block_k)
-    vb = _pad_seq(to_bh(v), block_k)
+    qb = _pad_seq(_to_bh(q), block_q)
+    kb = _pad_seq(_to_bh(k), block_k)
+    vb = _pad_seq(_to_bh(v), block_k)
     n_q = qb.shape[1] // block_q
     n_kv = kb.shape[1] // block_k
 
@@ -160,9 +177,11 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, ki: (_kv_row(bh, h, hkv), ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, ki: (_kv_row(bh, h, hkv), ki, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -250,10 +269,17 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
-                          causal, seq_len, block_q, block_k, n_q):
-    ki, qi = pl.program_id(1), pl.program_id(2)   # q innermost here
+                          causal, seq_len, block_q, block_k, n_q,
+                          n_inner):
+    """Grid: (b·h_kv, n_kv, n_inner) with n_inner = group·n_q — the
+    innermost axis walks every (q-head-in-group, q-block) pair whose
+    gradients land in THIS kv head's (dk, dv) tile, so GQA's
+    sum-over-group falls out of the same scratch accumulation that
+    already summed over q blocks (group = 1 reduces to plain MHA)."""
+    ki, inner = pl.program_id(1), pl.program_id(2)
+    qi = inner % n_q
 
-    @pl.when(qi == 0)
+    @pl.when(inner == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -278,7 +304,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         fold()
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(inner == n_inner - 1)
     def _():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -300,17 +326,16 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
     same rank-1 row term as Δ with the opposite sign, so it folds into
     the delta operand and the kernels need no change at all."""
     b, l, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
     scale = 1.0 / float(d) ** 0.5
 
-    def to_bh(x):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
-
     block_q, block_k = _clamp_blocks(l, block_q, block_k)
-    qb = _pad_seq(to_bh(q), block_q)
-    kb = _pad_seq(to_bh(k), block_k)
-    vb = _pad_seq(to_bh(v), block_k)
-    dob = _pad_seq(to_bh(g), block_q)
-    ob = _pad_seq(to_bh(o), block_q)
+    qb = _pad_seq(_to_bh(q), block_q)
+    kb = _pad_seq(_to_bh(k), block_k)
+    vb = _pad_seq(_to_bh(v), block_k)
+    dob = _pad_seq(_to_bh(g), block_q)
+    ob = _pad_seq(_to_bh(o), block_q)
     delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32),
                     axis=-1)                        # (B·H, Lq_pad)
     # kernel dots need matching operand dtypes: the lse path's cotangent
@@ -332,8 +357,9 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
                           memory_space=pltpu.VMEM)
     spec_row = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i),
                             memory_space=pltpu.VMEM)
-    spec_kv = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
-                           memory_space=pltpu.VMEM)
+    spec_kv = pl.BlockSpec(
+        (1, block_k, d), lambda bh, i, j: (_kv_row(bh, h, hkv), j, 0),
+        memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, n_kv=n_kv, **kw),
@@ -345,17 +371,24 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
         interpret=interpret,
     )(qb, kb, vb, dob, lse, delta)
 
-    # dkv grid: kv-block outer, q-block inner (accumulators live per
-    # kv tile); index maps mirror the dq call's with i↔j swapped
-    spec_q2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0),
-                           memory_space=pltpu.VMEM)
-    spec_row2 = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i),
-                             memory_space=pltpu.VMEM)
+    # dkv grid: one row per KV head, kv-block outer, and the innermost
+    # axis walks (q-head-in-group × q-block) — the q-side index maps
+    # recover the q grid row from (bhkv, inner // n_q)
+    def q_row(bhkv, i):
+        return (bhkv // hkv) * h + (bhkv % hkv) * group + i // n_q
+
+    spec_q2 = pl.BlockSpec(
+        (1, block_q, d), lambda bh, j, i: (q_row(bh, i), i % n_q, 0),
+        memory_space=pltpu.VMEM)
+    spec_row2 = pl.BlockSpec(
+        (1, block_q), lambda bh, j, i: (q_row(bh, i), i % n_q),
+        memory_space=pltpu.VMEM)
     spec_kv2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0),
                             memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, n_q=n_q, **kw),
-        grid=(b * h, n_kv, n_q),
+        functools.partial(_flash_bwd_dkv_kernel, n_q=n_q,
+                          n_inner=group * n_q, **kw),
+        grid=(b * hkv, n_kv, group * n_q),
         in_specs=[spec_q2, spec_kv2, spec_kv2, spec_q2, spec_row2,
                   spec_row2],
         out_specs=[spec_kv2, spec_kv2],
@@ -366,11 +399,12 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
         interpret=interpret,
     )(qb, kb, vb, dob, lse, delta)
 
-    def from_bh(x, ln):
-        return jnp.transpose(x[:, :ln, :].reshape(b, h, ln, d),
+    def from_bh(x, ln, heads):
+        return jnp.transpose(x[:, :ln, :].reshape(b, heads, ln, d),
                              (0, 2, 1, 3))
 
-    return from_bh(dq, l), from_bh(dk, l), from_bh(dv, l)
+    return (from_bh(dq, l, h), from_bh(dk, l, hkv),
+            from_bh(dv, l, hkv))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -448,6 +482,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
     kernel; ``"xla"`` is the reference composition (correctness oracle,
     non-TPU platforms).
 
+    Grouped-query attention: k/v may carry FEWER heads than q (H_kv
+    dividing H) — q head ``h`` attends kv head ``h // (H/H_kv)``. The
+    kernels regroup via index maps (kv tiles re-read per group member;
+    the dkv backward walks each kv head's whole q group in its scratch
+    accumulation), so GQA costs no extra HBM materialization either.
+
     ``return_lse=True`` also returns the per-row logsumexp of the
     masked scores, shape (B, L, H) f32 — the mergeable-softmax state
     that lets callers combine partial attentions over disjoint KV sets
@@ -455,9 +495,16 @@ def flash_attention(q, k, v, *, causal: bool = False,
     round once at the caller's final cast, not per merge step).
     Differentiable through BOTH outputs."""
     backend = resolve_backend(backend, "flash_attention")
-    if q.shape != k.shape or q.shape != v.shape:
-        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} "
-                         f"{v.shape}")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
+    if (q.shape[0], q.shape[1], q.shape[3]) != \
+            (k.shape[0], k.shape[1], k.shape[3]):
+        raise ValueError(f"q/k shapes incompatible: {q.shape} vs "
+                         f"{k.shape} (batch, seq, head_dim must match)")
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"GQA needs q heads divisible by kv heads: {q.shape[2]} "
+            f"vs {k.shape[2]}")
     # the kernel's dots run in the operand dtype (MXU-native bf16 path),
     # so mixed q/k/v dtypes are promoted HERE — otherwise dot_general
     # fails deep inside the pallas trace with no user-facing cause

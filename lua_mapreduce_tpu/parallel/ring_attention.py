@@ -353,6 +353,10 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = "sp",
     if q.shape[2] % n_shards:
         raise ValueError(
             f"{q.shape[2]} heads not divisible by {axis}={n_shards}")
+    if k.shape[2] % n_shards:
+        raise ValueError(
+            f"{k.shape[2]} kv heads not divisible by {axis}={n_shards} "
+            f"(GQA over ulysses reshards BOTH head sets)")
     if q.shape[1] % n_shards:
         raise ValueError(
             f"seq len {q.shape[1]} not divisible by {axis}={n_shards}")

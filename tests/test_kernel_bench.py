@@ -19,6 +19,20 @@ def test_bench_conv_train_lenet_smoke():
     assert "lenet5_cifar" in out["config"]
 
 
+def test_bench_decode_smoke():
+    """bench_decode at toy scale on CPU: sane numbers, prefill path
+    actually faster-or-equal is NOT asserted (CPU timings are noise) —
+    only that both paths run and the dict is well-formed."""
+    from benchmarks.kernel_bench import bench_decode
+
+    out = bench_decode(d_model=32, n_heads=4, n_layers=1, d_ff=64,
+                       vocab=64, max_seq=64, prompt_len=48, n_new=8,
+                       batch=2)
+    assert out["prefill_total_s"] > 0 and out["scan_total_s"] > 0
+    assert out["decode_tokens_per_sec"] > 0
+    assert out["end_to_end_tokens_per_sec"] > 0
+
+
 def test_bench_conv_train_unknown_model_rejected():
     from benchmarks.kernel_bench import bench_conv_train
 
