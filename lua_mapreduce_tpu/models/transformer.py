@@ -59,6 +59,18 @@ class TransformerConfig:
     # factor (the modern long-context serving lever; the flash kernels
     # regroup via index maps, ops/attention.py)
     n_kv_heads: int = 0
+    # rotary position embeddings: q/k rotated by their GLOBAL position
+    # before attention (relative-position encoding, no pos_emb table —
+    # the standard long-context scheme; composes with every sequence-
+    # parallel form because _shard_pos already hands each device its
+    # global positions). head_dim must be even.
+    rope: bool = False
+    rope_base: float = 10000.0
+    # "ln" (pre-LN with bias) or "rms" (RMSNorm, scale only)
+    norm: str = "ln"
+    # "gelu" (2-matmul MLP with biases) or "swiglu" (gate/up/down,
+    # no biases — the llama-style FFN)
+    ffn: str = "gelu"
     # mixture-of-experts: >0 replaces every block's dense FFN with a
     # switch-routed expert FFN (parallel/moe.py); 0 = dense. capacity is
     # REQUIRED with experts and is per routing group (the device tile in
@@ -80,6 +92,14 @@ class TransformerConfig:
         return TransformerConfig(vocab=64, d_model=32, n_heads=4,
                                  n_layers=2, d_ff=64, max_seq=128)
 
+    @staticmethod
+    def llama_style(**kw) -> "TransformerConfig":
+        """The modern decoder recipe: RoPE + RMSNorm + SwiGLU + GQA
+        (pass ``n_kv_heads``); any field overridable via ``kw``."""
+        base = dict(rope=True, norm="rms", ffn="swiglu")
+        base.update(kw)
+        return TransformerConfig(**base)
+
 
 def flops_per_token(cfg: TransformerConfig, seq_len: int,
                     causal: bool = True) -> float:
@@ -97,7 +117,8 @@ def flops_per_token(cfg: TransformerConfig, seq_len: int,
     hd = d // cfg.n_heads
     qkv_proj = 2.0 * d * (cfg.n_heads + 2 * kv_heads(cfg)) * hd
     attn = 4.0 * seq_len * d * (0.5 if causal else 1.0)
-    per_layer = qkv_proj + 2.0 * d * d + attn + 4.0 * d * dff
+    ffn = (6.0 if cfg.ffn == "swiglu" else 4.0) * d * dff
+    per_layer = qkv_proj + 2.0 * d * d + attn + ffn
     fwd = cfg.n_layers * per_layer + 2.0 * d * cfg.vocab
     return 3.0 * fwd
 
@@ -109,6 +130,21 @@ def kv_heads(cfg: TransformerConfig) -> int:
         raise ValueError(f"n_kv_heads={hkv} must divide "
                          f"n_heads={cfg.n_heads}")
     return hkv
+
+
+def _check_arch(cfg: TransformerConfig) -> None:
+    """Architecture-knob validation shared by init and every factory."""
+    if cfg.norm not in ("ln", "rms"):
+        raise ValueError(f"unknown norm {cfg.norm!r} (want 'ln'|'rms')")
+    if cfg.ffn not in ("gelu", "swiglu"):
+        raise ValueError(f"unknown ffn {cfg.ffn!r} "
+                         f"(want 'gelu'|'swiglu')")
+    if cfg.rope and (cfg.d_model // cfg.n_heads) % 2:
+        raise ValueError("rope needs an even head_dim; got "
+                         f"{cfg.d_model // cfg.n_heads}")
+    if cfg.moe_experts and cfg.ffn != "gelu":
+        raise ValueError("MoE blocks use the switch-gelu expert FFN; "
+                         "ffn='swiglu' applies to dense blocks only")
 
 
 def _check_moe(cfg: TransformerConfig, n_ep: Optional[int] = None) -> None:
@@ -127,15 +163,17 @@ def init_transformer(key, cfg: TransformerConfig = TransformerConfig(),
     2-layer MLP + 2 layernorms, final layernorm; the LM head is tied to
     the token embedding (standard weight tying)."""
     _check_moe(cfg)
+    _check_arch(cfg)
     d, ff = cfg.d_model, cfg.d_ff
     hd = d // cfg.n_heads
     qkv_cols = (cfg.n_heads + 2 * kv_heads(cfg)) * hd
     params: Params = {}
-    keys = iter(jax.random.split(key, 2 + 4 * cfg.n_layers))
+    keys = iter(jax.random.split(key, 2 + 5 * cfg.n_layers))
     params["tok_emb"] = 0.02 * jax.random.normal(
         next(keys), (cfg.vocab, d), dtype)
-    params["pos_emb"] = 0.02 * jax.random.normal(
-        next(keys), (cfg.max_seq, d), dtype)
+    if not cfg.rope:        # rope needs no position table
+        params["pos_emb"] = 0.02 * jax.random.normal(
+            next(keys), (cfg.max_seq, d), dtype)
     for i in range(cfg.n_layers):
         p = f"L{i}"
         params[f"{p}_qkv_W"] = jax.random.normal(
@@ -146,6 +184,13 @@ def init_transformer(key, cfg: TransformerConfig = TransformerConfig(),
             params.update(_moe.init_moe(
                 next(keys), d, ff, cfg.moe_experts, dtype,
                 prefix=f"{p}_moe"))
+        elif cfg.ffn == "swiglu":
+            params[f"{p}_ff1_W"] = jax.random.normal(     # gate
+                next(keys), (d, ff), dtype) / np.sqrt(d)
+            params[f"{p}_ff3_W"] = jax.random.normal(     # up
+                next(keys), (d, ff), dtype) / np.sqrt(d)
+            params[f"{p}_ff2_W"] = jax.random.normal(     # down
+                next(keys), (ff, d), dtype) / np.sqrt(ff)
         else:
             params[f"{p}_ff1_W"] = jax.random.normal(
                 next(keys), (d, ff), dtype) / np.sqrt(d)
@@ -155,9 +200,11 @@ def init_transformer(key, cfg: TransformerConfig = TransformerConfig(),
             params[f"{p}_ff2_b"] = jnp.zeros((d,), dtype)
         for ln in ("ln1", "ln2"):
             params[f"{p}_{ln}_g"] = jnp.ones((d,), dtype)
-            params[f"{p}_{ln}_b"] = jnp.zeros((d,), dtype)
+            if cfg.norm == "ln":
+                params[f"{p}_{ln}_b"] = jnp.zeros((d,), dtype)
     params["lnf_g"] = jnp.ones((d,), dtype)
-    params["lnf_b"] = jnp.zeros((d,), dtype)
+    if cfg.norm == "ln":
+        params["lnf_b"] = jnp.zeros((d,), dtype)
     return params
 
 
@@ -167,12 +214,42 @@ def _layer_norm(x, g, b, eps=1e-5):
     return (x - mu) * lax.rsqrt(var + eps) * g + b
 
 
+def _norm(params: Params, name: str, x, cfg: TransformerConfig,
+          eps=1e-5):
+    """The block norm: pre-LN (scale+bias) or RMSNorm (scale only)."""
+    g = params[f"{name}_g"]
+    if cfg.norm == "rms":
+        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        return x * lax.rsqrt(ms + eps) * g
+    return _layer_norm(x, g, params[f"{name}_b"], eps)
+
+
+def _rope(x, pos, base: float):
+    """Rotary embedding: rotate each (i, i+hd/2) pair of head dims by
+    pos·base^(-2i/hd). x (B, L, H*, hd) — broadcasts over ANY head
+    count (q and GQA's smaller k alike); pos (L,) global positions.
+    Rotation-half convention; angles in f32, result in x.dtype."""
+    half = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]  # (L, half)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), \
+        x[..., half:].astype(jnp.float32)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
 def _ffn(params: Params, p: str, y, cfg: TransformerConfig,
          moe_axis: Optional[str]):
     """The block's FFN: dense, or switch-MoE when cfg.moe_experts > 0
     (expert-parallel over ``moe_axis`` inside shard_map, single-device
     reference routing when ``moe_axis`` is None). Returns (out, aux)."""
     if not cfg.moe_experts:
+        if cfg.ffn == "swiglu":
+            gate = jax.nn.silu(y @ params[f"{p}_ff1_W"])
+            up = y @ params[f"{p}_ff3_W"]
+            return (gate * up) @ params[f"{p}_ff2_W"], 0.0
         h = jax.nn.gelu(y @ params[f"{p}_ff1_W"] + params[f"{p}_ff1_b"])
         return h @ params[f"{p}_ff2_W"] + params[f"{p}_ff2_b"], 0.0
     b, l, d = y.shape
@@ -190,26 +267,34 @@ def _ffn(params: Params, p: str, y, cfg: TransformerConfig,
 
 
 def _block(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
-           moe_axis: Optional[str] = None, kv_sink: Optional[list] = None):
-    """One pre-LN decoder block; ``attn_fn(q, k, v) -> out`` supplies the
-    (possibly sequence-parallel) attention. Returns (x, moe_aux).
+           pos, moe_axis: Optional[str] = None,
+           kv_sink: Optional[list] = None):
+    """One pre-norm decoder block; ``attn_fn(q, k, v) -> out`` supplies
+    the (possibly sequence-parallel) attention; ``pos`` are the GLOBAL
+    positions of the L rows (rope consumes them; ignored otherwise).
+    Returns (x, moe_aux).
 
     ``kv_sink`` (a list) captures this block's (k, v) projections —
-    the prefill path harvests them as the decode KV cache."""
+    the prefill path harvests them as the decode KV cache. With rope
+    the captured k is the ROTATED one (what attention consumes and
+    what the decode cache stores)."""
     p = f"L{i}"
     b, l, d = x.shape
     h, hd = cfg.n_heads, d // cfg.n_heads
     hkv = kv_heads(cfg)
-    y = _layer_norm(x, params[f"{p}_ln1_g"], params[f"{p}_ln1_b"])
+    y = _norm(params, f"{p}_ln1", x, cfg)
     qkv = y @ params[f"{p}_qkv_W"]              # (B, L, (H+2Hkv)·hd) MXU
     q = qkv[..., :h * hd].reshape(b, l, h, hd)
     k = qkv[..., h * hd:(h + hkv) * hd].reshape(b, l, hkv, hd)
     v = qkv[..., (h + hkv) * hd:].reshape(b, l, hkv, hd)
+    if cfg.rope:
+        q = _rope(q, pos, cfg.rope_base)
+        k = _rope(k, pos, cfg.rope_base)
     if kv_sink is not None:
         kv_sink.append((k, v))
     a = attn_fn(q, k, v).reshape(b, l, d)
     x = x + a @ params[f"{p}_out_W"]
-    y = _layer_norm(x, params[f"{p}_ln2_g"], params[f"{p}_ln2_b"])
+    y = _norm(params, f"{p}_ln2", x, cfg)
     out, aux = _ffn(params, p, y, cfg, moe_axis)
     return x + out, aux
 
@@ -229,7 +314,9 @@ def _forward(params: Params, tokens, pos, cfg: TransformerConfig,
     passes its tensor-parallel block) — one forward for every path.
     Returns (logits, summed moe aux loss; 0.0 for dense blocks)."""
     block = block or _block
-    x = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    x = params["tok_emb"][tokens]
+    if not cfg.rope:
+        x = x + params["pos_emb"][pos]   # rope positions live in-block
     aux_total = 0.0
     for i in range(cfg.n_layers):
         if cfg.remat:
@@ -237,12 +324,12 @@ def _forward(params: Params, tokens, pos, cfg: TransformerConfig,
             # sp/tp blocks are re-executed in the backward — the usual
             # ring-attention remat shape)
             def run_block(p, xx, _i=i):
-                return block(p, _i, xx, cfg, attn_fn)
+                return block(p, _i, xx, cfg, attn_fn, pos)
             x, aux = jax.checkpoint(run_block)(params, x)
         else:
-            x, aux = block(params, i, x, cfg, attn_fn)
+            x, aux = block(params, i, x, cfg, attn_fn, pos)
         aux_total = aux_total + aux
-    x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    x = _norm(params, "lnf", x, cfg)
     return x @ params["tok_emb"].T, aux_total           # tied head
 
 
@@ -269,6 +356,7 @@ def prefill(params: Params, prompt, *,
     b, p_len = prompt.shape
     if p_len < 1:
         raise ValueError("prompt must contain at least one token")
+    _check_arch(cfg)
     total = p_len if total is None else total
     if total < p_len:
         raise ValueError(f"total={total} shorter than the prompt {p_len}")
@@ -307,12 +395,17 @@ def prefill(params: Params, prompt, *,
             return logits, ks, vs
 
         tokens_z, perm = _maybe_zigzag(attn, n_sp, tokens)
+        # inference batches are often smaller than the training dp
+        # size: when B doesn't divide it, replicate the batch axis and
+        # keep only the sequence sharded (the memory that matters at
+        # long context is the L axis anyway)
+        bspec = dp_axis if b % mesh.shape[dp_axis] == 0 else None
         fn = jax.shard_map(
             shard_fwd, mesh=mesh,
-            in_specs=(P(), P(dp_axis, sp_axis)),
-            out_specs=(P(dp_axis, sp_axis),
-                       P(None, dp_axis, sp_axis),
-                       P(None, dp_axis, sp_axis)))
+            in_specs=(P(), P(bspec, sp_axis)),
+            out_specs=(P(bspec, sp_axis),
+                       P(None, bspec, sp_axis),
+                       P(None, bspec, sp_axis)))
         logits, ks, vs = fn(params, tokens_z)
         if perm is not None:                 # back to standard order
             inv = perm.argsort()
@@ -377,6 +470,7 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     (B, P) prompt as one group (the oracle grouping) while the scan
     routes B tokens per step, so under overflow the two drop DIFFERENT
     tokens and may diverge, the same caveat as decode-vs-oracle."""
+    _check_arch(cfg)
     if cfg.moe_experts:
         _check_moe(cfg)
     if temperature < 0:
@@ -415,16 +509,23 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     def step(carry, t):
         caches, cur = carry
         tok = jnp.where(t < p_len, given[:, t], cur)    # (B,)
-        x = params["tok_emb"][tok] + params["pos_emb"][t]   # (B, D)
+        x = params["tok_emb"][tok]                      # (B, D)
+        if not cfg.rope:
+            x = x + params["pos_emb"][t]
         x = x[:, None, :]                               # (B, 1, D)
         for i in range(cfg.n_layers):
             pfx = f"L{i}"
-            y = _layer_norm(x, params[f"{pfx}_ln1_g"],
-                            params[f"{pfx}_ln1_b"])
+            y = _norm(params, f"{pfx}_ln1", x, cfg)
             qkv = y @ params[f"{pfx}_qkv_W"]
-            q = qkv[..., :h * hd].reshape(b, 1, hkv, g, hd)
+            q = qkv[..., :h * hd].reshape(b, 1, h, hd)
             k = qkv[..., h * hd:(h + hkv) * hd].reshape(b, 1, hkv, hd)
             v = qkv[..., (h + hkv) * hd:].reshape(b, 1, hkv, hd)
+            if cfg.rope:
+                # rotate THIS position; cache stores rotated keys (the
+                # same convention the prefill capture uses)
+                q = _rope(q, t[None], cfg.rope_base)
+                k = _rope(k, t[None], cfg.rope_base)
+            q = q.reshape(b, 1, hkv, g, hd)
             ck = lax.dynamic_update_slice(
                 caches[f"{pfx}_k"], k, (0, t, 0, 0))
             cv = lax.dynamic_update_slice(
@@ -443,11 +544,10 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
                            preferred_element_type=jnp.float32)
             a = a.astype(x.dtype).reshape(b, 1, cfg.d_model)
             x = x + a @ params[f"{pfx}_out_W"]
-            y = _layer_norm(x, params[f"{pfx}_ln2_g"],
-                            params[f"{pfx}_ln2_b"])
+            y = _norm(params, f"{pfx}_ln2", x, cfg)
             ff, _ = _ffn(params, pfx, y, step_cfg, None)
             x = x + ff
-        x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+        x = _norm(params, "lnf", x, cfg)
         logits = (x @ params["tok_emb"].T)[:, 0]        # (B, vocab)
         nxt = select(logits, t)
         return (caches, nxt), nxt
@@ -567,6 +667,7 @@ def make_sharded_apply(cfg: TransformerConfig, mesh, *,
     sequence-parallel over ``sp``. Dense params are replicated; with
     ``cfg.moe_experts`` > 0 the expert stacks shard over dp and params
     must come from :func:`shard_params_moe`."""
+    _check_arch(cfg)
     n_sp = mesh.shape[sp_axis]
     attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
     moe_axis = dp_axis if cfg.moe_experts else None
@@ -661,6 +762,7 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
     (ADVICE r2); the pre-permuted path removes it from steady state."""
     if zigzag_layout and attn != "zigzag":
         raise ValueError("zigzag_layout=True requires attn='zigzag'")
+    _check_arch(cfg)
     n_sp = mesh.shape[sp_axis]
     attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
     moe_axis = None
@@ -761,6 +863,7 @@ def param_specs_3d(mp_axis: str = "mp") -> Dict[str, object]:
         "_out_W": P(mp_axis, None, None),
         "_ff1_W": P(None, mp_axis),
         "_ff1_b": P(mp_axis),
+        "_ff3_W": P(None, mp_axis),     # swiglu up (columns, like gate)
         "_ff2_W": P(mp_axis, None),
     }
 
@@ -808,18 +911,26 @@ def unshard_params_3d(params: Params, cfg: TransformerConfig) -> Params:
 
 
 def _block_tp(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
-              mp_axis: str):
+              pos, mp_axis: str):
     """One decoder block on LOCAL tp slices; x enters and leaves
-    replicated across mp."""
+    replicated across mp. Rope rotates this slice's heads by the same
+    global ``pos`` (per-head independent, so head sharding is free);
+    swiglu shards gate/up columns and down rows like gelu's ff1/ff2."""
     p = f"L{i}"
-    y = _layer_norm(x, params[f"{p}_ln1_g"], params[f"{p}_ln1_b"])
+    y = _norm(params, f"{p}_ln1", x, cfg)
     w_qkv = params[f"{p}_qkv_W"]                # (d, 3, H/mp, hd) local
     q, k, v = (jnp.einsum("bld,dhk->blhk", y, w_qkv[:, t])
                for t in range(3))               # (B, L, H/mp, hd)
+    if cfg.rope:
+        q = _rope(q, pos, cfg.rope_base)
+        k = _rope(k, pos, cfg.rope_base)
     a = attn_fn(q, k, v)                        # this mp slice's heads
     partial = jnp.einsum("blhk,hkd->bld", a, params[f"{p}_out_W"])
     x = x + lax.psum(partial, mp_axis)          # Megatron sync point 1
-    y = _layer_norm(x, params[f"{p}_ln2_g"], params[f"{p}_ln2_b"])
+    y = _norm(params, f"{p}_ln2", x, cfg)
+    if cfg.ffn == "swiglu":
+        h = jax.nn.silu(y @ params[f"{p}_ff1_W"]) * (y @ params[f"{p}_ff3_W"])
+        return x + lax.psum(h @ params[f"{p}_ff2_W"], mp_axis), 0.0
     y = jax.nn.gelu(y @ params[f"{p}_ff1_W"] + params[f"{p}_ff1_b"])
     partial = y @ params[f"{p}_ff2_W"]
     return x + lax.psum(partial, mp_axis) + params[f"{p}_ff2_b"], 0.0
@@ -836,6 +947,7 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
     pre-permuted zigzag batches via ``shard_batch(schedule="zigzag")``."""
     if zigzag_layout and attn != "zigzag":
         raise ValueError("zigzag_layout=True requires attn='zigzag'")
+    _check_arch(cfg)
     n_sp = mesh.shape[sp_axis]
     n_mp = mesh.shape[mp_axis]
     if cfg.n_heads % n_mp:
@@ -907,8 +1019,11 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
 # the pipeline (parallel/pipeline.py). Dense FFN blocks only — tp/MoE
 # compose with dp/sp, not with this axis, in the current build.
 
-_STACKED = ("qkv_W", "out_W", "ff1_W", "ff1_b", "ff2_W", "ff2_b",
-            "ln1_g", "ln1_b", "ln2_g", "ln2_b")
+def _layer_weight_names(params: Params) -> list:
+    """Per-layer weight suffixes, derived from the ACTUAL keys (the set
+    varies with cfg.ffn / cfg.norm — a fixed list would silently drop
+    swiglu's ff3 or crash on rms's missing biases)."""
+    return sorted(k[len("L0_"):] for k in params if k.startswith("L0_"))
 
 
 def stack_params_pp(params: Params, cfg: TransformerConfig) -> Params:
@@ -918,7 +1033,7 @@ def stack_params_pp(params: Params, cfg: TransformerConfig) -> Params:
         raise ValueError("pipeline form supports dense blocks only")
     out: Params = {k: v for k, v in params.items()
                    if not k.startswith("L")}
-    for name in _STACKED:
+    for name in _layer_weight_names(params):
         out[f"layers_{name}"] = jnp.stack(
             [params[f"L{i}_{name}"] for i in range(cfg.n_layers)])
     return out
@@ -928,10 +1043,12 @@ def unstack_params_pp(stacked: Params, cfg: TransformerConfig) -> Params:
     """Inverse of :func:`stack_params_pp` (canonical per-layer names)."""
     out: Params = {k: jnp.asarray(v) for k, v in stacked.items()
                    if not k.startswith("layers_")}
-    for name in _STACKED:
-        w = jnp.asarray(stacked[f"layers_{name}"])
-        for i in range(cfg.n_layers):
-            out[f"L{i}_{name}"] = w[i]
+    for k, v in stacked.items():
+        if k.startswith("layers_"):
+            name = k[len("layers_"):]
+            w = jnp.asarray(v)
+            for i in range(cfg.n_layers):
+                out[f"L{i}_{name}"] = w[i]
     return out
 
 
@@ -946,7 +1063,7 @@ def shard_params_pp(params: Params, mesh, cfg: TransformerConfig, *,
         for k, v in stacked.items()}
 
 
-def _block_stacked(w: Params, x, cfg: TransformerConfig):
+def _block_stacked(w: Params, x, cfg: TransformerConfig, pos):
     """One dense decoder block from a single layer's weight dict (no
     name prefixes) with full local attention — the pipeline stage body.
     Delegates to _block so the pipeline computes EXACTLY the model the
@@ -954,7 +1071,7 @@ def _block_stacked(w: Params, x, cfg: TransformerConfig):
     prefixed = {f"L0_{k}": v for k, v in w.items()}
     out, _aux = _block(prefixed, 0, x, cfg,
                        functools.partial(attention_reference,
-                                         causal=True))
+                                         causal=True), pos)
     return out
 
 
@@ -965,6 +1082,7 @@ def make_train_step_pp(cfg: TransformerConfig, mesh, optimizer, *,
     :func:`shard_params_pp` and tokens/targets replicated (B must divide
     by ``n_micro``). Reverse-mode AD transposes the GPipe scan into the
     backward pipeline — no hand-written schedule."""
+    _check_arch(cfg)
     n_pp = mesh.shape[pp_axis]
     if cfg.n_layers % n_pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
@@ -983,20 +1101,22 @@ def make_train_step_pp(cfg: TransformerConfig, mesh, optimizer, *,
         tgt_m = targets.reshape(n_micro, mb, l)
 
         def global_loss(p):
-            local_layers = {name: p[f"layers_{name}"]
-                            for name in _STACKED}
+            local_layers = {k[len("layers_"):]: v for k, v in p.items()
+                            if k.startswith("layers_")}
             pos = jnp.arange(l)
-            x_micro = (p["tok_emb"][tok_m] + p["pos_emb"][pos])
+            x_micro = p["tok_emb"][tok_m]
+            if not cfg.rope:
+                x_micro = x_micro + p["pos_emb"][pos]
 
             def stage(x):
                 def body(x, w):
-                    return _block_stacked(w, x, cfg), None
+                    return _block_stacked(w, x, cfg, pos), None
                 x, _ = lax.scan(body, x, local_layers)
                 return x
 
             outs = pipeline_apply(stage, x_micro, pp_axis=pp_axis,
                                   n_stages=n_pp)       # (M, mb, l, d)
-            x = _layer_norm(outs, p["lnf_g"], p["lnf_b"])
+            x = _norm(p, "lnf", outs, cfg)
             logits = x @ p["tok_emb"].T
             return _mean_nll(logits, tgt_m)
 
