@@ -313,13 +313,16 @@ def bench_pool(n, h, w, c, dtype):
 
 def bench_transformer_step(d_model=1024, n_heads=16, n_layers=8,
                            d_ff=4096, vocab=32768, seq=2048, batch=8,
-                           steps=10) -> dict:
+                           steps=10, modern=False) -> dict:
     """Whole-train-step bench for the long-context model family: the
     framework's own LM train step (flash attention on the device-local
     path, fused grad all-reduce, optimizer) scanned ``steps`` times in
     ONE jitted call on a 1-device mesh, bf16 params. Reports ms/step,
     tokens/sec, and MFU from models/transformer.flops_per_token — the
-    training-loop counterpart of the per-op numbers above."""
+    training-loop counterpart of the per-op numbers above.
+
+    ``modern=True`` runs the llama_style recipe (rope + rms + swiglu +
+    4:1 GQA) — the architecture most serving stacks actually train."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -330,9 +333,11 @@ def bench_transformer_step(d_model=1024, n_heads=16, n_layers=8,
     from lua_mapreduce_tpu.models import transformer as tfm
     from lua_mapreduce_tpu.utils.roofline import mfu
 
-    cfg = tfm.TransformerConfig(vocab=vocab, d_model=d_model,
-                                n_heads=n_heads, n_layers=n_layers,
-                                d_ff=d_ff, max_seq=seq)
+    kw = dict(vocab=vocab, d_model=d_model, n_heads=n_heads,
+              n_layers=n_layers, d_ff=d_ff, max_seq=seq)
+    cfg = (tfm.TransformerConfig.llama_style(n_kv_heads=n_heads // 4,
+                                             **kw)
+           if modern else tfm.TransformerConfig(**kw))
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
     params = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
                           tfm.init_transformer(jax.random.PRNGKey(0), cfg))
@@ -364,7 +369,9 @@ def bench_transformer_step(d_model=1024, n_heads=16, n_layers=8,
     model_flops = tok * tfm.flops_per_token(cfg, seq)
     return {
         "config": (f"d{d_model} h{n_heads} L{n_layers} ff{d_ff} "
-                   f"v{vocab} seq{seq} b{batch} bf16 ring+flash"),
+                   f"v{vocab} seq{seq} b{batch} bf16 ring+flash"
+                   + (" llama-style(rope+rms+swiglu+gqa4:1)"
+                      if modern else "")),
         "ms_per_step": round(per_step * 1e3, 2),
         "tokens_per_sec": round(tok / per_step, 1),
         "mfu": round(mfu(model_flops, per_step), 4),
@@ -600,6 +607,8 @@ def main() -> None:
                                                         bf16),
             # whole-train-step: the long-context LM family end to end
             "transformer_step_d1024_L8_s2048": bench_transformer_step,
+            "transformer_step_llama_style": lambda: bench_transformer_step(
+                modern=True),
             # inference: long-prompt prefill vs from-scratch scan
             "decode_prompt3968_new128": bench_decode,
             # end-to-end conv training (BASELINE configs 3-4)

@@ -34,7 +34,7 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from lua_mapreduce_tpu.ops.attention import flash_attention
+from lua_mapreduce_tpu.ops.attention import _tile_mask, flash_attention
 from lua_mapreduce_tpu.parallel import moe as _moe
 from lua_mapreduce_tpu.parallel.pipeline import pipeline_apply
 from lua_mapreduce_tpu.parallel.ring_attention import (
@@ -71,6 +71,11 @@ class TransformerConfig:
     # "gelu" (2-matmul MLP with biases) or "swiglu" (gate/up/down,
     # no biases — the llama-style FFN)
     ffn: str = "gelu"
+    # sliding-window attention: each position sees at most the last
+    # ``window`` positions (0 = full causal). Supported on the oracle,
+    # KV-cached decode, and single-device prefill; the sequence-
+    # parallel forms reject it (a banded ring is a different schedule).
+    window: int = 0
     # mixture-of-experts: >0 replaces every block's dense FFN with a
     # switch-routed expert FFN (parallel/moe.py); 0 = dense. capacity is
     # REQUIRED with experts and is per routing group (the device tile in
@@ -116,7 +121,15 @@ def flops_per_token(cfg: TransformerConfig, seq_len: int,
     d, dff = cfg.d_model, cfg.d_ff
     hd = d // cfg.n_heads
     qkv_proj = 2.0 * d * (cfg.n_heads + 2 * kv_heads(cfg)) * hd
-    attn = 4.0 * seq_len * d * (0.5 if causal else 1.0)
+    if cfg.window and causal:
+        # sliding window: mean visible keys per token is
+        # (Σ_{i=1..L} min(i, w)) / L — the kernel prunes the rest,
+        # so counting full-causal work would inflate MFU
+        we = min(cfg.window, seq_len)
+        visible = (we * (we + 1) / 2 + (seq_len - we) * we) / seq_len
+        attn = 4.0 * d * visible
+    else:
+        attn = 4.0 * seq_len * d * (0.5 if causal else 1.0)
     ffn = (6.0 if cfg.ffn == "swiglu" else 4.0) * d * dff
     per_layer = qkv_proj + 2.0 * d * d + attn + ffn
     fwd = cfg.n_layers * per_layer + 2.0 * d * cfg.vocab
@@ -145,6 +158,8 @@ def _check_arch(cfg: TransformerConfig) -> None:
     if cfg.moe_experts and cfg.ffn != "gelu":
         raise ValueError("MoE blocks use the switch-gelu expert FFN; "
                          "ffn='swiglu' applies to dense blocks only")
+    if cfg.window < 0:
+        raise ValueError(f"window must be >= 0, got {cfg.window}")
 
 
 def _check_moe(cfg: TransformerConfig, n_ep: Optional[int] = None) -> None:
@@ -373,13 +388,18 @@ def prefill(params: Params, prompt, *,
         logits, _ = _forward(
             params, tokens, jnp.arange(p_len), cfg_fwd,
             lambda q, k, v: flash_attention(q, k, v, causal=True,
-                                            backend="auto"),
+                                            backend="auto",
+                                            window=cfg.window),
             block=functools.partial(_block, kv_sink=sink))
         kvs = sink
     else:
         if cfg.moe_experts:
             raise ValueError("sequence-parallel prefill supports dense "
                              "configs; MoE prefills single-device")
+        if cfg.window:
+            raise ValueError("sliding-window prefill runs single-device "
+                             "(mesh=None); the sequence-parallel forms "
+                             "reject cfg.window")
         n_sp = mesh.shape[sp_axis]
         attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
 
@@ -536,9 +556,11 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
             s = jnp.einsum("bqkgd,bmkd->bkgqm", q, ck,
                            preferred_element_type=jnp.float32)
             s = s / jnp.sqrt(jnp.float32(hd))
-            s = jnp.where(
-                jnp.arange(total)[None, None, None, None, :] <= t,
-                s, _NEG_INF)
+            # the SHARED mask definition (ops/attention._tile_mask):
+            # rows = the single query position t, cols = cache slots
+            seen = jnp.arange(total)[None, None, None, None, :]
+            vis = _tile_mask(t, seen, True, cfg.window, total)
+            s = jnp.where(vis, s, _NEG_INF)
             w = jax.nn.softmax(s, axis=-1)
             a = jnp.einsum("bkgqm,bmkd->bqkgd", w.astype(cv.dtype), cv,
                            preferred_element_type=jnp.float32)
@@ -595,7 +617,8 @@ def transformer_apply(params: Params, tokens, *,
     pos = jnp.arange(tokens.shape[1])
     logits, _ = _forward(params, tokens, pos, cfg,
                          functools.partial(attention_reference,
-                                           causal=True))
+                                           causal=True,
+                                           window=cfg.window))
     return logits
 
 
@@ -607,6 +630,11 @@ def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int,
     head count the divisibility check sees (the 3-D form passes its
     per-tp-slice count)."""
     n_heads = cfg.n_heads if n_heads is None else n_heads
+    if cfg.window:
+        raise ValueError(
+            "sliding-window attention (cfg.window > 0) is supported on "
+            "the oracle/decode/prefill paths; the sequence-parallel "
+            "forms need a banded ring schedule (not yet built)")
     if attn == "ring":
         return functools.partial(_ring_shard, axis=sp_axis,
                                  n_shards=n_sp, causal=True)
@@ -1071,7 +1099,8 @@ def _block_stacked(w: Params, x, cfg: TransformerConfig, pos):
     prefixed = {f"L0_{k}": v for k, v in w.items()}
     out, _aux = _block(prefixed, 0, x, cfg,
                        functools.partial(attention_reference,
-                                         causal=True), pos)
+                                         causal=True,
+                                         window=cfg.window), pos)
     return out
 
 

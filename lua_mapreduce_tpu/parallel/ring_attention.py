@@ -49,11 +49,13 @@ from lua_mapreduce_tpu.ops.attention import flash_attention
 _NEG_INF = -1e30      # finite mask fill: -inf breaks the m-subtraction
 
 
-def attention_reference(q, k, v, *, causal: bool = False):
+def attention_reference(q, k, v, *, causal: bool = False,
+                        window: int = 0):
     """Single-device softmax attention oracle, (B, L, H, D) layout —
     ONE oracle for the whole framework (delegates to the kernel
     library's XLA reference so the two can never diverge)."""
-    return flash_attention(q, k, v, causal=causal, backend="xla")
+    return flash_attention(q, k, v, causal=causal, backend="xla",
+                           window=window)
 
 
 def _flash_block(q, kb, vb, causal: bool):
