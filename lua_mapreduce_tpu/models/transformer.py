@@ -396,10 +396,9 @@ def prefill(params: Params, prompt, *,
         if cfg.moe_experts:
             raise ValueError("sequence-parallel prefill supports dense "
                              "configs; MoE prefills single-device")
-        if cfg.window:
-            raise ValueError("sliding-window prefill runs single-device "
-                             "(mesh=None); the sequence-parallel forms "
-                             "reject cfg.window")
+        if cfg.window and attn != "ring":
+            raise ValueError("sequence-parallel sliding-window prefill "
+                             "runs the banded ring (attn='ring')")
         n_sp = mesh.shape[sp_axis]
         attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
 
@@ -630,14 +629,17 @@ def _attn_shard_fn(attn: str, sp_axis: str, n_sp: int,
     head count the divisibility check sees (the 3-D form passes its
     per-tp-slice count)."""
     n_heads = cfg.n_heads if n_heads is None else n_heads
-    if cfg.window:
+    if cfg.window and attn != "ring":
         raise ValueError(
-            "sliding-window attention (cfg.window > 0) is supported on "
-            "the oracle/decode/prefill paths; the sequence-parallel "
-            "forms need a banded ring schedule (not yet built)")
+            "sliding-window attention (cfg.window > 0) runs "
+            "sequence-parallel as the BANDED contiguous ring "
+            "(attn='ring'); zigzag balances full-causal work a window "
+            "already bounds, and ulysses materializes full-sequence "
+            "heads per device")
     if attn == "ring":
         return functools.partial(_ring_shard, axis=sp_axis,
-                                 n_shards=n_sp, causal=True)
+                                 n_shards=n_sp, causal=True,
+                                 window=cfg.window)
     if attn == "zigzag":
         return functools.partial(_ring_shard_zigzag, axis=sp_axis,
                                  n_shards=n_sp, causal=True)

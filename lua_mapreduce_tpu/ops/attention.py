@@ -40,12 +40,18 @@ from lua_mapreduce_tpu.ops import resolve_backend
 _NEG_INF = -1e30
 
 
-def _tile_mask(rows, cols, causal: bool, window: int, seq_len: int):
+def _tile_mask(rows, cols, causal: bool, window: int, seq_len: int,
+               q_offset: int = 0):
     """Visibility of (row, col) score entries — THE mask definition,
     shared by the forward kernel, the backward tile re-materialization,
     and the XLA oracle so the three can never drift. ``window`` > 0
     additionally hides keys more than window-1 positions behind the
-    query (sliding-window attention; implies causal)."""
+    query (sliding-window attention; implies causal). ``q_offset``
+    shifts the query rows globally relative to the key columns — the
+    banded-ring case where this call's q block sits q_offset positions
+    AFTER its kv block (ring step i → offset i·L_loc, a STATIC value
+    because the windowed ring unrolls its steps)."""
+    rows = rows + q_offset
     valid = cols < seq_len
     if causal:
         valid = valid & (rows >= cols)
@@ -55,17 +61,17 @@ def _tile_mask(rows, cols, causal: bool, window: int, seq_len: int):
 
 
 def _tile_live(qi, ki, block_q: int, block_k: int, causal: bool,
-               window: int):
+               window: int, q_offset: int = 0):
     """Whether tile (qi, ki) contains ANY visible score — the block-skip
     predicate (None = statically always live). Causal prunes tiles
     wholly above the diagonal; a window additionally prunes tiles wholly
     behind it (~L/window of the causal work at long L)."""
+    row0 = qi * block_q + q_offset
     conds = []
     if causal:
-        conds.append(ki * block_k <= qi * block_q + block_q - 1)
+        conds.append(ki * block_k <= row0 + block_q - 1)
     if window:
-        conds.append(qi * block_q - (ki * block_k + block_k - 1)
-                     < window)
+        conds.append(row0 - (ki * block_k + block_k - 1) < window)
     if not conds:
         return None
     live = conds[0]
@@ -75,7 +81,8 @@ def _tile_live(qi, ki, block_q: int, block_k: int, causal: bool,
 
 
 def _attn_reference_xla(q, k, v, causal: bool, scale: float,
-                        with_lse: bool = False, window: int = 0):
+                        with_lse: bool = False, window: int = 0,
+                        q_offset: int = 0):
     group = q.shape[2] // k.shape[2]
     if group > 1:                   # GQA: each kv head serves a group
         k = jnp.repeat(k, group, axis=2)
@@ -86,7 +93,7 @@ def _attn_reference_xla(q, k, v, causal: bool, scale: float,
         lq, lk = s.shape[-2], s.shape[-1]
         rows = jnp.arange(lq)[:, None]
         cols = jnp.arange(lk)[None, :]
-        mask = _tile_mask(rows, cols, causal, window, lk)
+        mask = _tile_mask(rows, cols, causal, window, lk, q_offset)
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out32 = jnp.einsum("bhlm,bmhd->blhd", p, v.astype(jnp.float32))
@@ -100,7 +107,7 @@ def _attn_reference_xla(q, k, v, causal: bool, scale: float,
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                   acc_scr, *, scale: float, causal: bool, seq_len: int,
                   block_q: int, block_k: int, n_kv: int,
-                  window: int = 0):
+                  window: int = 0, q_offset: int = 0):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -127,7 +134,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             jnp.int32, (block_q, block_k), 0)
         cols = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        valid = _tile_mask(rows, cols, causal, window, seq_len)
+        valid = _tile_mask(rows, cols, causal, window, seq_len,
+                           q_offset)
         s = jnp.where(valid, s, _NEG_INF)
 
         m_prev = m_scr[:]                               # (bq, 1)
@@ -142,7 +150,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    live = _tile_live(qi, ki, block_q, block_k, causal, window)
+    live = _tile_live(qi, ki, block_q, block_k, causal, window,
+                      q_offset)
     if live is None:
         fold()
     else:
@@ -191,9 +200,10 @@ def _kv_row(bh, h: int, hkv: int):
 
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret",
-                              "with_lse", "window"))
+                              "with_lse", "window", "q_offset"))
 def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
-                  interpret=False, with_lse=False, window=0):
+                  interpret=False, with_lse=False, window=0,
+                  q_offset=0):
     b, l, h, d = q.shape
     hkv = k.shape[2]
     scale = 1.0 / float(d) ** 0.5
@@ -208,7 +218,8 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
     out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
                           seq_len=l, block_q=block_q, block_k=block_k,
-                          n_kv=n_kv, window=window),
+                          n_kv=n_kv, window=window,
+                          q_offset=q_offset),
         grid=(b * h, n_q, n_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
@@ -247,7 +258,7 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
 
 
 def _bwd_tile(q, k, v, do, lse_ref, delta_ref, qi, ki, *, scale, causal,
-              seq_len, block_q, block_k, window=0):
+              seq_len, block_q, block_k, window=0, q_offset=0):
     """Re-materialize one (block_q, block_k) tile's p and ds in VMEM —
     the shared core of both backward kernels. Returns (p, ds) in f32.
 
@@ -260,7 +271,7 @@ def _bwd_tile(q, k, v, do, lse_ref, delta_ref, qi, ki, *, scale, causal,
         jnp.int32, (block_q, block_k), 0)
     cols = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    valid = _tile_mask(rows, cols, causal, window, seq_len)
+    valid = _tile_mask(rows, cols, causal, window, seq_len, q_offset)
     lse = lse_ref[...].reshape(block_q, 1)
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -272,7 +283,7 @@ def _bwd_tile(q, k, v, do, lse_ref, delta_ref, qi, ki, *, scale, causal,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_scr, *, scale, causal, seq_len,
-                         block_q, block_k, n_kv, window=0):
+                         block_q, block_k, n_kv, window=0, q_offset=0):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -284,13 +295,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         _, ds = _bwd_tile(q_ref[0], k, v_ref[0], do_ref[0], lse_ref,
                           delta_ref, qi, ki, scale=scale, causal=causal,
                           seq_len=seq_len, block_q=block_q,
-                          block_k=block_k, window=window)
+                          block_k=block_k, window=window,
+                          q_offset=q_offset)
         # dq_i += ds_ij · k_j  (scale already folded into ds)
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    live = _tile_live(qi, ki, block_q, block_k, causal, window)
+    live = _tile_live(qi, ki, block_q, block_k, causal, window,
+                      q_offset)
     if live is None:
         fold()
     else:
@@ -304,7 +317,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
                           causal, seq_len, block_q, block_k, n_q,
-                          n_inner, window=0):
+                          n_inner, window=0, q_offset=0):
     """Grid: (b·h_kv, n_kv, n_inner) with n_inner = group·n_q — the
     innermost axis walks every (q-head-in-group, q-block) pair whose
     gradients land in THIS kv head's (dk, dv) tile, so GQA's
@@ -324,7 +337,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p, ds = _bwd_tile(q, k_ref[0], v_ref[0], do, lse_ref, delta_ref,
                           qi, ki, scale=scale, causal=causal,
                           seq_len=seq_len, block_q=block_q,
-                          block_k=block_k, window=window)
+                          block_k=block_k, window=window,
+                          q_offset=q_offset)
         # dv_j += p_ijᵀ · do_i ; dk_j += ds_ijᵀ · q_i
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -333,7 +347,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    live = _tile_live(qi, ki, block_q, block_k, causal, window)
+    live = _tile_live(qi, ki, block_q, block_k, causal, window,
+                      q_offset)
     if live is None:
         fold()
     else:
@@ -347,10 +362,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret",
-                              "window"))
+                              "window", "q_offset"))
 def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
                       block_k=128, interpret=False, g_lse=None,
-                      window=0):
+                      window=0, q_offset=0):
     """Fused backward: (dq, dk, dv) with only O(L·d) HBM traffic.
 
     ``lse`` is the forward's saved per-row logsumexp, already in the
@@ -388,7 +403,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
     n_q = qb.shape[1] // block_q
     n_kv = kb.shape[1] // block_k
     kw = dict(scale=scale, causal=causal, seq_len=l,
-              block_q=block_q, block_k=block_k, window=window)
+              block_q=block_q, block_k=block_k, window=window,
+              q_offset=q_offset)
 
     spec_q = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
                           memory_space=pltpu.VMEM)
@@ -446,28 +462,30 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_p(q, k, v, cfg):
-    causal, block_q, block_k, interpret, window = cfg
+    causal, block_q, block_k, interpret, window, q_off = cfg
     return _flash_pallas(q, k, v, causal, block_q=block_q,
                          block_k=block_k, interpret=interpret,
-                         window=window)
+                         window=window, q_offset=q_off)
 
 
 def _flash_fwd(q, k, v, cfg):
-    causal, block_q, block_k, interpret, window = cfg
+    causal, block_q, block_k, interpret, window, q_off = cfg
     o, lse = _flash_pallas(q, k, v, causal, block_q=block_q,
                            block_k=block_k, interpret=interpret,
-                           with_lse=True, window=window)
+                           with_lse=True, window=window,
+                           q_offset=q_off)
     # primal must match _flash_p's eval dtype (q.dtype) — the with_lse
     # kernel emits f32; keep THAT in the residuals (sharper delta)
     return o.astype(q.dtype), (q, k, v, o, lse)
 
 
 def _flash_bwd(cfg, res, g):
-    causal, block_q, block_k, interpret, window = cfg
+    causal, block_q, block_k, interpret, window, q_off = cfg
     q, k, v, o, lse = res
     return _flash_bwd_pallas(q, k, v, o, lse, g, causal,
                              block_q=block_q, block_k=block_k,
-                             interpret=interpret, window=window)
+                             interpret=interpret, window=window,
+                             q_offset=q_off)
 
 
 _flash_p.defvjp(_flash_fwd, _flash_bwd)
@@ -482,31 +500,33 @@ def _lse_public(lse, b, l, h):
 def _flash_p_lse(q, k, v, cfg):
     """(out, lse (B, L, H)) — the two-output form ring folds consume;
     gradients flow through BOTH outputs (see _flash_bwd_pallas g_lse)."""
-    causal, block_q, block_k, interpret, window = cfg
+    causal, block_q, block_k, interpret, window, q_off = cfg
     b, l, h, _ = q.shape
     o, lse = _flash_pallas(q, k, v, causal, block_q=block_q,
                            block_k=block_k, interpret=interpret,
-                           with_lse=True, window=window)
+                           with_lse=True, window=window,
+                           q_offset=q_off)
     return o, _lse_public(lse, b, l, h)
 
 
 def _flash_lse_fwd(q, k, v, cfg):
-    causal, block_q, block_k, interpret, window = cfg
+    causal, block_q, block_k, interpret, window, q_off = cfg
     b, l, h, _ = q.shape
     o, lse = _flash_pallas(q, k, v, causal, block_q=block_q,
                            block_k=block_k, interpret=interpret,
-                           with_lse=True, window=window)
+                           with_lse=True, window=window,
+                           q_offset=q_off)
     return (o, _lse_public(lse, b, l, h)), (q, k, v, o, lse)
 
 
 def _flash_lse_bwd(cfg, res, g):
-    causal, block_q, block_k, interpret, window = cfg
+    causal, block_q, block_k, interpret, window, q_off = cfg
     g_out, g_lse = g
     q, k, v, o, lse = res
     return _flash_bwd_pallas(q, k, v, o, lse, g_out, causal,
                              block_q=block_q, block_k=block_k,
                              interpret=interpret, g_lse=g_lse,
-                             window=window)
+                             window=window, q_offset=q_off)
 
 
 _flash_p_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -515,7 +535,7 @@ _flash_p_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 def flash_attention(q, k, v, *, causal: bool = False,
                     backend: str = "auto", block_q: int = 128,
                     block_k: int = 128, return_lse: bool = False,
-                    window: int = 0):
+                    window: int = 0, q_offset: int = 0):
     """Exact softmax attention, (B, L, H, D) → (B, L, H, D).
 
     ``backend="pallas"``/``"pallas_interpret"`` runs the fused VMEM
@@ -541,6 +561,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
                              "causal attention")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if q_offset:
+        if not window:
+            raise ValueError("q_offset only applies to windowed "
+                             "attention (the banded-ring case)")
+        if q_offset < 0:
+            raise ValueError(f"q_offset must be >= 0, got {q_offset}")
     if k.shape != v.shape:
         raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
     if (q.shape[0], q.shape[1], q.shape[3]) != \
@@ -559,9 +585,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if backend == "xla":
         scale = 1.0 / float(q.shape[-1]) ** 0.5
         return _attn_reference_xla(q, k, v, causal, scale,
-                                   with_lse=return_lse, window=window)
+                                   with_lse=return_lse, window=window,
+                                   q_offset=q_offset)
     cfg = (causal, block_q, block_k, backend == "pallas_interpret",
-           window)
+           window, q_offset)
     if return_lse:
         return _flash_p_lse(q, k, v, cfg)
     return _flash_p(q, k, v, cfg)

@@ -58,7 +58,8 @@ def attention_reference(q, k, v, *, causal: bool = False,
                            window=window)
 
 
-def _flash_block(q, kb, vb, causal: bool):
+def _flash_block(q, kb, vb, causal: bool, window: int = 0,
+                 q_offset: int = 0):
     """One device-local attention block through the FUSED kernel
     (``ops.flash_attention``: Pallas on TPU, the XLA composition
     elsewhere), returning (out, lse) — the mergeable-softmax state.
@@ -66,9 +67,13 @@ def _flash_block(q, kb, vb, causal: bool):
     sequence-parallel stack: scores live one VMEM tile at a time, so
     per-device memory is O(L_loc·d) instead of the O(L_loc²) tile the
     previous hand-inlined fold materialized per ring step, and its
-    fused FlashAttention-2 backward keeps the same bound in training."""
+    fused FlashAttention-2 backward keeps the same bound in training.
+    ``window``/``q_offset``: the banded-ring mask (q rows sit
+    q_offset positions after the kv block's cols — STATIC, because the
+    windowed ring unrolls its hops)."""
     return flash_attention(q, kb, vb, causal=causal, backend="auto",
-                           return_lse=True)
+                           return_lse=True, window=window,
+                           q_offset=q_offset)
 
 
 def _merge_block(o, lse, blk):
@@ -109,13 +114,45 @@ def _ring_init(q):
     return o, lse
 
 
-def _ring_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
+def _ring_shard(q, k, v, *, axis: str, n_shards: int, causal: bool,
+                window: int = 0):
     """Per-device body (inside shard_map): local q stays put, (k, v)
     rotate the ring; after step i this device holds the KV shard of
     device (my - i) mod P. Every fold runs the fused flash kernel
-    (_flash_block) and merges via logaddexp weights (_merge_block)."""
+    (_flash_block) and merges via logaddexp weights (_merge_block).
+
+    ``window`` > 0 (causal only) runs the BANDED ring: the loop unrolls
+    with a static hop index i, so each fold's q-vs-kv offset (i·L_loc)
+    is a static kernel parameter, and the ring STOPS after
+    ceil((window-1)/L_loc) hops — blocks further back are wholly behind
+    the window for every device, so neither their compute NOR their
+    ppermute traffic happens (the communication win sliding-window
+    exists for)."""
     my = lax.axis_index(axis)
+    l_loc = q.shape[1]
     o, lse = _ring_init(q)
+
+    if causal and window:
+        # hops with ANY visible pair: min(row-col) at hop i is
+        # i·L_loc - (L_loc - 1) < window  ⇔  i ≤ (window+L_loc-2)/L_loc
+        hops = min(n_shards - 1, (window + l_loc - 2) // l_loc)
+        # hop 0 = the local block, unconditionally live on every device
+        o, lse = _merge_block(o, lse, _flash_block(q, k, v, True,
+                                                   window=window))
+        kb, vb = k, v
+        for i in range(1, hops + 1):
+            perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+            kb = lax.ppermute(kb, axis, perm)
+            vb = lax.ppermute(vb, axis, perm)
+            # wrapped sources (src > my, i.e. my < i) are above the
+            # causal diagonal — skipped; the kernel's banded mask
+            # handles everything else with the static offset i·L_loc
+            def live(c, _i=i, _kb=kb, _vb=vb):
+                return _merge_block(*c, _flash_block(
+                    q, _kb, _vb, True, window=window,
+                    q_offset=_i * l_loc))
+            o, lse = lax.cond(my >= i, live, lambda c: c, (o, lse))
+        return o.astype(q.dtype)
 
     def fold(o, lse, kb, vb, src):
         if causal:
@@ -256,14 +293,21 @@ def _ring_shard_zigzag(q, k, v, *, axis: str, n_shards: int,
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_jit(mesh, axis: str, causal: bool, schedule: str = "contiguous"):
-    """One compiled callable per (mesh, axis, causal, schedule) — jit
-    caches key on the function object, so building shard_map+jit per
-    call would retrace and recompile every invocation."""
-    body = _ring_shard_zigzag if schedule == "zigzag" else _ring_shard
+def _ring_jit(mesh, axis: str, causal: bool, schedule: str = "contiguous",
+              window: int = 0):
+    """One compiled callable per (mesh, axis, causal, schedule, window)
+    — jit caches key on the function object, so building shard_map+jit
+    per call would retrace and recompile every invocation."""
+    if schedule == "zigzag":
+        body = functools.partial(_ring_shard_zigzag, axis=axis,
+                                 n_shards=mesh.shape[axis],
+                                 causal=causal)
+    else:
+        body = functools.partial(_ring_shard, axis=axis,
+                                 n_shards=mesh.shape[axis],
+                                 causal=causal, window=window)
     fn = jax.shard_map(
-        functools.partial(body, axis=axis,
-                          n_shards=mesh.shape[axis], causal=causal),
+        body,
         mesh=mesh, in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis))
     return jax.jit(fn)
@@ -271,7 +315,7 @@ def _ring_jit(mesh, axis: str, causal: bool, schedule: str = "contiguous"):
 
 def ring_attention(q, k, v, mesh, *, axis: str = "sp",
                    causal: bool = False, schedule: str = "contiguous",
-                   layout: str = "seq"):
+                   layout: str = "seq", window: int = 0):
     """Exact attention over a sequence sharded on ``axis`` of ``mesh``.
 
     Inputs (B, L, H, D) are resharded to P(None, axis) if not already;
@@ -296,6 +340,16 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
         raise ValueError(f"unknown ring schedule {schedule!r}")
     if layout not in ("seq", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
+    if window:
+        if not causal:
+            raise ValueError("windowed ring attention implies causal")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if schedule == "zigzag":
+            raise ValueError("the banded ring runs the contiguous "
+                             "schedule (zigzag balances full-causal "
+                             "work; a window already bounds per-device "
+                             "work by construction)")
     if layout == "zigzag" and schedule != "zigzag":
         raise ValueError("layout='zigzag' requires schedule='zigzag'")
     permute = schedule == "zigzag" and layout == "seq"
@@ -310,7 +364,7 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
         q, k, v = (x[:, perm] for x in (q, k, v))
     sharding = NamedSharding(mesh, P(None, axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
-    out = _ring_jit(mesh, axis, causal, schedule)(q, k, v)
+    out = _ring_jit(mesh, axis, causal, schedule, window)(q, k, v)
     if permute:
         out = out[:, inv]
     return out

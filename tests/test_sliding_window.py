@@ -63,6 +63,27 @@ class TestKernel:
         with pytest.raises(ValueError, match="implies"):
             flash_attention(q, q, q, window=4)
 
+    def test_op_level_bad_args_rejected(self):
+        """Negative window / q_offset and orphan q_offset fail at the
+        OP boundary (the config path has its own check — code-review
+        r3 caught the op-level guard dropped in a refactor)."""
+        q = jnp.zeros((1, 8, 2, 4))
+        with pytest.raises(ValueError, match="window must be"):
+            flash_attention(q, q, q, causal=True, window=-1)
+        with pytest.raises(ValueError, match="q_offset only"):
+            flash_attention(q, q, q, causal=True, q_offset=4)
+        with pytest.raises(ValueError, match="q_offset must be"):
+            flash_attention(q, q, q, causal=True, window=4, q_offset=-2)
+        from lua_mapreduce_tpu.parallel.ring_attention import \
+            ring_attention
+        from lua_mapreduce_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(dp=1, mp=2, devices=jax.devices("cpu")[:2],
+                         axis_names=("dp", "sp"))
+        q2 = jnp.zeros((1, 16, 2, 4))
+        with pytest.raises(ValueError, match="window must be"):
+            ring_attention(q2, q2, q2, mesh, axis="sp", causal=True,
+                           window=-3)
+
     def test_window_one_sees_only_self(self):
         """window=1: every position attends only itself — output is
         exactly v (softmax over a single score)."""
@@ -75,12 +96,14 @@ class TestKernel:
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.fixture()
+def cfg():
+    return tfm.TransformerConfig.llama_style(
+        vocab=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=48, max_seq=128, window=8)
+
+
 class TestModel:
-    @pytest.fixture()
-    def cfg(self):
-        return tfm.TransformerConfig.llama_style(
-            vocab=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
-            d_ff=48, max_seq=128, window=8)
 
     def test_oracle_windowed_differs_from_full(self, cfg):
         """The window genuinely changes the model (long-range context
@@ -120,19 +143,104 @@ class TestModel:
                               use_prefill=True)
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
-    def test_sequence_parallel_forms_reject_window(self, cfg):
+    def test_non_ring_parallel_forms_reject_window(self, cfg):
+        """Windowed sequence-parallel runs ONLY as the banded ring;
+        zigzag/ulysses reject (zigzag balances work a window already
+        bounds; ulysses holds full-sequence heads)."""
         from lua_mapreduce_tpu.parallel.mesh import make_mesh
         import optax
         mesh = make_mesh(dp=2, mp=2, devices=jax.devices("cpu")[:4],
                          axis_names=("dp", "sp"))
-        with pytest.raises(ValueError, match="banded ring"):
-            tfm.make_train_step(cfg, mesh, optax.sgd(0.1))
-        with pytest.raises(ValueError, match="banded ring"):
-            tfm.make_sharded_apply(cfg, mesh)
+        for attn in ("zigzag", "ulysses"):
+            with pytest.raises(ValueError, match="(?i)banded"):
+                tfm.make_train_step(cfg, mesh, optax.sgd(0.1), attn=attn)
+            with pytest.raises(ValueError, match="(?i)banded"):
+                tfm.make_sharded_apply(cfg, mesh, attn=attn)
         params = tfm.init_transformer(jax.random.PRNGKey(0), cfg)
         prompt = jnp.zeros((2, 16), jnp.int32)
-        with pytest.raises(ValueError, match="single-device"):
-            tfm.prefill(params, prompt, cfg=cfg, mesh=mesh)
+        with pytest.raises(ValueError, match="(?i)banded"):
+            tfm.prefill(params, prompt, cfg=cfg, mesh=mesh,
+                        attn="zigzag")
+
+
+class TestBandedRing:
+    """Windowed SEQUENCE-PARALLEL attention: the banded ring unrolls
+    its hops (static per-hop mask offsets for the kernel) and stops at
+    ceil((w-1)/L_loc) hops — golden-diffed against the windowed
+    oracle, gradients included."""
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from lua_mapreduce_tpu.parallel.mesh import make_mesh
+        return make_mesh(dp=1, mp=8, devices=jax.devices("cpu")[:8],
+                         axis_names=("dp", "sp"))
+
+    @pytest.mark.parametrize("w", [1, 5, 16, 40, 128],
+                             ids=lambda w: f"w{w}")
+    def test_standalone_matches_windowed_oracle(self, mesh, w):
+        """Windows smaller than, equal to, and larger than L_loc=16 —
+        0, 1, 3, and all hops of the 8-shard ring respectively."""
+        from lua_mapreduce_tpu.parallel import ring_attention as ra
+        rng = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(rng.randn(2, 128, 4, 16),
+                               jnp.float32) * 0.5 for _ in range(3))
+        want = ra.attention_reference(q, k, v, causal=True, window=w)
+        got = ra.ring_attention(q, k, v, mesh, axis="sp", causal=True,
+                                window=w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-5, atol=3e-5)
+
+    def test_gradients_match_windowed_oracle(self, mesh):
+        from lua_mapreduce_tpu.parallel import ring_attention as ra
+        rng = np.random.RandomState(1)
+        q, k, v = (jnp.asarray(rng.randn(1, 64, 2, 8),
+                               jnp.float32) * 0.5 for _ in range(3))
+
+        def ring_loss(q):
+            return jnp.sum(ra.ring_attention(
+                q, k, v, mesh, axis="sp", causal=True, window=13) ** 2)
+
+        def ref_loss(q):
+            return jnp.sum(ra.attention_reference(
+                q, k, v, causal=True, window=13) ** 2)
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(ring_loss)(q)),
+            np.asarray(jax.grad(ref_loss)(q)), rtol=1e-4, atol=1e-4)
+
+    def test_train_step_windowed_matches_oracle_loss(self, cfg):
+        """make_train_step(attn='ring') with cfg.window: first-step
+        loss equals the windowed oracle's mean NLL."""
+        import optax
+        from lua_mapreduce_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(dp=2, mp=2, devices=jax.devices("cpu")[:4],
+                         axis_names=("dp", "sp"))
+        rng = np.random.RandomState(2)
+        seq = rng.randint(0, 64, (4, 33))
+        toks = jnp.asarray(seq[:, :-1], jnp.int32)
+        tgts = jnp.asarray(seq[:, 1:], jnp.int32)
+        params = tfm.init_transformer(jax.random.PRNGKey(3), cfg)
+        logits = tfm.transformer_apply(params, toks, cfg=cfg)
+        logp = jax.nn.log_softmax(logits)
+        want = -float(jnp.mean(
+            jnp.take_along_axis(logp, tgts[..., None], -1)))
+        opt = optax.sgd(0.1)
+        step = tfm.make_train_step(cfg, mesh, opt, attn="ring")
+        _, _, loss = step(params, opt.init(params),
+                          *tfm.shard_batch(mesh, toks, tgts))
+        assert abs(float(loss) - want) < 2e-5, (float(loss), want)
+
+    def test_sharded_windowed_prefill(self, cfg):
+        from lua_mapreduce_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(dp=2, mp=2, devices=jax.devices("cpu")[:4],
+                         axis_names=("dp", "sp"))
+        params = tfm.init_transformer(jax.random.PRNGKey(4), cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(5).randint(0, 64, (2, 16)), jnp.int32)
+        ref = tfm.greedy_decode(params, prompt, 5, cfg=cfg)
+        got = tfm.greedy_decode(params, prompt, 5, cfg=cfg,
+                                use_prefill=True, mesh=mesh, attn="ring")
+        assert np.array_equal(np.asarray(got), np.asarray(ref))
 
     def test_negative_window_rejected(self, cfg):
         bad = dataclasses.replace(cfg, window=-1)
