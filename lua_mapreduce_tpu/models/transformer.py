@@ -514,9 +514,17 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
                 if cfg.moe_experts else cfg)
 
     # GQA: the cache holds H_kv heads — the group-factor cache shrink
-    # is the point of n_kv_heads at decode time
+    # is the point of n_kv_heads at decode time. A sliding window
+    # additionally makes the cache a ROLLING buffer of `window` slots
+    # (position p lives in slot p mod window): the scan carry is O(w)
+    # instead of O(total), the serving memory the window exists for.
+    # Rolling containment IS the window mask — slot contents are
+    # exactly the positions (t-w, t], so the only masking left is
+    # "slot not yet filled" during the first w steps.
+    roll = bool(cfg.window) and cfg.window < total
+    cache_len = cfg.window if roll else total
     caches = {
-        f"L{i}_{kv}": jnp.zeros((b, total, hkv, hd),
+        f"L{i}_{kv}": jnp.zeros((b, cache_len, hkv, hd),
                                 params["tok_emb"].dtype)
         for i in range(cfg.n_layers) for kv in ("k", "v")
     }
@@ -545,20 +553,26 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
                 q = _rope(q, t[None], cfg.rope_base)
                 k = _rope(k, t[None], cfg.rope_base)
             q = q.reshape(b, 1, hkv, g, hd)
+            slot = t % cache_len if roll else t
             ck = lax.dynamic_update_slice(
-                caches[f"{pfx}_k"], k, (0, t, 0, 0))
+                caches[f"{pfx}_k"], k, (0, slot, 0, 0))
             cv = lax.dynamic_update_slice(
-                caches[f"{pfx}_v"], v, (0, t, 0, 0))
+                caches[f"{pfx}_v"], v, (0, slot, 0, 0))
             caches = {**caches, f"{pfx}_k": ck, f"{pfx}_v": cv}
             # grouped contraction: the g query heads of each kv head
             # share its cache rows (g = 1 is exactly the MHA einsum)
             s = jnp.einsum("bqkgd,bmkd->bkgqm", q, ck,
                            preferred_element_type=jnp.float32)
             s = s / jnp.sqrt(jnp.float32(hd))
-            # the SHARED mask definition (ops/attention._tile_mask):
-            # rows = the single query position t, cols = cache slots
-            seen = jnp.arange(total)[None, None, None, None, :]
-            vis = _tile_mask(t, seen, True, cfg.window, total)
+            seen = jnp.arange(cache_len)[None, None, None, None, :]
+            if roll:
+                # rolling containment = the window; mask only the
+                # slots not yet filled (first w steps)
+                vis = (seen <= t) | (t >= cache_len)
+            else:
+                # the SHARED mask definition (_tile_mask): rows = the
+                # single query position t, cols = cache slots
+                vis = _tile_mask(t, seen, True, cfg.window, total)
             s = jnp.where(vis, s, _NEG_INF)
             w = jax.nn.softmax(s, axis=-1)
             a = jnp.einsum("bkgqm,bmkd->bqkgd", w.astype(cv.dtype), cv,
@@ -592,6 +606,19 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
         caches, last_logits = prefill(params, prompt, cfg=cfg,
                                       total=total, mesh=mesh, attn=attn,
                                       dp_axis=dp_axis, sp_axis=sp_axis)
+        if roll:
+            # fold the prompt cache into the rolling layout: slot j
+            # holds the LAST prompt position ≡ j (mod w)
+            if p_len >= cache_len:
+                j = jnp.arange(cache_len)
+                src = p_len - 1 - ((p_len - 1 - j) % cache_len)
+                caches = {n: c[:, src] for n, c in caches.items()}
+            else:
+                # positions 0..p_len-1 land in slots 0..p_len-1 and the
+                # prefill cache is already zero-padded beyond them —
+                # a plain truncation IS the rolling layout
+                caches = {n: c[:, :cache_len]
+                          for n, c in caches.items()}
         tok1 = select(last_logits, p_len - 1)
         # remaining n_new - 1 positions ride the ordinary step scan
         (_, _), emitted = lax.scan(step, (caches, tok1),

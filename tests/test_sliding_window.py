@@ -143,6 +143,25 @@ class TestModel:
                               use_prefill=True)
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
+    def test_rolling_cache_short_prompt(self, cfg):
+        """Prompt SHORTER than the window: rolling slots beyond the
+        prompt stay masked until filled; prefill and scan agree with
+        the full-forward rerun (the window=8 cfg with p_len=5)."""
+        params = tfm.init_transformer(jax.random.PRNGKey(6), cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(7).randint(0, 64, (3, 5)), jnp.int32)
+        n_new = 10               # generation crosses the w=8 boundary
+        got = tfm.greedy_decode(params, prompt, n_new, cfg=cfg)
+        pre = tfm.greedy_decode(params, prompt, n_new, cfg=cfg,
+                                use_prefill=True)
+        toks = prompt
+        for _ in range(n_new):
+            logits = tfm.transformer_apply(params, toks, cfg=cfg)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        assert np.array_equal(np.asarray(got), np.asarray(toks))
+        assert np.array_equal(np.asarray(pre), np.asarray(toks))
+
     def test_non_ring_parallel_forms_reject_window(self, cfg):
         """Windowed sequence-parallel runs ONLY as the banded ring;
         zigzag/ulysses reject (zigzag balances work a window already
