@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from lua_mapreduce_tpu.ops.attention import _tile_mask, flash_attention
 from lua_mapreduce_tpu.parallel import moe as _moe
+from lua_mapreduce_tpu.parallel import zero1 as _z1
 from lua_mapreduce_tpu.parallel.pipeline import pipeline_apply
 from lua_mapreduce_tpu.parallel.ring_attention import (
     _NEG_INF, _ring_shard, _ring_shard_zigzag, _ulysses_shard,
@@ -72,9 +73,9 @@ class TransformerConfig:
     # no biases — the llama-style FFN)
     ffn: str = "gelu"
     # sliding-window attention: each position sees at most the last
-    # ``window`` positions (0 = full causal). Supported on the oracle,
-    # KV-cached decode, and single-device prefill; the sequence-
-    # parallel forms reject it (a banded ring is a different schedule).
+    # ``window`` positions (0 = full causal). Oracle, KV-cached decode
+    # (rolling O(window) cache), prefill, pipeline, and the BANDED
+    # contiguous ring (attn="ring") speak it; zigzag/ulysses reject.
     window: int = 0
     # mixture-of-experts: >0 replaces every block's dense FFN with a
     # switch-routed expert FFN (parallel/moe.py); 0 = dense. capacity is
@@ -794,7 +795,7 @@ def shard_params_moe(params: Params, mesh, *, ep_axis: str = "dp"
 def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
                     attn: str = "ring", dp_axis: str = "dp",
                     sp_axis: str = "sp", grad_accum: int = 1,
-                    zigzag_layout: bool = False):
+                    zigzag_layout: bool = False, zero1: bool = False):
     """Jitted SPMD LM train step: ``step(params, opt_state, tokens,
     targets) -> (params, opt_state, loss)`` with tokens/targets sharded
     P(dp, sp) and the gradient all-reduce (pmean over dp AND sp) fused
@@ -816,9 +817,26 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
     ``shard_batch(..., schedule="zigzag")``, which permutes host-side
     before device_put. The default path permutes inside the jitted step,
     which on P(dp, sp)-sharded arrays is a per-step cross-shard gather
-    (ADVICE r2); the pre-permuted path removes it from steady state."""
+    (ADVICE r2); the pre-permuted path removes it from steady state.
+
+    ``zero1=True`` shards the OPTIMIZER STATE over the dp axis
+    (parallel/zero1.py): gradients reduce-scatter instead of
+    all-reducing, each dp rank updates only its 1/n_dp chunk of every
+    parameter (Adam's m/v shrink by n_dp), and the updated chunks
+    all-gather back — same wire traffic as the all-reduce, optimizer
+    memory ÷ n_dp. The opt_state must come from
+    :func:`parallel.zero1.init_state`. Elementwise optimizers only;
+    dense configs (MoE already spends the dp axis on experts) and
+    grad_accum == 1 for now."""
     if zigzag_layout and attn != "zigzag":
         raise ValueError("zigzag_layout=True requires attn='zigzag'")
+    if zero1:
+        if cfg.moe_experts:
+            raise ValueError("zero1 shards optimizer state over dp, "
+                             "which MoE already spends on experts")
+        if grad_accum > 1:
+            raise ValueError("zero1 with grad_accum is not composed "
+                             "yet; pick one")
     _check_arch(cfg)
     n_sp = mesh.shape[sp_axis]
     attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
@@ -851,6 +869,34 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
         return accum_value_and_grad(global_loss, params,
                                     (tokens, targets), grad_accum)
 
+    def shard_step_zero1(params, opt_state, tokens, targets):
+        """The ZeRO-1 body: loss/grad per rank, dp-mean via
+        reduce-scatter, chunk update, all-gather (parallel/zero1.py).
+        Lives INSIDE shard_map so the optimizer runs on per-rank
+        chunks; the replicated path keeps its update outside."""
+        l_loc = tokens.shape[1]
+        _check_seq(l_loc * n_sp, cfg)
+        pos = _shard_pos(attn, sp_axis, n_sp, l_loc)
+        n_dp = mesh.shape[dp_axis]
+
+        def local_loss(p):
+            return lm_loss_local(p, tokens, targets, cfg, attn_shard,
+                                 pos, block=block)
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        # sp first: grads must be identical along every non-dp axis
+        # before the dp reduce-scatter
+        grads = jax.tree.map(lambda g: lax.pmean(g, sp_axis), grads)
+        g_chunks = _z1.scatter_mean_grads(grads, dp_axis, n_dp)
+        p_chunks = jax.tree.map(
+            lambda p: _z1.chunk_of_rank(p, dp_axis, n_dp), params)
+        updates, opt_state = optimizer.update(g_chunks, opt_state,
+                                              p_chunks)
+        p_chunks = optax.apply_updates(p_chunks, updates)
+        params = _z1.gather_params(p_chunks, params, dp_axis)
+        return params, opt_state, lax.pmean(
+            lax.pmean(loss, sp_axis), dp_axis)
+
     def step(params, opt_state, tokens, targets):
         # specs derive from the ACTUAL param keys (cannot drift from
         # init_transformer; same pattern as the 3-D step)
@@ -861,6 +907,17 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
         # un-permutation is needed — drop-in for the ring
         tokens, targets, _ = _maybe_zigzag(attn, n_sp, tokens, targets,
                                            pre_permuted=zigzag_layout)
+        if zero1:
+            st_specs = _z1.state_specs(opt_state, dp_axis)
+            # check_vma off: the all_gather'd params ARE replicated
+            # (chunks updated from dp-invariant inputs), but the static
+            # varying-axes checker cannot prove it through all_gather
+            mapped = jax.shard_map(
+                shard_step_zero1, mesh=mesh,
+                in_specs=(P(), st_specs, P(dp_axis, sp_axis),
+                          P(dp_axis, sp_axis)),
+                out_specs=(P(), st_specs, P()), check_vma=False)
+            return mapped(params, opt_state, tokens, targets)
         mapped = jax.shard_map(
             shard_step, mesh=mesh,
             in_specs=(specs, P(dp_axis, sp_axis), P(dp_axis, sp_axis)),

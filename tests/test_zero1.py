@@ -1,0 +1,120 @@
+"""ZeRO-1 sharded optimizer state (parallel/zero1.py +
+make_train_step(zero1=True)): numerically identical to the replicated
+step, with Adam's moments actually living in 1/n_dp shards."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from lua_mapreduce_tpu.models import transformer as tfm
+from lua_mapreduce_tpu.parallel import zero1 as z1
+from lua_mapreduce_tpu.parallel.mesh import make_mesh
+
+N_DP = 4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(dp=N_DP, mp=2, devices=jax.devices("cpu")[:8],
+                     axis_names=("dp", "sp"))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tfm.TransformerConfig.llama_style(
+        vocab=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=48, max_seq=128)
+
+
+def _batch(cfg, b=8, l=32, seed=0):
+    rng = np.random.RandomState(seed)
+    seq = rng.randint(0, cfg.vocab, (b, l + 1))
+    return (jnp.asarray(seq[:, :-1], jnp.int32),
+            jnp.asarray(seq[:, 1:], jnp.int32))
+
+
+def test_zero1_matches_replicated_step(mesh, cfg):
+    """5 Adam steps: the sharded-optimizer path lands on the SAME
+    params and losses as the replicated path (reduce_scatter+update+
+    all_gather ≡ all_reduce+update, up to float associativity)."""
+    toks, tgts = _batch(cfg)
+    td = tfm.shard_batch(mesh, toks, tgts)
+    params = tfm.init_transformer(jax.random.PRNGKey(1), cfg)
+    opt = optax.adam(3e-3)
+
+    p_rep = jax.tree.map(jnp.copy, params)
+    st_rep = opt.init(p_rep)
+    step_rep = tfm.make_train_step(cfg, mesh, opt, attn="ring")
+    p_z = jax.tree.map(jnp.copy, params)
+    st_z = z1.init_state(opt, p_z, mesh, dp_axis="dp")
+    step_z = tfm.make_train_step(cfg, mesh, opt, attn="ring",
+                                 zero1=True)
+    for i in range(5):
+        p_rep, st_rep, l_rep = step_rep(p_rep, st_rep, *td)
+        p_z, st_z, l_z = step_z(p_z, st_z, *td)
+        assert abs(float(l_rep) - float(l_z)) < 1e-5, i
+    for k in p_rep:
+        np.testing.assert_allclose(np.asarray(p_z[k]),
+                                   np.asarray(p_rep[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_state_is_actually_sharded(mesh, cfg):
+    """Adam m/v leaves live in 1/n_dp shards on the dp axis; the step
+    count replicates."""
+    params = tfm.init_transformer(jax.random.PRNGKey(2), cfg)
+    opt = optax.adam(1e-3)
+    st = z1.init_state(opt, params, mesh, dp_axis="dp")
+    leaves = jax.tree.leaves(st)
+    arrays = [x for x in leaves if x.ndim >= 1]
+    scalars = [x for x in leaves if x.ndim == 0]
+    assert arrays and scalars
+    total_param = sum(v.size for v in params.values())
+    for a in arrays:
+        assert a.sharding.spec == P("dp"), a.sharding
+        # each leaf is ONE param's padded flat length
+        shard_rows = a.addressable_shards[0].data.shape[0]
+        assert shard_rows * N_DP == a.shape[0]
+    # total sharded moment storage ≈ param count (padded), per moment:
+    # structural proof of the ÷ n_dp memory claim
+    m_total = sum(a.shape[0] for a in arrays) // 2   # mu and nu
+    assert total_param <= m_total <= total_param + len(params) * N_DP
+
+
+def test_padding_edge_leaf(mesh):
+    """A leaf whose size doesn't divide n_dp pads without corrupting
+    the update (biases of odd length are the common case)."""
+    params = {"w": jnp.arange(10, dtype=jnp.float32)}   # 10 % 4 != 0
+    opt = optax.sgd(0.5)
+    st = z1.init_state(opt, params, mesh, dp_axis="dp")
+
+    def body(p, s, g):
+        gc = z1.scatter_mean_grads(g, "dp", N_DP)
+        pc = jax.tree.map(lambda x: z1.chunk_of_rank(x, "dp", N_DP), p)
+        up, s = opt.update(gc, s, pc)
+        pc = optax.apply_updates(pc, up)
+        return z1.gather_params(pc, p, "dp"), s
+
+    st_specs = z1.state_specs(st, "dp")
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), st_specs, P()),
+        out_specs=(P(), st_specs), check_vma=False))
+    g = {"w": jnp.ones(10, jnp.float32)}
+    p2, _ = fn(params, st, g)
+    np.testing.assert_allclose(np.asarray(p2["w"]),
+                               np.arange(10) - 0.5, rtol=1e-6)
+
+
+def test_zero1_rejections(mesh, cfg):
+    import dataclasses
+    moe = dataclasses.replace(cfg, ffn="gelu", moe_experts=4,
+                              moe_capacity=64)
+    with pytest.raises(ValueError, match="experts"):
+        tfm.make_train_step(moe, mesh, optax.sgd(0.1), zero1=True)
+    with pytest.raises(ValueError, match="grad_accum"):
+        tfm.make_train_step(cfg, mesh, optax.sgd(0.1), zero1=True,
+                            grad_accum=2)
