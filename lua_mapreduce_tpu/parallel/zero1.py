@@ -30,12 +30,19 @@ def _chunk_len(n: int, n_dp: int) -> int:
     return -(-n // n_dp)
 
 
+def _pad_flat(x, n_dp: int):
+    """(flat-padded array, chunk length) — THE chunk layout, shared by
+    every helper so gradient and parameter chunks can never
+    desynchronize."""
+    flat = x.reshape(-1)
+    c = _chunk_len(flat.size, n_dp)
+    return jnp.pad(flat, (0, c * n_dp - flat.size)), c
+
+
 def chunk_of_rank(x, axis: str, n_dp: int):
     """This rank's (chunk,) slice of a replicated array (flatten, pad
     to n_dp chunks, take chunk axis_index)."""
-    flat = x.reshape(-1)
-    c = _chunk_len(flat.size, n_dp)
-    flat = jnp.pad(flat, (0, c * n_dp - flat.size))
+    flat, c = _pad_flat(x, n_dp)
     return lax.dynamic_slice_in_dim(flat, lax.axis_index(axis) * c, c)
 
 
@@ -44,9 +51,7 @@ def scatter_mean_grads(grads, axis: str, n_dp: int):
     each rank receives its chunk of the dp-MEAN gradient. (The grads
     must already be identical along every OTHER mesh axis.)"""
     def one(g):
-        flat = g.reshape(-1)
-        c = _chunk_len(flat.size, n_dp)
-        flat = jnp.pad(flat, (0, c * n_dp - flat.size))
+        flat, c = _pad_flat(g, n_dp)
         return lax.psum_scatter(flat.reshape(n_dp, c), axis,
                                 scatter_dimension=0, tiled=False) / n_dp
     return jax.tree.map(one, grads)
