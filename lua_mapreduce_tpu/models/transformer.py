@@ -826,17 +826,14 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
     all-gather back — same wire traffic as the all-reduce, optimizer
     memory ÷ n_dp. The opt_state must come from
     :func:`parallel.zero1.init_state`. Elementwise optimizers only;
-    dense configs (MoE already spends the dp axis on experts) and
-    grad_accum == 1 for now."""
+    dense configs (MoE already spends the dp axis on experts).
+    Composes with ``grad_accum`` (the microbatch fold feeds the same
+    reduce-scatter) and every ``attn`` schedule."""
     if zigzag_layout and attn != "zigzag":
         raise ValueError("zigzag_layout=True requires attn='zigzag'")
-    if zero1:
-        if cfg.moe_experts:
-            raise ValueError("zero1 shards optimizer state over dp, "
-                             "which MoE already spends on experts")
-        if grad_accum > 1:
-            raise ValueError("zero1 with grad_accum is not composed "
-                             "yet; pick one")
+    if zero1 and cfg.moe_experts:
+        raise ValueError("zero1 shards optimizer state over dp, "
+                         "which MoE already spends on experts")
     _check_arch(cfg)
     n_sp = mesh.shape[sp_axis]
     attn_shard = _attn_shard_fn(attn, sp_axis, n_sp, cfg)
@@ -879,11 +876,19 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
         pos = _shard_pos(attn, sp_axis, n_sp, l_loc)
         n_dp = mesh.shape[dp_axis]
 
-        def local_loss(p):
-            return lm_loss_local(p, tokens, targets, cfg, attn_shard,
+        def local_loss(p, tok, tgt):
+            return lm_loss_local(p, tok, tgt, cfg, attn_shard,
                                  pos, block=block)
 
-        loss, grads = jax.value_and_grad(local_loss)(params)
+        if grad_accum == 1:
+            loss, grads = jax.value_and_grad(local_loss)(
+                params, tokens, targets)
+        else:
+            # the microbatch fold composes: it returns the tile-mean
+            # LOCAL loss/grads, which then ride the same sp-pmean +
+            # dp reduce-scatter as the unaccumulated path
+            loss, grads = accum_value_and_grad(
+                local_loss, params, (tokens, targets), grad_accum)
         # sp first: grads must be identical along every non-dp axis
         # before the dp reduce-scatter
         grads = jax.tree.map(lambda g: lax.pmean(g, sp_axis), grads)

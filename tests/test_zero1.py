@@ -115,6 +115,27 @@ def test_zero1_rejections(mesh, cfg):
                               moe_capacity=64)
     with pytest.raises(ValueError, match="experts"):
         tfm.make_train_step(moe, mesh, optax.sgd(0.1), zero1=True)
-    with pytest.raises(ValueError, match="grad_accum"):
-        tfm.make_train_step(cfg, mesh, optax.sgd(0.1), zero1=True,
-                            grad_accum=2)
+
+
+def test_zero1_composes_with_grad_accum(mesh, cfg):
+    """zero1 + grad_accum: identical numbers to zero1 alone (the
+    microbatch fold feeds the same reduce-scatter)."""
+    toks, tgts = _batch(cfg, b=8, l=32, seed=3)
+    td = tfm.shard_batch(mesh, toks, tgts)
+    params = tfm.init_transformer(jax.random.PRNGKey(4), cfg)
+    opt = optax.adam(3e-3)
+
+    outs = {}
+    for accum in (1, 2):
+        p = jax.tree.map(jnp.copy, params)
+        st = z1.init_state(opt, p, mesh)
+        step = tfm.make_train_step(cfg, mesh, opt, attn="ring",
+                                   zero1=True, grad_accum=accum)
+        for _ in range(3):
+            p, st, loss = step(p, st, *td)
+        outs[accum] = (float(loss), p)
+    assert abs(outs[1][0] - outs[2][0]) < 2e-6
+    for k in outs[1][1]:
+        np.testing.assert_allclose(np.asarray(outs[1][1][k]),
+                                   np.asarray(outs[2][1][k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
