@@ -892,13 +892,8 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
         # sp first: grads must be identical along every non-dp axis
         # before the dp reduce-scatter
         grads = jax.tree.map(lambda g: lax.pmean(g, sp_axis), grads)
-        g_chunks = _z1.scatter_mean_grads(grads, dp_axis, n_dp)
-        p_chunks = jax.tree.map(
-            lambda p: _z1.chunk_of_rank(p, dp_axis, n_dp), params)
-        updates, opt_state = optimizer.update(g_chunks, opt_state,
-                                              p_chunks)
-        p_chunks = optax.apply_updates(p_chunks, updates)
-        params = _z1.gather_params(p_chunks, params, dp_axis)
+        params, opt_state = _z1.update_chunks(
+            optimizer, params, grads, opt_state, dp_axis, n_dp)
         return params, opt_state, lax.pmean(
             lax.pmean(loss, sp_axis), dp_axis)
 

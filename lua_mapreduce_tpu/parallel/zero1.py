@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -55,6 +56,20 @@ def scatter_mean_grads(grads, axis: str, n_dp: int):
         return lax.psum_scatter(flat.reshape(n_dp, c), axis,
                                 scatter_dimension=0, tiled=False) / n_dp
     return jax.tree.map(one, grads)
+
+
+def update_chunks(optimizer, params, grads, opt_state, axis: str,
+                  n_dp: int):
+    """The whole ZeRO-1 update dance, shared by every step body
+    (transformer make_train_step and the DP trainer): reduce-scatter
+    the grads, slice this rank's param chunks, run the optimizer on
+    the chunks, gather updated params. Returns (params, opt_state)."""
+    g_chunks = scatter_mean_grads(grads, axis, n_dp)
+    p_chunks = jax.tree.map(
+        lambda p: chunk_of_rank(p, axis, n_dp), params)
+    updates, opt_state = optimizer.update(g_chunks, opt_state, p_chunks)
+    p_chunks = optax.apply_updates(p_chunks, updates)
+    return gather_params(p_chunks, params, axis), opt_state
 
 
 def gather_params(chunks, templates, axis: str):

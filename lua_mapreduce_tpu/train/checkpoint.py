@@ -33,9 +33,20 @@ def save_pytree(store, name: str, tree: Any) -> None:
     b.build(name)
 
 
-def load_pytree(store, name: str, like: Any) -> Any:
+def load_pytree(store, name: str, like: Any, *,
+                check_shapes: bool = False) -> Any:
     """Load checkpoint ``name``; ``like`` supplies the tree structure
-    (leaf values are ignored)."""
+    AND leaf dtypes: numpy round-trips ml_dtypes leaves (bfloat16 and
+    friends) as raw void arrays ('|V2'), so each loaded leaf is
+    re-viewed as its template leaf's dtype (a zero-copy reinterpret —
+    the bytes are exactly the original values).
+
+    ``check_shapes=True`` additionally pins every leaf's shape to the
+    template's — for loads whose shapes encode the RUN configuration
+    (e.g. ZeRO-1 optimizer chunks depend on the dp size), where a
+    silent mismatch surfaces as a shape error deep inside the next
+    jitted step. Off by default: legitimate callers (sharded dataset
+    loaders) load into variable-shape templates."""
     lines = iter(store.lines(name))
     header = json.loads(next(lines))
     leaves = []
@@ -46,7 +57,21 @@ def load_pytree(store, name: str, like: Any) -> Any:
     if len(leaves) != treedef.num_leaves:
         raise ValueError(f"checkpoint {name!r} has {len(leaves)} leaves, "
                          f"expected {treedef.num_leaves}")
-    return jax.tree.unflatten(treedef, leaves)
+    like_leaves = jax.tree.leaves(like)
+    out = []
+    for i, (leaf, tmpl) in enumerate(zip(leaves, like_leaves)):
+        want = np.dtype(getattr(tmpl, "dtype", np.dtype(type(tmpl))))
+        if leaf.dtype != want and leaf.dtype.kind == "V" \
+                and leaf.dtype.itemsize == want.itemsize:
+            leaf = leaf.view(want)
+        if check_shapes and np.shape(tmpl) != leaf.shape:
+            raise ValueError(
+                f"checkpoint {name!r} leaf {i}: shape {leaf.shape} does "
+                f"not match the template's {np.shape(tmpl)} — was it "
+                "written by a run with a different configuration (e.g. "
+                "a ZeRO-1 checkpoint from a different dp size)?")
+        out.append(leaf)
+    return jax.tree.unflatten(treedef, out)
 
 
 def exists(store, name: str) -> bool:

@@ -155,13 +155,8 @@ class DataParallelTrainer:
                 else:
                     loss, grads = accum_value_and_grad(
                         loss_fn, params, (x, y), accum)
-                g_chunks = _z1.scatter_mean_grads(grads, axis, n_dp)
-                p_chunks = jax.tree.map(
-                    lambda p: _z1.chunk_of_rank(p, axis, n_dp), params)
-                updates, opt_state = optimizer.update(g_chunks, opt_state,
-                                                      p_chunks)
-                p_chunks = optax.apply_updates(p_chunks, updates)
-                params = _z1.gather_params(p_chunks, params, axis)
+                params, opt_state = _z1.update_chunks(
+                    optimizer, params, grads, opt_state, axis, n_dp)
                 return params, opt_state, lax.pmean(loss, axis)
 
             st_specs = _z1.state_specs(opt_state, axis)
@@ -287,7 +282,7 @@ class DataParallelTrainer:
                 and ckpt.exists(checkpoint_store, resume_name):
             loaded_p, loaded_st = ckpt.load_pytree(
                 checkpoint_store, resume_name,
-                (self.params, self.opt_state))
+                (self.params, self.opt_state), check_shapes=True)
             self.params = jax.device_put(
                 loaded_p, NamedSharding(self.mesh, P()))
             if self.config.zero1:

@@ -1,0 +1,138 @@
+"""f32 master weights (train/precision.py): the crisp failure mode it
+fixes — bf16 params freezing when updates round below their ulp — and
+its composition with ZeRO-1 sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from lua_mapreduce_tpu.parallel import zero1 as z1
+from lua_mapreduce_tpu.parallel.mesh import make_mesh
+from lua_mapreduce_tpu.train.precision import with_f32_master
+
+
+def test_small_updates_accumulate_instead_of_vanishing():
+    """A constant update far below bf16's ulp at |p|=1 (~0.0078):
+    naive bf16 SGD leaves the param FROZEN (p + u rounds back to p);
+    the master version accumulates in f32 and the working copy steps
+    once the accumulated change crosses the ulp."""
+    p0 = jnp.ones((4,), jnp.bfloat16)
+    u = 1e-4                      # << bf16 ulp at 1.0
+
+    naive = optax.sgd(1.0)
+    st = naive.init({"w": p0})
+    p = {"w": p0}
+    for _ in range(100):
+        upd, st = naive.update({"w": jnp.full((4,), u, jnp.bfloat16)},
+                               st, p)
+        p = optax.apply_updates(p, upd)
+    assert np.all(np.asarray(p["w"], np.float32) == 1.0), "expected frozen"
+
+    master = with_f32_master(optax.sgd(1.0))
+    st = master.init({"w": p0})
+    p = {"w": p0}
+    for _ in range(100):
+        upd, st = master.update({"w": jnp.full((4,), u, jnp.float32)},
+                                st, p)
+        p = optax.apply_updates(p, upd)
+    moved = np.asarray(p["w"], np.float32)
+    assert np.all(moved < 1.0), moved        # 100 * 1e-4 = 0.01 > ulp
+    # and the MASTER tracked the sum exactly in f32
+    m = np.asarray(st[0]["w"])
+    np.testing.assert_allclose(m, 1.0 - 0.01, rtol=1e-5)
+
+
+def test_f32_params_pass_through_losslessly():
+    """With f32 params the wrapper must match the bare optimizer."""
+    rng = np.random.RandomState(0)
+    p = {"w": jnp.asarray(rng.randn(8), jnp.float32)}
+    g = {"w": jnp.asarray(rng.randn(8), jnp.float32)}
+    bare = optax.adam(1e-2)
+    wrapped = with_f32_master(optax.adam(1e-2))
+    pb, sb = dict(p), bare.init(p)
+    pw, sw = dict(p), wrapped.init(p)
+    for _ in range(5):
+        ub, sb = bare.update(g, sb, pb)
+        pb = optax.apply_updates(pb, ub)
+        uw, sw = wrapped.update(g, sw, pw)
+        pw = optax.apply_updates(pw, uw)
+    np.testing.assert_allclose(np.asarray(pw["w"]), np.asarray(pb["w"]),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_composes_with_zero1_sharded_masters():
+    """Under ZeRO-1 the f32 masters live in the per-rank chunks: the
+    sharded-master training matches a replicated-master run, and the
+    master leaves are genuinely dp-sharded (f32 master cost 4/n_dp
+    bytes per param)."""
+    from lua_mapreduce_tpu.models import transformer as tfm
+
+    mesh = make_mesh(dp=4, mp=2, devices=jax.devices("cpu")[:8],
+                     axis_names=("dp", "sp"))
+    cfg = tfm.TransformerConfig.llama_style(
+        vocab=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=1,
+        d_ff=48, max_seq=64)
+    params32 = tfm.init_transformer(jax.random.PRNGKey(0), cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params32)
+    opt = with_f32_master(optax.adam(3e-3))
+    rng = np.random.RandomState(1)
+    seq = rng.randint(0, 64, (8, 17))
+    td = tfm.shard_batch(mesh, jnp.asarray(seq[:, :-1], jnp.int32),
+                         jnp.asarray(seq[:, 1:], jnp.int32))
+
+    outs = {}
+    for z in (False, True):
+        p = jax.tree.map(jnp.copy, params)
+        st = (z1.init_state(opt, p, mesh) if z else opt.init(p))
+        step = tfm.make_train_step(cfg, mesh, opt, attn="ring", zero1=z)
+        for _ in range(4):
+            p, st, loss = step(p, st, *td)
+        outs[z] = (p, st, float(loss))
+    assert abs(outs[True][2] - outs[False][2]) < 1e-3
+    for k in outs[False][0]:
+        np.testing.assert_allclose(
+            np.asarray(outs[True][0][k], np.float32),
+            np.asarray(outs[False][0][k], np.float32),
+            rtol=2e-2, atol=2e-2, err_msg=k)
+    # master leaves in the zero1 state are f32, chunked, dp-sharded
+    masters = jax.tree.leaves(outs[True][1][0])
+    assert all(m.dtype == jnp.float32 for m in masters)
+    assert all(m.sharding.spec == P("dp") for m in masters)
+
+
+def test_update_requires_params():
+    opt = with_f32_master(optax.sgd(0.1))
+    st = opt.init({"w": jnp.zeros(2)})
+    with pytest.raises(ValueError, match="requires params"):
+        opt.update({"w": jnp.ones(2)}, st)
+
+
+def test_bf16_checkpoint_roundtrip(tmp_path):
+    """bf16 leaves survive save_pytree/load_pytree: numpy round-trips
+    ml_dtypes as raw void arrays, and load re-views them through the
+    template's dtype (code-review r3 — the bf16 training path's
+    checkpoints were unreadable before)."""
+    from lua_mapreduce_tpu.store.router import get_storage_from
+    from lua_mapreduce_tpu.train import checkpoint as ckpt
+
+    store = get_storage_from(f"shared:{tmp_path}")
+    tree = {"w": jnp.asarray(np.random.RandomState(0).randn(4, 3),
+                             jnp.bfloat16),
+            "b": jnp.arange(5, dtype=jnp.float32)}
+    ckpt.save_pytree(store, "mp.ckpt", tree)
+    back = ckpt.load_pytree(store, "mp.ckpt", tree)
+    assert np.dtype(back["w"].dtype) == np.dtype(jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(back["w"], np.float32), np.asarray(tree["w"],
+                                                      np.float32))
+    # shape mismatches fail loudly naming the leaf
+    bad_like = {"w": tree["w"][:2], "b": tree["b"]}
+    with pytest.raises(ValueError, match="leaf 1"):
+        ckpt.load_pytree(store, "mp.ckpt", bad_like, check_shapes=True)
+    # default (sharded dataset loaders need variable-shape templates):
+    # shapes unchecked, dtype restoration still applies
+    loose = ckpt.load_pytree(store, "mp.ckpt", bad_like)
+    assert loose["w"].shape == (4, 3)
