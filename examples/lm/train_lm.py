@@ -49,6 +49,15 @@ def main() -> None:
     ap.add_argument("--kv-heads", type=int, default=0,
                     help="grouped-query attention kv heads "
                          "(0 = n_heads, plain MHA)")
+    ap.add_argument("--modern", action="store_true",
+                    help="llama-style recipe: rope + rmsnorm + swiglu")
+    ap.add_argument("--window", type=int, default=0,
+                    help="sliding-window attention (ring only; the "
+                         "banded ring also truncates its hops)")
+    ap.add_argument("--zero1", action="store_true",
+                    help="shard optimizer state over dp (ZeRO-1)")
+    ap.add_argument("--bf16", action="store_true",
+                    help="bf16 params with f32 master weights")
     ap.add_argument("--ckpt", default=None,
                     help="storage spec for checkpoints, e.g. shared:/tmp/lm")
     ap.add_argument("--ckpt-every", type=int, default=10)
@@ -76,20 +85,33 @@ def main() -> None:
     mesh = Mesh(np.array(devices[:n]).reshape(args.dp, args.sp),
                 ("dp", "sp"))
 
-    cfg = tfm.TransformerConfig(vocab=64, d_model=64, n_heads=4,
-                                n_layers=2, d_ff=128, max_seq=args.seq,
-                                remat=True, n_kv_heads=args.kv_heads)
+    mk = (tfm.TransformerConfig.llama_style if args.modern
+          else tfm.TransformerConfig)
+    cfg = mk(vocab=64, d_model=64, n_heads=4,
+             n_layers=2, d_ff=128, max_seq=args.seq,
+             remat=True, n_kv_heads=args.kv_heads, window=args.window)
+    if args.window and args.attn != "ring":
+        raise SystemExit("--window runs sequence-parallel as the "
+                         "banded ring: use --attn ring")
     params = tfm.init_transformer(jax.random.PRNGKey(0), cfg)
     opt = optax.adam(3e-3)
+    if args.bf16:
+        from lua_mapreduce_tpu.train.precision import with_f32_master
+        params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+        opt = with_f32_master(opt)
     # zigzag batches are pre-permuted HOST-side (shard_batch below), so
     # the steady-state step never pays a cross-shard resharding — the
     # persistent-layout integration (VERDICT r2 item 8)
     zz = args.attn == "zigzag"
     step = tfm.make_train_step(cfg, mesh, opt, attn=args.attn,
                                grad_accum=args.grad_accum,
-                               zigzag_layout=zz)
+                               zigzag_layout=zz, zero1=args.zero1)
     schedule = "zigzag" if zz else "contiguous"
-    opt_state = opt.init(params)
+    if args.zero1:
+        from lua_mapreduce_tpu.parallel import zero1 as z1
+        opt_state = z1.init_state(opt, params, mesh)
+    else:
+        opt_state = opt.init(params)
 
     store = get_storage_from(args.ckpt) if args.ckpt else None
     rng = np.random.RandomState(0)
@@ -105,9 +127,16 @@ def main() -> None:
         if store is not None and i % args.ckpt_every == 0:
             ckpt.save_pytree(store, "lm.ckpt", (params, opt_state))
             print(f"  checkpoint @ step {i}", flush=True)
+    jax.block_until_ready(params)   # CPU backends: don't overlap the
+    #                                   decode program with in-flight
+    #                                   train collectives
     print(f"done: final loss {float(loss):.4f} "
           f"({args.attn} attention, dp={args.dp} sp={args.sp}, "
-          f"grad_accum={args.grad_accum}, remat=on)")
+          f"grad_accum={args.grad_accum}, remat=on"
+          + (", llama-style" if args.modern else "")
+          + (f", window={args.window}" if args.window else "")
+          + (", zero1" if args.zero1 else "")
+          + (", bf16+f32-master" if args.bf16 else "") + ")")
 
     # generate: parallel prompt prefill + KV-cached greedy decode
     prompt = np.arange(8, dtype=np.int32)[None, :] % cfg.vocab
