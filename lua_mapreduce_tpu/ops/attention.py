@@ -553,7 +553,15 @@ def flash_attention(q, k, v, *, causal: bool = False,
     that lets callers combine partial attentions over disjoint KV sets
     (the ring fold's contract). The out is then f32 too (partials must
     round once at the caller's final cast, not per merge step).
-    Differentiable through BOTH outputs."""
+    Differentiable through BOTH outputs.
+
+    Backward-precision note (return_lse path): the out-cotangent
+    arrives f32 but the backward's dp/dv dots run in q.dtype — at bf16
+    the gradients round there, so training grads are slightly less
+    precise than the forward's round-once f32 merge contract. This is
+    the standard MXU tradeoff (bf16 dots are what make the kernel
+    fast); validate grad error vs the XLA oracle at bf16 if a new
+    recipe is sensitive to it."""
     backend = resolve_backend(backend, "flash_attention")
     if window:
         if not causal:

@@ -78,16 +78,21 @@ def _auto_blocks(m: int, n: int, k: int) -> tuple:
     jax.jit,
     static_argnames=("block_m", "block_n", "block_k", "out_dtype",
                      "interpret"))
-def _matmul_pallas(a, b, block_m=None, block_n=None, block_k=None,
+def _matmul_pallas(a, b, block_m: int | None = None,
+                   block_n: int | None = None, block_k: int | None = None,
                    out_dtype=None, interpret=False):
     m, k = a.shape
     k2, n = b.shape
     if k != k2:    # not assert: must survive python -O, else _pad_to
         raise ValueError(f"contracting dims differ: {k} vs {k2}")
+    for nm, v in (("block_m", block_m), ("block_n", block_n),
+                  ("block_k", block_k)):
+        if v is not None and v <= 0:
+            raise ValueError(f"{nm} must be positive, got {v}")
     auto_m, auto_n, auto_k = _auto_blocks(m, n, k)
-    block_m = block_m or auto_m
-    block_n = block_n or auto_n
-    block_k = block_k or auto_k
+    block_m = auto_m if block_m is None else block_m
+    block_n = auto_n if block_n is None else block_n
+    block_k = auto_k if block_k is None else block_k
     out_dtype = out_dtype or jnp.promote_types(a.dtype, b.dtype)
 
     # clamp blocks to the (padded-to-tile) problem, keep MXU/VPU alignment

@@ -19,34 +19,75 @@ import jax
 import numpy as np
 
 
+def _dtype_by_name(name: str) -> np.dtype:
+    """Resolve a dtype name, including ml_dtypes extended types
+    ('bfloat16', 'float8_e4m3fn', ...) that plain numpy can't parse."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            raise ValueError(
+                f"checkpoint records unknown dtype {name!r} — written "
+                "by a newer environment, or a corrupted manifest?")
+
+
+def _leaf_dtype_name(leaf: Any) -> str:
+    # jax/numpy arrays expose .dtype without a device→host copy; python
+    # scalars go through np.result_type (matches what np.asarray+np.save
+    # will write below)
+    d = getattr(leaf, "dtype", None)
+    return str(np.dtype(d) if d is not None else np.result_type(leaf))
+
+
 def save_pytree(store, name: str, tree: Any) -> None:
     """Atomically publish ``tree`` as checkpoint file ``name``."""
     leaves, treedef = jax.tree.flatten(tree)
     b = store.builder()
-    b.write(json.dumps({"v": 1, "n": len(leaves),
+    # v2 manifests record each leaf's dtype NAME: numpy serializes
+    # ml_dtypes leaves (bfloat16 and friends) as raw void arrays, and
+    # without the name a loader can only guess the original dtype by
+    # itemsize — bfloat16 vs float16 would silently reinterpret bits.
+    b.write(json.dumps({"v": 2, "n": len(leaves),
+                        "dtypes": [_leaf_dtype_name(x) for x in leaves],
                         "treedef": str(treedef)}) + "\n")
+    # one leaf materialized at a time: a multi-GB params+opt_state tree
+    # must not double its host RSS during save
     for leaf in leaves:
-        arr = np.asarray(leaf)
         buf = io.BytesIO()
-        np.save(buf, arr, allow_pickle=False)
+        np.save(buf, np.asarray(leaf), allow_pickle=False)
         b.write(base64.b64encode(buf.getvalue()).decode() + "\n")
     b.build(name)
 
 
 def load_pytree(store, name: str, like: Any, *,
-                check_shapes: bool = False) -> Any:
-    """Load checkpoint ``name``; ``like`` supplies the tree structure
-    AND leaf dtypes: numpy round-trips ml_dtypes leaves (bfloat16 and
-    friends) as raw void arrays ('|V2'), so each loaded leaf is
-    re-viewed as its template leaf's dtype (a zero-copy reinterpret —
-    the bytes are exactly the original values).
+                check_shapes: bool = False,
+                check_dtypes: bool = False) -> Any:
+    """Load checkpoint ``name``; ``like`` supplies the tree structure.
 
-    ``check_shapes=True`` additionally pins every leaf's shape to the
-    template's — for loads whose shapes encode the RUN configuration
-    (e.g. ZeRO-1 optimizer chunks depend on the dp size), where a
-    silent mismatch surfaces as a shape error deep inside the next
-    jitted step. Off by default: legitimate callers (sharded dataset
-    loaders) load into variable-shape templates."""
+    Leaves come back FAITHFUL to what was written: v2 manifests record
+    every leaf's dtype name, so ml_dtypes leaves (bfloat16 and friends),
+    which numpy round-trips as raw void arrays ('|V2'), are re-viewed as
+    their WRITTEN dtype — a zero-copy reinterpret back to the original
+    values, independent of the template's dtype. (Legacy v1 files lack
+    the record; their void leaves fall back to an itemsize-matched view
+    through the template's dtype.)
+
+    ``check_dtypes=True`` additionally pins every leaf's dtype to the
+    template's — for resume paths where a dtype drift (a bf16
+    checkpoint resumed into an f32-master run, or vice versa) should
+    fail loudly instead of surfacing as a jit dtype error later.
+    Casting is the caller's explicit job (load faithfully, then
+    ``jax.tree.map(lambda x: x.astype(...))``).
+
+    ``check_shapes=True`` pins every leaf's shape to the template's —
+    for loads whose shapes encode the RUN configuration (e.g. ZeRO-1
+    optimizer chunks depend on the dp size), where a silent mismatch
+    surfaces as a shape error deep inside the next jitted step. Both
+    checks off by default: legitimate callers (sharded dataset loaders)
+    load into variable-shape, dtype-agnostic templates."""
     lines = iter(store.lines(name))
     header = json.loads(next(lines))
     leaves = []
@@ -58,12 +99,32 @@ def load_pytree(store, name: str, like: Any, *,
         raise ValueError(f"checkpoint {name!r} has {len(leaves)} leaves, "
                          f"expected {treedef.num_leaves}")
     like_leaves = jax.tree.leaves(like)
+    recorded = header.get("dtypes")   # v2+; absent in legacy v1 files
+    if recorded is not None and len(recorded) != len(leaves):
+        raise ValueError(
+            f"checkpoint {name!r}: manifest records {len(recorded)} "
+            f"dtypes for {len(leaves)} leaves — truncated or corrupted "
+            "manifest")
     out = []
     for i, (leaf, tmpl) in enumerate(zip(leaves, like_leaves)):
         want = np.dtype(getattr(tmpl, "dtype", np.dtype(type(tmpl))))
-        if leaf.dtype != want and leaf.dtype.kind == "V" \
+        if recorded is not None and leaf.dtype.kind == "V":
+            # faithful restore: view as the WRITTEN dtype (correct
+            # values), never a template-guided reinterpret
+            leaf = leaf.view(_dtype_by_name(recorded[i]))
+        elif recorded is None and leaf.dtype != want \
+                and leaf.dtype.kind == "V" \
                 and leaf.dtype.itemsize == want.itemsize:
+            # legacy v1 manifest: best-effort itemsize reinterpret
             leaf = leaf.view(want)
+        if check_dtypes and leaf.dtype != want:
+            wrote = (recorded[i] if recorded is not None else
+                     f"{leaf.dtype} (v1 file: dtype name unrecorded; a "
+                     "void leaf is an ml_dtypes array of that itemsize)")
+            raise ValueError(
+                f"checkpoint {name!r} leaf {i} was written as "
+                f"{wrote} but the template expects {want} — "
+                "load with a matching template and cast explicitly")
         if check_shapes and np.shape(tmpl) != leaf.shape:
             raise ValueError(
                 f"checkpoint {name!r} leaf {i}: shape {leaf.shape} does "

@@ -282,7 +282,8 @@ class DataParallelTrainer:
                 and ckpt.exists(checkpoint_store, resume_name):
             loaded_p, loaded_st = ckpt.load_pytree(
                 checkpoint_store, resume_name,
-                (self.params, self.opt_state), check_shapes=True)
+                (self.params, self.opt_state), check_shapes=True,
+                check_dtypes=True)
             self.params = jax.device_put(
                 loaded_p, NamedSharding(self.mesh, P()))
             if self.config.zero1:

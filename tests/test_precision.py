@@ -136,3 +136,50 @@ def test_bf16_checkpoint_roundtrip(tmp_path):
     # shapes unchecked, dtype restoration still applies
     loose = ckpt.load_pytree(store, "mp.ckpt", bad_like)
     assert loose["w"].shape == (4, 3)
+
+
+def test_checkpoint_dtype_manifest_guards_reinterpret(tmp_path):
+    """v2 manifests record leaf dtype names, so void (ml_dtypes) leaves
+    restore FAITHFULLY to their written dtype — a bfloat16 checkpoint
+    loaded through a float16 template comes back as correct bfloat16
+    values, never bit-reinterpreted garbage (advisor r3). Resume paths
+    pin dtypes with check_dtypes=True and get a loud error instead."""
+    import json as _json
+
+    from lua_mapreduce_tpu.store.router import get_storage_from
+    from lua_mapreduce_tpu.train import checkpoint as ckpt
+
+    store = get_storage_from(f"shared:{tmp_path}")
+    vals = np.linspace(-2.0, 2.0, 12).reshape(4, 3)
+    tree = {"w": jnp.asarray(vals, jnp.bfloat16)}
+    ckpt.save_pytree(store, "d.ckpt", tree)
+    # faithful restore regardless of the template's (wrong) dtype
+    back = ckpt.load_pytree(store, "d.ckpt",
+                            {"w": jnp.ones((4, 3), jnp.float16)})
+    assert np.dtype(back["w"].dtype) == np.dtype(jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(back["w"], np.float32),
+                                  np.asarray(tree["w"], np.float32))
+    # resume-style loads pin dtypes loudly, in BOTH directions
+    with pytest.raises(ValueError, match="written as bfloat16"):
+        ckpt.load_pytree(store, "d.ckpt",
+                         {"w": jnp.ones((4, 3), jnp.float16)},
+                         check_dtypes=True)
+    ckpt.save_pytree(store, "f16.ckpt", {"w": jnp.ones((4, 3),
+                                                       jnp.float16)})
+    with pytest.raises(ValueError, match="written as float16"):
+        ckpt.load_pytree(store, "f16.ckpt",
+                         {"w": jnp.ones((4, 3), jnp.bfloat16)},
+                         check_dtypes=True)
+    # legacy v1 files (no dtype record) keep the itemsize-view
+    # fallback: strip "dtypes" from the manifest and reload
+    lines = list(store.lines("d.ckpt"))
+    hdr = _json.loads(lines[0])
+    del hdr["dtypes"]
+    hdr["v"] = 1
+    b = store.builder()
+    b.write(_json.dumps(hdr) + "\n")
+    for ln in lines[1:]:
+        b.write(ln if ln.endswith("\n") else ln + "\n")
+    b.build("legacy.ckpt")
+    back = ckpt.load_pytree(store, "legacy.ckpt", tree)
+    assert np.dtype(back["w"].dtype) == np.dtype(jnp.bfloat16)
