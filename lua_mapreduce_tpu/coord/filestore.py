@@ -317,6 +317,10 @@ class FileJobStore(JobStore):
     def requeue_stale(self, ns, older_than_s):
         return self._idx(ns).requeue_stale(time.time() - older_than_s)
 
+    def heartbeat(self, ns, job_id, worker):
+        return self._idx(ns).heartbeat(job_id, worker_hash(worker),
+                                       time.time())
+
     def drop_ns(self, ns):
         self._batches.pop(ns, None)
         for stale in (f"{ns}.idx", f"{ns}.gen"):

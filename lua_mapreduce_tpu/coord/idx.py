@@ -51,6 +51,9 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.jsx_scavenge.argtypes = [ctypes.c_char_p, ctypes.c_int32]
     lib.jsx_requeue_stale.restype = ctypes.c_int64
     lib.jsx_requeue_stale.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.jsx_heartbeat.restype = ctypes.c_int
+    lib.jsx_heartbeat.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.c_int64, ctypes.c_double]
     lib.jsx_snapshot.restype = ctypes.c_int64
     lib.jsx_snapshot.argtypes = [ctypes.c_char_p,
                                  ctypes.POINTER(ctypes.c_int32),
@@ -131,6 +134,12 @@ class NativeJobIndex:
         if r < 0:
             raise OSError(f"jsx_requeue_stale failed on {self.path}")
         return r
+
+    def heartbeat(self, job_id: int, worker: int, now: float) -> bool:
+        r = self._lib.jsx_heartbeat(self._p, job_id, worker, now)
+        if r < 0:
+            raise OSError(f"jsx_heartbeat failed on {self.path}")
+        return bool(r)
 
     def snapshot(self):
         cap = self.count()

@@ -12,7 +12,9 @@ The on-disk format is shared byte-for-byte with the native C++ engine
 
 Layout (little-endian):
     header:  8s magic "JSIX0001" | q record count
-    record:  i status | i repetitions | q worker-hash | d started_time | d reserved
+    record:  i status | i repetitions | q worker-hash | d started_time | d heartbeat
+(``heartbeat`` was the reserved field; 0.0 = never beaten — old files
+read compatibly.)
 """
 
 from __future__ import annotations
@@ -193,20 +195,42 @@ class PyJobIndex:
             os.close(fd)
 
     def requeue_stale(self, cutoff: float) -> int:
-        """RUNNING|FINISHED records started before ``cutoff`` → BROKEN
-        (+1 rep). FINISHED is included so a worker killed between its
-        FINISHED and WRITTEN transitions cannot wedge the barrier."""
+        """RUNNING|FINISHED records whose last liveness signal (claim
+        time or worker heartbeat) predates ``cutoff`` → BROKEN (+1 rep).
+        FINISHED is included so a worker killed between its FINISHED and
+        WRITTEN transitions cannot wedge the barrier; a heartbeating
+        worker's long job is never requeued."""
         if not os.path.exists(self.path):
             return 0
         fd = self._open_locked()
         try:
             n = 0
             for jid in range(self._read_count(fd)):
-                status, reps, w, st, rv = self._read_rec(fd, jid)
-                if status in (Status.RUNNING, Status.FINISHED) and st < cutoff:
-                    self._write_rec(fd, jid, Status.BROKEN, reps + 1, w, st, rv)
+                status, reps, w, st, hb = self._read_rec(fd, jid)
+                if (status in (Status.RUNNING, Status.FINISHED) and
+                        max(st, hb) < cutoff):
+                    self._write_rec(fd, jid, Status.BROKEN, reps + 1, w, st, hb)
                     n += 1
             return n
+        finally:
+            os.close(fd)
+
+    def heartbeat(self, job_id: int, worker: int, now: float) -> bool:
+        """Refresh a RUNNING|FINISHED record's liveness timestamp iff
+        ``worker`` still owns the claim (0 skips the ownership check)."""
+        if not os.path.exists(self.path):
+            return False
+        fd = self._open_locked()
+        try:
+            if not (0 <= job_id < self._read_count(fd)):
+                return False
+            status, reps, w, st, _ = self._read_rec(fd, job_id)
+            if status not in (Status.RUNNING, Status.FINISHED):
+                return False
+            if worker and w != worker:
+                return False
+            self._write_rec(fd, job_id, status, reps, w, st, now)
+            return True
         finally:
             os.close(fd)
 

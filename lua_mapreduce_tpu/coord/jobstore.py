@@ -112,13 +112,26 @@ class JobStore(abc.ABC):
 
     @abc.abstractmethod
     def requeue_stale(self, ns: str, older_than_s: float) -> int:
-        """RUNNING or FINISHED jobs started more than ``older_than_s`` ago
-        → BROKEN (re-claimable). Covers hard-killed workers that never mark
-        their job broken — including a kill between the FINISHED and
-        WRITTEN transitions — a gap the reference leaves open (its recovery
-        relies on the worker's own xpcall handler, worker.lua:116-131).
-        ``older_than_s`` must exceed the longest expected job duration.
-        Returns count."""
+        """RUNNING or FINISHED jobs SILENT for more than ``older_than_s``
+        → BROKEN (re-claimable). Silence is measured from the job's last
+        liveness signal — its claim time or its worker's last
+        :meth:`heartbeat` — so a legitimately long job whose worker keeps
+        beating is never requeued mid-run. Beats stop when the job body
+        returns, so ``older_than_s`` must exceed the heartbeat interval
+        PLUS the worst-case finish/publish time (the FINISHED→WRITTEN
+        window) — but not the longest job. Covers hard-killed
+        workers that never mark their job broken — including a kill
+        between the FINISHED and WRITTEN transitions — a gap the
+        reference leaves open (its recovery relies on the worker's own
+        xpcall handler, worker.lua:116-131). Returns count."""
+
+    def heartbeat(self, ns: str, job_id: int, worker: str) -> bool:
+        """Refresh the liveness timestamp of a RUNNING|FINISHED job this
+        worker owns, so :meth:`requeue_stale` measures silence instead of
+        elapsed time. Returns False when the claim is lost (requeued and
+        re-claimed), the job is in another state, or the store does not
+        track liveness (this default)."""
+        return False
 
     @abc.abstractmethod
     def drop_ns(self, ns: str) -> None: ...
@@ -187,7 +200,8 @@ class MemJobStore(JobStore):
             for i, doc in enumerate(docs):
                 d = dict(doc)
                 d.update(_id=base + i, status=Status.WAITING, repetitions=0,
-                         worker=None, started_time=None, times=None)
+                         worker=None, started_time=None, hb_time=None,
+                         times=None)
                 queue.append(d)
                 ids.append(base + i)
             return ids
@@ -201,6 +215,7 @@ class MemJobStore(JobStore):
                     d["status"] = Status.RUNNING
                     d["worker"] = worker
                     d["started_time"] = time.time()
+                    d["hb_time"] = None   # fresh claim, fresh silence clock
                     return dict(d)
                 return None
 
@@ -269,13 +284,25 @@ class MemJobStore(JobStore):
             n = 0
             cutoff = time.time() - older_than_s
             for d in self._jobs.get(ns, []):
+                live = max(d["started_time"] or 0.0, d.get("hb_time") or 0.0)
                 if (d["status"] in (Status.RUNNING, Status.FINISHED) and
-                        d["started_time"] is not None and
-                        d["started_time"] < cutoff):
+                        d["started_time"] is not None and live < cutoff):
                     d["status"] = Status.BROKEN
                     d["repetitions"] += 1
                     n += 1
             return n
+
+    def heartbeat(self, ns, job_id, worker):
+        with self._lock:
+            queue = self._jobs.get(ns, [])
+            if not (0 <= job_id < len(queue)):
+                return False
+            d = queue[job_id]
+            if d["status"] not in (Status.RUNNING, Status.FINISHED) \
+                    or d["worker"] != worker:
+                return False
+            d["hb_time"] = time.time()
+            return True
 
     def drop_ns(self, ns):
         with self._lock:

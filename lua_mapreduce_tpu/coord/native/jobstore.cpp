@@ -15,7 +15,8 @@
 // Layout (little-endian, matching idx_py.py):
 //   header: char magic[8] = "JSIX0001"; int64 count;
 //   record: int32 status; int32 repetitions; int64 worker; double started;
-//           double reserved;   // 32 bytes
+//           double reserved;   // 32 bytes; reserved = last heartbeat
+//                              // time (0.0 = never beaten)
 
 #include <cstdint>
 #include <cstring>
@@ -152,6 +153,7 @@ int64_t jsx_claim(const char* path, int64_t worker, const int64_t* preferred,
     rec.status = kRunning;
     rec.worker = worker;
     rec.started = now_seconds();
+    rec.reserved = 0.0;  // fresh claim, fresh silence clock (= idx_py)
     return idx.write(id, rec);
   };
 
@@ -219,9 +221,12 @@ int64_t jsx_counts(const char* path, int64_t* out6) {
   return count;
 }
 
-// RUNNING|FINISHED records with started < cutoff → BROKEN (+1 repetition).
-// Covers hard-killed workers, including a kill between the FINISHED and
-// WRITTEN transitions (no analog in the reference; see jobstore.py).
+// RUNNING|FINISHED records whose last liveness signal — claim time or
+// worker heartbeat (record.reserved, see jsx_heartbeat) — predates cutoff
+// → BROKEN (+1 repetition). Covers hard-killed workers, including a kill
+// between the FINISHED and WRITTEN transitions (no analog in the
+// reference; see jobstore.py). A legitimately long job whose worker keeps
+// heartbeating is never requeued, however long it runs.
 int64_t jsx_requeue_stale(const char* path, double cutoff) {
   if (access(path, F_OK) != 0) return 0;
   LockedIndex idx(path, false);
@@ -231,8 +236,10 @@ int64_t jsx_requeue_stale(const char* path, double cutoff) {
   Record rec;
   for (int64_t id = 0; id < count; ++id) {
     if (!idx.read(id, &rec)) return -1;
+    const double live =
+        rec.reserved > rec.started ? rec.reserved : rec.started;
     if ((rec.status == kRunning || rec.status == kFinished) &&
-        rec.started < cutoff) {
+        live < cutoff) {
       rec.status = kBroken;
       rec.repetitions += 1;
       if (!idx.write(id, rec)) return -1;
@@ -240,6 +247,24 @@ int64_t jsx_requeue_stale(const char* path, double cutoff) {
     }
   }
   return n;
+}
+
+// Refresh the liveness timestamp (record.reserved) of a RUNNING|FINISHED
+// record, iff `worker` still owns the claim (0 = skip the ownership
+// check). Returns 1 on success, 0 on mismatch/bounds/missing, -1 on
+// error. The worker runtime beats this during long map/reduce jobs so
+// the server's stale-requeue measures silence, not elapsed time.
+int jsx_heartbeat(const char* path, int64_t id, int64_t worker, double now) {
+  if (access(path, F_OK) != 0) return 0;  // namespace dropped: miss
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  if (id < 0 || id >= idx.count()) return 0;
+  Record rec;
+  if (!idx.read(id, &rec)) return -1;
+  if (rec.status != kRunning && rec.status != kFinished) return 0;
+  if (worker != 0 && rec.worker != worker) return 0;
+  rec.reserved = now;
+  return idx.write(id, rec) ? 1 : -1;
 }
 
 // Bulk snapshot: fill caller arrays (capacity cap) with every record's

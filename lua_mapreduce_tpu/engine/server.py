@@ -57,7 +57,10 @@ class Server:
     """Orchestrate one task over an elastic worker pool.
 
     ``stale_timeout_s`` (None disables) requeues RUNNING jobs whose worker
-    silently died — see JobStore.requeue_stale.
+    went SILENT — no claim or heartbeat within the window (workers beat
+    their running job every ``Worker.heartbeat_s``, default 60 s, so the
+    timeout bounds silence, not job duration; a legitimately long job is
+    never requeued from under a live worker) — see JobStore.requeue_stale.
 
     ``strict`` raises :class:`PhaseFailed` the moment a phase ends with
     FAILED jobs instead of feeding finalfn partial results (the default

@@ -11,8 +11,10 @@ processes/hosts over a FileJobStore).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import threading
 import time
 import traceback
 import uuid
@@ -29,7 +31,8 @@ from lua_mapreduce_tpu.store.router import get_storage_from
 MAP_NS = "map_jobs"
 RED_NS = "red_jobs"
 
-_CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases")
+_CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases",
+                "heartbeat_s")
 
 
 class Worker:
@@ -53,6 +56,11 @@ class Worker:
         # hosts, fs.lua:143-160); default runs everything like the
         # reference's workers
         self.phases = ("map", "reduce")
+        # liveness beat while a job runs, so the server's stale-requeue
+        # measures SILENCE instead of elapsed time — a legitimately long
+        # map/reduce is never requeued out from under a live worker.
+        # None/0 disables (staleness falls back to elapsed-since-claim).
+        self.heartbeat_s = 60.0
         self._spec_cache: Dict[str, TaskSpec] = {}
         self._affinity: list = []       # map-job ids this worker ran before
         self._idle_count = 0
@@ -110,11 +118,41 @@ class Worker:
 
     # -- job execution ------------------------------------------------------
 
+    @contextlib.contextmanager
+    def _beating(self, ns: str, jid: int):
+        """Heartbeat the claimed job every ``heartbeat_s`` seconds from a
+        daemon thread while the (blocking, user-code) job body runs. Best
+        effort: a failed beat is ignored — the CAS ownership checks keep
+        correctness; the beat only prevents WASTEFUL requeues of live
+        long jobs."""
+        if not self.heartbeat_s:
+            yield
+            return
+        stop = threading.Event()
+
+        def beat():
+            while not stop.wait(self.heartbeat_s):
+                try:
+                    self.store.heartbeat(ns, jid, self.name)
+                except Exception:
+                    pass
+
+        t = threading.Thread(target=beat, daemon=True,
+                             name=f"{self.name}-hb-{ns}-{jid}")
+        t.start()
+        try:
+            yield
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
     def _execute_map(self, spec: TaskSpec, job: dict) -> None:
         ns, jid = MAP_NS, job["_id"]
         try:
             store = get_storage_from(spec.storage)
-            times = run_map_job(spec, store, str(jid), job["key"], job["value"])
+            with self._beating(ns, jid):
+                times = run_map_job(spec, store, str(jid), job["key"],
+                                    job["value"])
             if self._finish(ns, jid, times):
                 if jid not in self._affinity:
                     self._affinity.append(jid)
@@ -147,8 +185,10 @@ class Worker:
                     f"visible in storage (producers: "
                     f"{v.get('mappers') or 'unknown'}): {missing[:3]} — "
                     "cross-host pools need a backend every host can reach")
-            times = run_reduce_job(spec, store, result_store, str(v["part"]),
-                                   v["files"], v["result"])
+            with self._beating(ns, jid):
+                times = run_reduce_job(spec, store, result_store,
+                                       str(v["part"]), v["files"],
+                                       v["result"])
             if self._finish(ns, jid, times):
                 self.jobs_executed += 1
                 self._log(f"reduce job {jid} done ({times.real:.3f}s)")

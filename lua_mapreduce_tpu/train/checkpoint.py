@@ -108,9 +108,13 @@ def load_pytree(store, name: str, like: Any, *,
     out = []
     for i, (leaf, tmpl) in enumerate(zip(leaves, like_leaves)):
         want = np.dtype(getattr(tmpl, "dtype", np.dtype(type(tmpl))))
-        if recorded is not None and leaf.dtype.kind == "V":
-            # faithful restore: view as the WRITTEN dtype (correct
-            # values), never a template-guided reinterpret
+        if recorded is not None and leaf.dtype.kind == "V" \
+                and leaf.dtype.names is None:
+            # faithful restore: a PLAIN void leaf is an ml_dtypes array
+            # numpy couldn't name — view as the WRITTEN dtype (correct
+            # values), never a template-guided reinterpret. Structured
+            # dtypes (also kind 'V', but with .names) round-trip through
+            # np.load exactly and need no view.
             leaf = leaf.view(_dtype_by_name(recorded[i]))
         elif recorded is None and leaf.dtype != want \
                 and leaf.dtype.kind == "V" \

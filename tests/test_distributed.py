@@ -444,6 +444,57 @@ def test_server_resume_mid_map_keeps_written_jobs(tmp_path):
     assert read_count(count_file) == len(CORPUS)
 
 
+def test_long_job_heartbeat_prevents_wasteful_requeue(monkeypatch):
+    """A job legitimately running 3× the server's stale timeout completes
+    WITHOUT being requeued while its worker heartbeats; with heartbeats
+    disabled the same job IS requeued (the control proving the test
+    bites). VERDICT r3 item 8: staleness = silence, not elapsed time."""
+    import examples.wordcount.finalfn as finalfn
+    import examples.wordcount.mapfn as mapmod
+
+    files = CORPUS[:2]
+    golden = naive_wordcount(files)
+    orig_mapfn = mapmod.mapfn
+
+    def run(heartbeat_s):
+        slow_used = []
+
+        def slow(k, v, emit):
+            if not slow_used:                 # exactly one long map job
+                slow_used.append(1)
+                time.sleep(1.5)               # 3× the 0.5 s stale timeout
+            return orig_mapfn(k, v, emit)
+
+        monkeypatch.setattr(mapmod, "mapfn", slow)
+        store = MemJobStore()
+        requeues = []
+        orig_rq = store.requeue_stale
+
+        def counting_rq(ns, older_than_s):
+            n = orig_rq(ns, older_than_s)
+            if n:
+                requeues.append((ns, n))
+            return n
+
+        monkeypatch.setattr(store, "requeue_stale", counting_rq)
+        server = Server(store, poll_interval=0.05,
+                        stale_timeout_s=0.5).configure(
+            _spec("mem:dist-hb", init_args={"files": files}))
+        worker = Worker(store).configure(max_iter=400, max_sleep=0.05,
+                                         heartbeat_s=heartbeat_s)
+        t = threading.Thread(target=worker.execute, daemon=True)
+        t.start()
+        stats = server.loop()
+        t.join(timeout=30)
+        assert dict(finalfn.counts) == golden
+        it = stats.iterations[-1]
+        assert it.map.count == len(files) and it.map.failed == 0
+        return sum(n for _, n in requeues)
+
+    assert run(heartbeat_s=0.1) == 0      # beating: never requeued
+    assert run(heartbeat_s=None) >= 1     # silent: stale-requeued (control)
+
+
 def test_server_rejects_unreachable_storage(tmp_path):
     """Regression: bare 'mem' (private per process) and mem:tag over a
     multi-process FileJobStore would silently produce empty results."""

@@ -2,6 +2,7 @@
 native/Python index interop (analog of task.lua + cnn.lua utests)."""
 
 import threading
+import time
 
 import pytest
 
@@ -84,6 +85,45 @@ def test_requeue_stale_covers_finished(tmp_path, idx):
     store.set_job_status("ns", 0, Status.FINISHED, expect=(Status.RUNNING,))
     assert store.requeue_stale("ns", older_than_s=0.0) == 1
     assert store.get_job("ns", 0)["status"] == Status.BROKEN
+
+
+@pytest.mark.parametrize("idx", [0, 1, 2], ids=["mem", "file-py", "file-auto"])
+def test_heartbeat_keeps_long_job_alive(tmp_path, idx):
+    """Staleness measures SILENCE, not elapsed time: a RUNNING job whose
+    worker heartbeats is spared by requeue_stale however old its claim
+    is, while a silent sibling is requeued (VERDICT r3 item 8)."""
+    store = _stores(tmp_path)[idx]
+    store.insert_jobs("ns", [make_job(0, "slow"), make_job(1, "dead")])
+    store.claim("ns", "live-worker")        # job 0
+    store.claim("ns", "dead-worker")        # job 1
+    time.sleep(0.3)
+    assert store.heartbeat("ns", 0, "live-worker")
+    # cutoff 0.2s ago: both claims are 0.3s old, but job 0 beat just now
+    assert store.requeue_stale("ns", older_than_s=0.2) == 1
+    assert store.get_job("ns", 0)["status"] == Status.RUNNING
+    assert store.get_job("ns", 1)["status"] == Status.BROKEN
+    # once the beats stop, job 0 goes stale like anything else
+    time.sleep(0.3)
+    assert store.requeue_stale("ns", older_than_s=0.2) == 1
+    assert store.get_job("ns", 0)["status"] == Status.BROKEN
+
+
+@pytest.mark.parametrize("idx", [0, 1, 2], ids=["mem", "file-py", "file-auto"])
+def test_heartbeat_ownership_and_state(tmp_path, idx):
+    """Heartbeats are ownership-CASed like every other transition: a
+    stale claimant cannot keep a re-claimed job alive, and only
+    RUNNING|FINISHED jobs (the requeueable states) accept beats."""
+    store = _stores(tmp_path)[idx]
+    store.insert_jobs("ns", [make_job(0, "x"), make_job(1, "y")])
+    store.claim("ns", "w1")                       # job 0
+    assert not store.heartbeat("ns", 0, "w2")     # non-owner misses
+    assert not store.heartbeat("ns", 1, "w1")     # WAITING: no beat
+    assert not store.heartbeat("ns", 99, "w1")    # out of bounds
+    # FINISHED still beats (covers the FINISHED→WRITTEN kill gap)
+    store.set_job_status("ns", 0, Status.FINISHED, expect=(Status.RUNNING,))
+    assert store.heartbeat("ns", 0, "w1")
+    store.set_job_status("ns", 0, Status.WRITTEN, expect=(Status.FINISHED,))
+    assert not store.heartbeat("ns", 0, "w1")     # WRITTEN: done
 
 
 @pytest.mark.parametrize("idx", [0, 1, 2], ids=["mem", "file-py", "file-auto"])

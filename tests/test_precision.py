@@ -183,3 +183,11 @@ def test_checkpoint_dtype_manifest_guards_reinterpret(tmp_path):
     b.build("legacy.ckpt")
     back = ckpt.load_pytree(store, "legacy.ckpt", tree)
     assert np.dtype(back["w"].dtype) == np.dtype(jnp.bfloat16)
+    # structured dtypes are ALSO kind 'V' but round-trip through np.load
+    # exactly — the faithful-restore view must not touch them
+    rec = np.zeros(3, dtype=[("a", "<i4"), ("b", "<f8")])
+    rec["a"] = [1, 2, 3]
+    ckpt.save_pytree(store, "s.ckpt", {"x": rec})
+    sback = ckpt.load_pytree(store, "s.ckpt", {"x": rec}, check_dtypes=True)
+    assert sback["x"].dtype == rec.dtype
+    np.testing.assert_array_equal(sback["x"]["a"], rec["a"])
