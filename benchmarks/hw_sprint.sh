@@ -1,0 +1,50 @@
+#!/bin/bash
+# Hardware-evidence sprint (VERDICT r3 item 1): regenerate every TPU
+# artifact while the tunnel is open. The chip is single-tenant, so the
+# runs are strictly sequential; each one logs to /tmp. A step's artifact
+# under benchmarks/results/ is only replaced when the run produced a
+# valid non-skip JSON line — a failed or off-TPU run must never clobber
+# a previously committed good artifact.
+set -u
+cd "$(dirname "$0")/.."
+
+keep_json () {  # keep_json <src-log> <dest>: install last line iff real JSON
+  python - "$1" "$2" <<'PY'
+import json, sys
+src, dest = sys.argv[1], sys.argv[2]
+try:
+    line = open(src).read().strip().rsplit("\n", 1)[-1]
+    d = json.loads(line)
+except Exception as e:
+    sys.exit(f"{src}: no JSON tail ({e}); keeping existing {dest}")
+if not d or "skipped" in d:
+    sys.exit(f"{src}: run skipped; keeping existing {dest}")
+with open(dest + ".tmp", "w") as f:
+    f.write(line + "\n")
+import os; os.replace(dest + ".tmp", dest)
+print(f"installed {dest}")
+PY
+}
+
+WAIT_PID="${1:-}"
+if [ -n "$WAIT_PID" ]; then
+  echo "waiting for pid $WAIT_PID (kernel_bench) ..."
+  while kill -0 "$WAIT_PID" 2>/dev/null; do sleep 10; done
+fi
+
+echo "=== matmul_tune $(date -u +%H:%M:%S) ==="
+python benchmarks/matmul_tune.py > /tmp/matmul_tune_r4.log 2>/tmp/matmul_tune_r4.err
+keep_json /tmp/matmul_tune_r4.log benchmarks/results/matmul_tune.json
+
+echo "=== flash_tune $(date -u +%H:%M:%S) ==="
+python benchmarks/flash_tune.py > /tmp/flash_tune_r4.log 2>/tmp/flash_tune_r4.err
+keep_json /tmp/flash_tune_r4.log benchmarks/results/flash_tune.json
+
+echo "=== attn_memory (TPU buffer assignment) $(date -u +%H:%M:%S) ==="
+python benchmarks/attn_memory.py > /tmp/attn_mem_tpu_r4.log 2>&1
+
+echo "=== bench.py $(date -u +%H:%M:%S) ==="
+python bench.py > /tmp/bench_r4.log 2>/tmp/bench_r4.err
+keep_json /tmp/bench_r4.log /tmp/bench_r4.json
+
+echo "=== sprint done $(date -u +%H:%M:%S) ==="

@@ -28,13 +28,15 @@ import jax
 # docs/DESIGN.md §8; the size-adaptive 512² schedule staged there flips
 # this entry only when a sweep-validated artifact shows ≥0.9× XLA; the
 # Pallas pooling kernel beats XLA's reduce_window ~2.7×. Flash resolves
-# to Pallas on memory grounds: the XLA composition materializes the
-# (L, L) f32 score matrix in HBM (1 GB at L=4096, h=8, b=2) in BOTH
-# directions, while the fused kernel pair (forward + FlashAttention-2
-# backward re-materializing p from the saved logsumexp) never does —
-# head-to-head speed entries (flash_* and flash_grad_* in kernels.json)
-# are pending a clean real-chip run. Softmax is a wash; XLA wins on
-# fusion-with-neighbors grounds.
+# to Pallas on memory grounds, now measured (benchmarks/attn_memory.py →
+# results/attn_memory.json, DESIGN.md §9): the XLA composition's compiled
+# buffer assignment holds ~4 L²-sized temps across fwd+bwd — 4.13 GiB at
+# (b=2, h=8, L=4096, d=128) vs the fused kernel pair's 0.172 GiB of O(L)
+# residents (24×; 59× by L=8192) — while the Pallas pair (forward +
+# FlashAttention-2 backward re-materializing p from the saved logsumexp)
+# never materializes O(L²). Head-to-head speed entries (flash_* and
+# flash_grad_* in kernels.json) complete the picture on real-chip runs.
+# Softmax is a wash; XLA wins on fusion-with-neighbors grounds.
 _TPU_AUTO_POLICY = {
     "matmul": "xla",
     "conv2d": "xla",

@@ -19,6 +19,7 @@ def test_bench_conv_train_lenet_smoke():
     assert "lenet5_cifar" in out["config"]
 
 
+@pytest.mark.heavy
 def test_bench_decode_smoke():
     """bench_decode at toy scale on CPU: sane numbers, prefill path
     actually faster-or-equal is NOT asserted (CPU timings are noise) —
@@ -64,3 +65,26 @@ def test_bench_pair_speedup_from_unrounded_seconds(monkeypatch):
     assert out["speedup_pallas_vs_xla"] == 1.0
     # 64 bytes in 20 ns = 3.2 GB/s > 1.1 * 1 GB/s → flagged on both
     assert out["pallas_suspect_elided"] and out["xla_suspect_elided"]
+
+
+def test_attn_memory_measures_the_l2_term():
+    """The compiler-reported temp bytes for the XLA attention grad must
+    contain the analytic O(L²) score term — the measured basis of the
+    flash auto-policy (ops/__init__.py, DESIGN.md §9). Small shape so
+    the compile stays cheap on CPU."""
+    from benchmarks.attn_memory import flash_analytic, xla_measured
+
+    b, h, l, d = 1, 2, 512, 64
+    meas = xla_measured(b, h, l, d)
+    ana = flash_analytic(b, h, l, d)
+    # fwd and grad both materialize at least one (L, L) f32 buffer
+    assert meas["fwd"]["temp_bytes"] >= ana["xla_score_term_bytes"]
+    assert meas["grad"]["temp_bytes"] >= 2 * ana["xla_score_term_bytes"]
+    # flash residents are O(L): far below the score term at this shape
+    assert ana["hbm_grad_bytes"] < ana["xla_score_term_bytes"]
+
+
+def test_attn_memory_utest():
+    import benchmarks.attn_memory as am
+
+    am.utest()
