@@ -68,20 +68,24 @@ def xla_measured(b, h, l, d):
 
 def flash_analytic(b, h, l, d, block_q=128, block_k=128):
     """Flash kernel pair's memory by construction (ops/attention.py):
-    HBM holds only O(L) arrays; VMEM holds the per-step tiles."""
+    HBM holds only O(L) arrays; VMEM holds the per-step tiles. Row
+    state (lse, Δ) rides lane-replicated ×_LANES for Mosaic block
+    legality — counted here at its real replicated size."""
+    from lua_mapreduce_tpu.ops.attention import _LANES
+
     bf16, f32 = 2, 4
     qkv = 3 * b * l * h * d * bf16
     o = b * l * h * d * bf16
-    lse = b * l * h * f32
+    lse = b * l * h * f32 * _LANES               # lane-replicated out
     # backward residuals: (q, k, v, o, lse) saved + do cotangent + Δ row
-    # + dq accumulated f32 + dk/dv f32 accumulators
+    # (both lane-replicated operands) + dq/dk/dv f32 accumulators
     bwd_extra = (b * l * h * d * bf16            # do
-                 + b * l * h * f32               # delta
+                 + 2 * b * l * h * f32 * _LANES  # lse_r, delta_r
                  + 3 * b * l * h * d * f32)      # dq, dk, dv f32 accums
     vmem_fwd = (block_q * d * bf16 + 2 * block_k * d * bf16
                 + block_q * block_k * f32        # score tile
                 + block_q * d * f32              # o accumulator
-                + 2 * block_q * f32)             # m, l scratch
+                + 2 * block_q * _LANES * f32)    # m, l scratch
     return {
         "hbm_fwd_bytes": qkv + o + lse,
         "hbm_grad_bytes": qkv + o + lse + bwd_extra,

@@ -31,8 +31,8 @@ import jax
 # to Pallas on memory grounds, now measured (benchmarks/attn_memory.py →
 # results/attn_memory.json, DESIGN.md §9): the XLA composition's compiled
 # buffer assignment holds ~4 L²-sized temps across fwd+bwd — 4.13 GiB at
-# (b=2, h=8, L=4096, d=128) vs the fused kernel pair's 0.172 GiB of O(L)
-# residents (24×; 59× by L=8192) — while the Pallas pair (forward +
+# (b=2, h=8, L=4096, d=128) vs the fused kernel pair's 0.178 GiB of O(L)
+# residents (23×; 57× by L=8192) — while the Pallas pair (forward +
 # FlashAttention-2 backward re-materializing p from the saved logsumexp)
 # never materializes O(L²). Head-to-head speed entries (flash_* and
 # flash_grad_* in kernels.json) complete the picture on real-chip runs.

@@ -39,6 +39,30 @@ from lua_mapreduce_tpu.ops import resolve_backend
 
 _NEG_INF = -1e30
 
+# Row-state arrays (running max / denominator / logsumexp / Δ) are
+# lane-REPLICATED inside kernels. Mosaic requires every block's trailing
+# two dims to be (divisible by 8, divisible by 128) or equal to the
+# array's — a (1, block_q) row block fails that whenever b·h > 1, so
+# per-row scalars ride as (block_q, _LANES) tiles whose lanes all hold
+# the same value. Reads collapse lanes with a max (exact: all lanes
+# equal); writes broadcast. 8 lanes, not 128: the block's lane dim then
+# EQUALS the array's lane dim (the same legality clause head_dim < 128
+# q/k/v blocks use), at 1/16th the HBM of full-width replication. CPU
+# interpret mode never enforces any of this — round 3's suite was green
+# while the kernel could not lower on the chip, which is exactly what
+# the round-4 hardware window exposed.
+_LANES = 8
+
+
+def _row_read(ref):
+    """(1, block_q, _LANES) lane-replicated ref → (block_q, 1) value."""
+    return jnp.max(ref[0], axis=-1, keepdims=True)
+
+
+def _lane_rep(x):
+    """(bh, l) row array → (bh, l, _LANES) lane-replicated operand."""
+    return jnp.broadcast_to(x[:, :, None], (*x.shape, _LANES))
+
 
 def _tile_mask(rows, cols, causal: bool, window: int, seq_len: int,
                q_offset: int = 0):
@@ -104,6 +128,15 @@ def _attn_reference_xla(q, k, v, causal: bool, scale: float,
     return out32, jnp.transpose(lse, (0, 2, 1))         # (B, L, H)
 
 
+def _flash_kernel_nolse(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                        acc_scr, **kw):
+    """Inference variant: no lse output allocated or written at all —
+    the plain forward (return_lse=False, outside any vjp) should not
+    pay HBM for softmax state nobody reads."""
+    _flash_kernel(q_ref, k_ref, v_ref, o_ref, None, m_scr, l_scr,
+                  acc_scr, **kw)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                   acc_scr, *, scale: float, causal: bool, seq_len: int,
                   block_q: int, block_k: int, n_kv: int,
@@ -138,12 +171,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                            q_offset)
         s = jnp.where(valid, s, _NEG_INF)
 
-        m_prev = m_scr[:]                               # (bq, 1)
+        m_prev = jnp.max(m_scr[:], axis=-1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)
-        m_scr[:] = m_new
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_prev = jnp.max(l_scr[:], axis=-1, keepdims=True)
+        l_scr[:] = jnp.broadcast_to(
+            l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True),
+            l_scr.shape)
         # p folds back to the value dtype for the MXU; the f32 denominator
         # (summed above, BEFORE the downcast) keeps normalization exact
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -162,12 +198,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 
     @pl.when(ki == n_kv - 1)
     def _():
-        o_ref[0] = (acc_scr[:] /
-                    jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
-        # per-row logsumexp: the ONLY softmax state the fused backward
-        # needs (p re-materializes as exp(s - lse) per tile)
-        lse = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
-        lse_ref[...] = lse.reshape(1, block_q)
+        l_fin = jnp.maximum(jnp.max(l_scr[:], axis=-1, keepdims=True),
+                            1e-30)                      # (bq, 1)
+        o_ref[0] = (acc_scr[:] / l_fin).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # per-row logsumexp: the ONLY softmax state the fused
+            # backward needs (p re-materializes as exp(s - lse))
+            lse = (jnp.max(m_scr[:], axis=-1, keepdims=True)
+                   + jnp.log(l_fin))
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _clamp_blocks(l: int, block_q: int, block_k: int):
@@ -215,8 +254,21 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
     n_q = qb.shape[1] // block_q
     n_kv = kb.shape[1] // block_k
 
-    out, lse = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, causal=causal,
+    kern = _flash_kernel if with_lse else _flash_kernel_nolse
+    spec_o = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
+                          memory_space=pltpu.VMEM)
+    spec_lse = pl.BlockSpec((1, block_q, _LANES),
+                            lambda bh, qi, ki: (bh, qi, 0),
+                            memory_space=pltpu.VMEM)
+    # the lse path serves partial-merge callers (ring folds): its out
+    # stays f32 so P merged partials round ONCE at the caller's final
+    # cast, not once per ring step
+    shape_o = jax.ShapeDtypeStruct(
+        qb.shape, jnp.float32 if with_lse else q.dtype)
+    shape_lse = jax.ShapeDtypeStruct((b * h, qb.shape[1], _LANES),
+                                     jnp.float32)
+    res = pl.pallas_call(
+        functools.partial(kern, scale=scale, causal=causal,
                           seq_len=l, block_q=block_q, block_k=block_k,
                           n_kv=n_kv, window=window,
                           q_offset=q_offset),
@@ -231,30 +283,21 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
                          lambda bh, qi, ki: (_kv_row(bh, h, hkv), ki, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            # the lse path serves partial-merge callers (ring folds):
-            # its out stays f32 so P merged partials round ONCE at the
-            # caller's final cast, not once per ring step
-            jax.ShapeDtypeStruct(qb.shape,
-                                 jnp.float32 if with_lse else q.dtype),
-            jax.ShapeDtypeStruct((b * h, qb.shape[1]), jnp.float32),
-        ],
+        out_specs=[spec_o, spec_lse] if with_lse else [spec_o],
+        out_shape=[shape_o, shape_lse] if with_lse else [shape_o],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),      # running max
-            pltpu.VMEM((block_q, 1), jnp.float32),      # running denom
-            pltpu.VMEM((block_q, d), jnp.float32),      # running output
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),       # running output
         ],
         interpret=interpret,
     )(qb, kb, vb)
 
+    out = res[0]
     out = jnp.transpose(out[:, :l, :].reshape(b, h, l, d), (0, 2, 1, 3))
-    return (out, lse) if with_lse else out
+    if not with_lse:
+        return out
+    return out, res[1][:, :, 0]        # collapse the replicated lanes
 
 
 def _bwd_tile(q, k, v, do, lse_ref, delta_ref, qi, ki, *, scale, causal,
@@ -272,11 +315,11 @@ def _bwd_tile(q, k, v, do, lse_ref, delta_ref, qi, ki, *, scale, causal,
     cols = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     valid = _tile_mask(rows, cols, causal, window, seq_len, q_offset)
-    lse = lse_ref[...].reshape(block_q, 1)
+    lse = _row_read(lse_ref)                            # (bq, 1)
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    delta = delta_ref[...].reshape(block_q, 1)
+    delta = _row_read(delta_ref)                        # (bq, 1)
     ds = p * (dp - delta) * scale
     return p, ds
 
@@ -406,9 +449,13 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
               block_q=block_q, block_k=block_k, window=window,
               q_offset=q_offset)
 
+    # row operands (lse, Δ) ride lane-replicated — see _LANES
+    lse_r = _lane_rep(lse)
+    delta_r = _lane_rep(delta)
     spec_q = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
                           memory_space=pltpu.VMEM)
-    spec_row = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i),
+    spec_row = pl.BlockSpec((1, block_q, _LANES),
+                            lambda bh, i, j: (bh, i, 0),
                             memory_space=pltpu.VMEM)
     spec_kv = pl.BlockSpec(
         (1, block_k, d), lambda bh, i, j: (_kv_row(bh, h, hkv), j, 0),
@@ -422,7 +469,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
         out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qb, kb, vb, dob, lse, delta)
+    )(qb, kb, vb, dob, lse_r, delta_r)
 
     # dkv grid: one row per KV head, kv-block outer, and the innermost
     # axis walks (q-head-in-group × q-block) — the q-side index maps
@@ -434,7 +481,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
         (1, block_q, d), lambda bh, j, i: (q_row(bh, i), i % n_q, 0),
         memory_space=pltpu.VMEM)
     spec_row2 = pl.BlockSpec(
-        (1, block_q), lambda bh, j, i: (q_row(bh, i), i % n_q),
+        (1, block_q, _LANES),
+        lambda bh, j, i: (q_row(bh, i), i % n_q, 0),
         memory_space=pltpu.VMEM)
     spec_kv2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0),
                             memory_space=pltpu.VMEM)
@@ -450,7 +498,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qb, kb, vb, dob, lse, delta)
+    )(qb, kb, vb, dob, lse_r, delta_r)
 
     def from_bh(x, ln, heads):
         return jnp.transpose(x[:, :ln, :].reshape(b, heads, ln, d),
