@@ -8,4 +8,4 @@
 set -e
 cd "$(dirname "$0")"
 python -c "import lua_mapreduce_tpu; lua_mapreduce_tpu.utest(); print('utest: all module self-tests passed')"
-python -m pytest tests/ -q
+python -m pytest tests/ -q --full

@@ -85,6 +85,7 @@ def test_remove_results_aborts_without_confirmation(tmp_path, monkeypatch):
     assert FileJobStore(coord).get_task() is not None
 
 
+@pytest.mark.heavy
 def test_lm_example_smoke():
     """The long-context LM demo must run end to end on a virtual mesh
     (and regression-guards the jax_env fix: with JAX_PLATFORMS=cpu in

@@ -237,6 +237,7 @@ def test_multiprocess_pool(tmp_path, engine):
     assert len(workers_seen) >= 1
 
 
+@pytest.mark.heavy
 def test_cross_host_pools_exchange_only_via_object_store(tmp_path):
     """Two disjoint worker pools — mappers and reducers with separate
     scratch dirs, phase-restricted so no process ever runs both sides —
@@ -347,6 +348,7 @@ def test_missing_run_file_fails_loudly_naming_producer():
     assert not t.is_alive(), "server loop did not complete after drain"
 
 
+@pytest.mark.heavy
 def test_server_resume_after_reduce_phase_restart(tmp_path):
     """Resume matrix (server.lua:470-492): a server restarted while the
     task doc says REDUCE must skip the map phase entirely."""

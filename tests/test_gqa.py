@@ -133,6 +133,7 @@ def test_ulysses_rejects_indivisible_kv_heads(mesh, gqa_cfg):
         tfm.make_sharded_apply(cfg, mesh, attn="ulysses")
 
 
+@pytest.mark.heavy
 def test_train_step_gqa_learns(mesh, gqa_cfg):
     """GQA training end to end (ring attention, flash backward under
     the hood): the copy task's loss must drop."""
@@ -155,6 +156,7 @@ def test_train_step_gqa_learns(mesh, gqa_cfg):
     assert float(loss) < 0.6 * first, (first, float(loss))
 
 
+@pytest.mark.heavy
 def test_decode_gqa_matches_full_forward(gqa_cfg):
     """KV-cached GQA decode (grouped einsum against the H_kv-head
     cache) vs re-running the full forward at every prefix."""
@@ -171,6 +173,7 @@ def test_decode_gqa_matches_full_forward(gqa_cfg):
     assert np.array_equal(np.asarray(got), np.asarray(toks))
 
 
+@pytest.mark.heavy
 def test_prefill_gqa_matches_scan_and_shrinks_cache(mesh, gqa_cfg):
     params = tfm.init_transformer(jax.random.PRNGKey(10), gqa_cfg)
     prompt = jnp.asarray(

@@ -64,6 +64,7 @@ class TestLeNet:
 
 
 class TestResNet:
+    @pytest.mark.heavy
     def test_forward_shape_imagenet_topology(self):
         """Full ResNet-18 wiring at reduced resolution: the imagenet stem
         (7x7/2 + maxpool) and all four stages must compose."""
@@ -77,6 +78,7 @@ class TestResNet:
         np.testing.assert_allclose(
             np.exp(np.asarray(logp)).sum(axis=1), 1.0, atol=1e-4)
 
+    @pytest.mark.heavy
     def test_gradients_flow_to_every_param(self):
         from lua_mapreduce_tpu.models import resnet
         cfg = resnet.ResNetConfig.tiny()
@@ -90,6 +92,7 @@ class TestResNet:
             assert np.isfinite(np.asarray(g)).all(), name
             assert float(jnp.abs(g).max()) > 0.0, f"dead gradient: {name}"
 
+    @pytest.mark.heavy
     def test_dp_training_learns(self, mesh):
         from lua_mapreduce_tpu.models import resnet
         cfg = resnet.ResNetConfig(input_shape=(16, 16, 3), n_classes=10,

@@ -114,6 +114,7 @@ def test_sharded_forward_matches_oracle(mesh, cfg, attn):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.heavy
 def test_train_step_learns_and_remat_parity(mesh, cfg):
     """llama_style training on the mesh: learns the copy task, and
     remat=True gives identical numbers."""
@@ -191,6 +192,7 @@ def test_pp_modern_runs(cfg):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.heavy
 def test_decode_and_prefill_match_full_forward(mesh, cfg):
     params = tfm.init_transformer(jax.random.PRNGKey(13), cfg)
     prompt = jnp.asarray(np.random.RandomState(14).randint(0, 64, (4, 8)),

@@ -183,6 +183,7 @@ class TestMoEDecode:
         want = tfm.greedy_decode(dense_params, prompt, 6, cfg=dense_cfg)
         assert np.array_equal(np.asarray(got), np.asarray(want))
 
+    @pytest.mark.heavy
     def test_decode_matches_full_forward_rerun(self):
         """Random-router MoE decode vs re-running the FULL MoE forward
         at every prefix: token-exact when no bucket overflows (capacity

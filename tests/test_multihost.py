@@ -133,6 +133,7 @@ print(f"P{{pid}}-OK loss={{float(loss):.6f}}", flush=True)
 '''
 
 
+@pytest.mark.heavy
 def test_two_process_distributed_training_step(tmp_path):
     """REAL multi-controller e2e on one box: two OS processes join via
     jax.distributed (gloo CPU collectives — the DCN stand-in), each

@@ -63,6 +63,7 @@ def test_f32_params_pass_through_losslessly():
                                rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.heavy
 def test_composes_with_zero1_sharded_masters():
     """Under ZeRO-1 the f32 masters live in the per-rank chunks: the
     sharded-master training matches a replicated-master run, and the

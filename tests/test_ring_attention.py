@@ -43,6 +43,7 @@ class TestRing:
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(want), rtol=0.1, atol=0.05)
 
+    @pytest.mark.heavy
     def test_gradients_match_reference(self, mesh):
         """d(sum(attn))/dq through the ring ≡ through the oracle — the
         ring must be trainable, not inference-only."""
@@ -101,6 +102,7 @@ class TestZigzagRing:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.heavy
     def test_gradients_match_reference(self, mesh):
         q, k, v = _qkv(8, l=32, h=4)
 

@@ -121,6 +121,7 @@ class TestModel:
         assert np.abs(np.asarray(lw[:, 20:]) -
                       np.asarray(lf[:, 20:])).max() > 1e-3
 
+    @pytest.mark.heavy
     def test_decode_matches_full_forward(self, cfg):
         params = tfm.init_transformer(jax.random.PRNGKey(2), cfg)
         prompt = jnp.asarray(
@@ -143,6 +144,7 @@ class TestModel:
                               use_prefill=True)
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
+    @pytest.mark.heavy
     def test_rolling_cache_short_prompt(self, cfg):
         """Prompt SHORTER than the window: rolling slots beyond the
         prompt stay masked until filled; prefill and scan agree with
@@ -227,6 +229,7 @@ class TestBandedRing:
             np.asarray(jax.grad(ring_loss)(q)),
             np.asarray(jax.grad(ref_loss)(q)), rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.heavy
     def test_train_step_windowed_matches_oracle_loss(self, cfg):
         """make_train_step(attn='ring') with cfg.window: first-step
         loss equals the windowed oracle's mean NLL."""
@@ -249,6 +252,7 @@ class TestBandedRing:
                           *tfm.shard_batch(mesh, toks, tgts))
         assert abs(float(loss) - want) < 2e-5, (float(loss), want)
 
+    @pytest.mark.heavy
     def test_sharded_windowed_prefill(self, cfg):
         from lua_mapreduce_tpu.parallel.mesh import make_mesh
         mesh = make_mesh(dp=2, mp=2, devices=jax.devices("cpu")[:4],
@@ -266,6 +270,7 @@ class TestBandedRing:
         with pytest.raises(ValueError, match="window"):
             tfm.init_transformer(jax.random.PRNGKey(0), bad)
 
+    @pytest.mark.heavy
     def test_pipeline_supports_window(self, cfg):
         """pp doesn't shard the sequence, so windowed attention works
         there — and the pp loss must equal the oracle's (same mask)."""

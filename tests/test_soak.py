@@ -61,6 +61,7 @@ def _final_params(model_tag):
     return ckpt.load_pytree(store, mr.MODEL_FILE, mr._template())["params"]
 
 
+@pytest.mark.heavy
 def test_soak_100_iterations_churn_and_midreduce_restart(monkeypatch):
     # ---- golden: unperturbed single-process run --------------------------
     gold_traj = []

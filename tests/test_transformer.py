@@ -46,6 +46,7 @@ def test_sharded_forward_matches_oracle(mesh, cfg, params, attn):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.heavy
 def test_grad_accum_matches_whole_tile(mesh, cfg):
     """make_train_step(grad_accum=2): identical loss/params to the
     un-accumulated step (mean of equal microbatch grads ≡ grad of the
@@ -74,6 +75,7 @@ def test_grad_accum_matches_whole_tile(mesh, cfg):
                                    rtol=1e-5, atol=1e-6, err_msg=k)
 
 
+@pytest.mark.heavy
 def test_zigzag_step_is_dropin_for_ring(mesh, cfg):
     """attn='zigzag' must be loss- and grad-equivalent to the contiguous
     ring (the permutation is internal; the loss is a token mean)."""
@@ -101,6 +103,7 @@ def test_zigzag_step_is_dropin_for_ring(mesh, cfg):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.heavy
 def test_zigzag_pre_permuted_batch_matches_in_step_permutation(mesh, cfg):
     """zigzag_layout=True + shard_batch(schedule='zigzag'): identical
     loss/params to the default path that permutes inside the jitted
@@ -137,6 +140,7 @@ def test_zigzag_pre_permuted_batch_matches_in_step_permutation(mesh, cfg):
                             zigzag_layout=True)
 
 
+@pytest.mark.heavy
 def test_train_step_learns_copy_task(mesh, cfg):
     """Sequence-parallel training on a deterministic pattern must reach
     low loss: sequences follow tok[t+1] = (tok[t] + 1) % vocab."""
@@ -205,6 +209,7 @@ class Test3D:
             np.array(jax.devices("cpu")[:8]).reshape(2, 2, 2),
             ("dp", "sp", "mp"))
 
+    @pytest.mark.heavy
     def test_one_step_matches_2d_path(self, mesh3, cfg):
         """Same data, same init: one SGD step through the 3-D tp form
         must produce the same params as the 2-D (dp, sp) form."""
@@ -236,6 +241,7 @@ class Test3D:
                 np.asarray(p3[k]), np.asarray(p2[k]), rtol=2e-4,
                 atol=2e-4, err_msg=k)
 
+    @pytest.mark.heavy
     def test_3d_zigzag_matches_3d_ring(self, mesh3, cfg):
         """attn='zigzag' on the 3-D mesh: loss/params equivalent to the
         contiguous 3-D ring (internal permutation, token-mean loss)."""
@@ -262,6 +268,7 @@ class Test3D:
                 np.asarray(outs["zigzag"][1][k]),
                 rtol=2e-4, atol=2e-4, err_msg=k)
 
+    @pytest.mark.heavy
     def test_3d_grad_accum_matches_whole_tile(self, mesh3, cfg):
         rng = np.random.RandomState(11)
         b, l = 4, 32
@@ -286,6 +293,7 @@ class Test3D:
                 np.asarray(outs[1][1][k]), np.asarray(outs[2][1][k]),
                 rtol=1e-5, atol=1e-6, err_msg=k)
 
+    @pytest.mark.heavy
     def test_3d_training_learns(self, mesh3, cfg):
         rng = np.random.RandomState(1)
         b, l = 8, 32
@@ -335,6 +343,7 @@ class TestMoE:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=3e-4, atol=3e-4)
 
+    @pytest.mark.heavy
     def test_moe_training_learns(self, moe_cfg):
         mesh2 = make_mesh(dp=4, mp=2, devices=jax.devices("cpu")[:8],
                           axis_names=("dp", "sp"))
@@ -395,6 +404,7 @@ class TestPipeline:
         return jax.sharding.Mesh(
             np.array(jax.devices("cpu")[:4]), ("pp",))
 
+    @pytest.mark.heavy
     def test_one_step_matches_single_device(self, pp_cfg, pp_mesh):
         """One SGD step through the 4-stage pipeline == the same step on
         one device (same data, same init) — forward AND backward."""
@@ -428,6 +438,7 @@ class TestPipeline:
                                        np.asarray(p_ref[k]), rtol=2e-4,
                                        atol=2e-4, err_msg=k)
 
+    @pytest.mark.heavy
     def test_pipeline_training_learns(self, pp_cfg, pp_mesh):
         rng = np.random.RandomState(1)
         b, l = 8, 32
@@ -493,6 +504,7 @@ def test_remat_matches_non_remat_grads():
                                    rtol=2e-5, atol=1e-6, err_msg=k)
 
 
+@pytest.mark.heavy
 def test_remat_composes_with_sequence_parallel(mesh):
     """remat under the sharded sp form: one train step runs and matches
     the non-remat step's loss (collectives re-executed in backward)."""
@@ -552,6 +564,7 @@ class TestGreedyDecode:
             toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
         assert np.array_equal(np.asarray(got), np.asarray(toks))
 
+    @pytest.mark.heavy
     def test_trained_model_continues_pattern(self, mesh, cfg):
         """Train on tok[t+1] = tok[t] + 1 (mod vocab), then decode: the
         continuation must follow the arithmetic pattern."""
@@ -624,6 +637,7 @@ class TestGreedyDecode:
         assert np.array_equal(np.asarray(e), np.asarray(
             tfm.greedy_decode(params, prompt, 1, cfg=cfg)))
 
+    @pytest.mark.heavy
     def test_prefill_sharded_matches_single_device(self, mesh, cfg):
         """Sequence-parallel prefill (ring + zigzag over the mesh)
         yields the same caches/logits — and therefore tokens — as the

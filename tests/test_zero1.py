@@ -36,6 +36,7 @@ def _batch(cfg, b=8, l=32, seed=0):
             jnp.asarray(seq[:, 1:], jnp.int32))
 
 
+@pytest.mark.heavy
 def test_zero1_matches_replicated_step(mesh, cfg):
     """5 Adam steps: the sharded-optimizer path lands on the SAME
     params and losses as the replicated path (reduce_scatter+update+
@@ -117,6 +118,7 @@ def test_zero1_rejections(mesh, cfg):
         tfm.make_train_step(moe, mesh, optax.sgd(0.1), zero1=True)
 
 
+@pytest.mark.heavy
 def test_zero1_composes_with_grad_accum(mesh, cfg):
     """zero1 + grad_accum: identical numbers to zero1 alone (the
     microbatch fold feeds the same reduce-scatter)."""
