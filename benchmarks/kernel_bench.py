@@ -567,6 +567,17 @@ def bench_native_merge(n_runs=16, keys_per_run=50_000) -> dict:
 
 
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default="",
+                    help="comma-separated substrings: run only matching "
+                         "cases and MERGE into the existing kernels.json "
+                         "(for re-running entries after a kernel fix "
+                         "without repeating the whole bench)")
+    args = ap.parse_args()
+    only = [s for s in args.only.split(",") if s]
+
     from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
     force_cpu_if_unavailable()
 
@@ -574,11 +585,32 @@ def main() -> None:
     import jax.numpy as jnp
 
     on_tpu = jax.default_backend() == "tpu"
-    results = {
+    prior = {}
+    if os.path.exists(RESULTS):
+        with open(RESULTS) as f:
+            prior = json.load(f)
+    if not on_tpu and prior.get("on_tpu"):
+        # a CPU run (fallback or --only on the wrong host) must never
+        # overwrite or mislabel real-chip numbers
+        print(json.dumps({"skipped": "no TPU and kernels.json holds "
+                                     "TPU-measured entries; artifact "
+                                     "left untouched"}))
+        return
+    results = {}
+    if only:
+        results = prior
+        if not prior.get("on_tpu") and on_tpu:
+            # TPU merge into a CPU-fallback artifact: reset the timings,
+            # keeping only the host-path native-merge bench (valid on
+            # either backend) unless this run regenerates it
+            results = {k: prior[k] for k in ("native_merge_16x50k",)
+                       if k in prior}
+    results.update({
         "device_kind": jax.devices()[0].device_kind,
         "on_tpu": on_tpu,
-        "native_merge_16x50k": bench_native_merge(),
-    }
+    })
+    if not only or any(s in "native_merge_16x50k" for s in only):
+        results["native_merge_16x50k"] = bench_native_merge()
     if on_tpu:
         bf16 = jnp.bfloat16
         cases = {
@@ -620,6 +652,8 @@ def main() -> None:
                 "resnet18_imagenet", 32, steps=5),
         }
         for name, fn in cases.items():
+            if only and not any(s in name for s in only):
+                continue
             try:
                 results[name] = fn()
             except Exception as e:   # record, keep benching the rest

@@ -75,8 +75,11 @@ def make_multihost_mesh(mesh_shape: Sequence[int],
     the ``dcn_axis`` axis is factored as (num_slices × per-slice) via
     ``mesh_utils.create_hybrid_device_mesh``, so only that axis's
     collectives cross DCN; every other axis stays inside a slice on ICI.
-    Single-slice (or CPU/virtual) platforms build an ordinary
-    ``create_device_mesh`` of the same shape — same program, one box.
+    Single-slice pods (any process count — one slice is all-ICI) build
+    an ordinary ``create_device_mesh``. Platforms with no slice notion
+    (multi-controller CPU/GPU) treat the PROCESS boundary as the DCN
+    granule instead — single-process degrades to the ordinary mesh, so
+    the same program runs on one box and on a pod.
     """
     import jax
     from jax.experimental import mesh_utils
@@ -91,7 +94,17 @@ def make_multihost_mesh(mesh_shape: Sequence[int],
             f"mesh {tuple(mesh_shape)} needs {total} devices, have "
             f"{len(devices)}")
 
-    num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    # DCN granule = pod slice when the platform reports slices (TPU:
+    # a single-slice multi-host pod is ALL ICI — hosts inside a slice
+    # are ring-connected, so one slice must stay an ordinary mesh no
+    # matter how many processes drive it). Only when the platform has
+    # no slice notion at all (multi-controller CPU/GPU, the virtual
+    # rig tests run on) does the process boundary stand in for DCN.
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    by_slice = None not in slice_ids
+    granules = (slice_ids if by_slice
+                else {d.process_index for d in devices})
+    num_slices = len(granules)
     if num_slices > 1:
         if mesh_shape[dcn_axis] % num_slices:
             raise ValueError(
@@ -102,7 +115,8 @@ def make_multihost_mesh(mesh_shape: Sequence[int],
         per_slice = list(mesh_shape)
         per_slice[dcn_axis] //= num_slices
         arr = mesh_utils.create_hybrid_device_mesh(
-            per_slice, dcn_shape, devices=devices)
+            per_slice, dcn_shape, devices=devices,
+            process_is_granule=not by_slice)
     else:
         arr = mesh_utils.create_device_mesh(mesh_shape, devices=devices)
     return Mesh(arr, tuple(axis_names))

@@ -1,0 +1,172 @@
+"""Scaled elastic-pool chaos e2e (VERDICT r3 item 3a): a multi-process
+worker pool over FileJobStore coordination + OBJECT storage runs the
+wordcount_big task while workers are SIGKILLed mid-map AND mid-reduce.
+
+The reference's scaled story is the 30-worker Europarl run
+(README.md:77-79) on a pool where any box joins by pointing at the
+shared Mongo; its RUNNING jobs of dead workers stay stuck forever
+(task.lua FIXMEs). This e2e proves the re-design's stronger contract at
+multi-process scale: ownership-CAS claims + stale-requeue recover BOTH
+phases' abandoned jobs with zero failed jobs and a golden-equal result.
+
+Choreography (deterministic, no sleeps-as-sync):
+  1. map victim boots alone, claims a map job, stalls, prints CLAIMED
+  2. SIGKILL it; start 3 map-only healthy processes + the reduce victim
+     (reduce-restricted, so reduce jobs are exclusively its until wave B)
+  3. reduce victim claims, stalls, prints RCLAIMED; SIGKILL it
+  4. wave B: 4 full-phase healthy processes finish everything
+Nine OS worker processes total; the server (this process) never stalls.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import Counter
+
+import pytest
+
+from lua_mapreduce_tpu import FileJobStore, Server, TaskSpec
+from lua_mapreduce_tpu.engine.local import iter_results
+from lua_mapreduce_tpu.store.router import get_storage_from
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_SPLITS = 6
+
+
+def _env():
+    ambient = os.environ.get("PYTHONPATH", "")
+    path = REPO + os.pathsep + ambient if ambient else REPO
+    return dict(os.environ, PYTHONPATH=path)
+
+
+def _worker_code(coord, extra="", configure="max_iter=2000, max_sleep=0.05"):
+    return (
+        "import sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        f"{extra}"
+        "from lua_mapreduce_tpu import FileJobStore, Worker\n"
+        f"w = Worker(FileJobStore({coord!r})).configure({configure})\n"
+        "w.execute()\n")
+
+
+_STALL_MAP = (
+    "import examples.wordcount_big.bigtask as bt\n"
+    "import time\n"
+    "def stall(k, v, emit):\n"
+    "    print('CLAIMED', flush=True)\n"
+    "    time.sleep(3600)\n"
+    "bt.mapfn = stall\n"
+    # the native fast path would bypass the stalled python mapfn
+    "import lua_mapreduce_tpu.core.native_wcmap as nw\n"
+    "nw.native_available = lambda: False\n")
+
+_STALL_REDUCE = (
+    "import examples.wordcount_big.bigtask as bt\n"
+    "import time\n"
+    "def stall(k, values):\n"
+    "    print('RCLAIMED', flush=True)\n"
+    "    time.sleep(3600)\n"
+    "bt.reducefn = stall\n"
+    "import lua_mapreduce_tpu.core.native_merge as nm\n"
+    "nm.native_available = lambda: False\n")
+
+
+@pytest.mark.heavy
+def test_nine_process_pool_survives_map_and_reduce_sigkill(tmp_path):
+    from examples.wordcount_big import corpus
+
+    corpus_dir = str(tmp_path / "corpus")
+    corpus.build(corpus_dir, n_splits=N_SPLITS)
+    golden = Counter()
+    for i in range(N_SPLITS):
+        with open(corpus.split_path(corpus_dir, i)) as f:
+            golden.update(f.read().split())
+
+    coord = str(tmp_path / "coord")
+    obj = str(tmp_path / "obj")
+    storage = f"object:{obj}"
+    store = FileJobStore(coord)
+    mod = "examples.wordcount_big.bigtask"
+    spec = TaskSpec(taskfn=mod, mapfn=mod, partitionfn=mod, reducefn=mod,
+                    init_args={"corpus_dir": corpus_dir,
+                               "n_splits": N_SPLITS, "build": False},
+                    storage=storage)
+
+    env = _env()
+    procs = []
+    events = {}
+
+    def spawn(code, capture=False):
+        p = subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE if capture else subprocess.DEVNULL,
+            text=capture)
+        procs.append(p)
+        return p
+
+    map_victim = spawn(_worker_code(coord, extra=_STALL_MAP), capture=True)
+
+    started = {"b": False}
+    lock = threading.Lock()
+
+    def wave_b():
+        with lock:
+            if started["b"]:
+                return
+            started["b"] = True
+        for p in (map_victim, events.get("rv")):
+            if p is not None and p.poll() is None:
+                p.kill()
+        for _ in range(4):
+            spawn(_worker_code(coord))
+
+    def chaos():
+        events["map_claimed"] = map_victim.stdout.readline().strip()
+        time.sleep(0.2)
+        map_victim.kill()
+        # wave A: map-only healthy pool + the reduce victim
+        for _ in range(3):
+            spawn(_worker_code(
+                coord, configure="max_iter=2000, max_sleep=0.05, "
+                                 "phases=('map',)"))
+        rv = spawn(_worker_code(coord, extra=_STALL_REDUCE), capture=True)
+        events["rv"] = rv
+        events["reduce_claimed"] = rv.stdout.readline().strip()
+        time.sleep(0.2)
+        rv.kill()
+        wave_b()
+
+    t = threading.Thread(target=chaos, daemon=True)
+    t.start()
+    watchdog = threading.Timer(120, wave_b)   # victims wedged → still end
+    watchdog.daemon = True
+    watchdog.start()
+
+    try:
+        server = Server(store, poll_interval=0.05,
+                        stale_timeout_s=1.5).configure(spec)
+        stats = server.loop()
+    finally:
+        watchdog.cancel()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+    assert events.get("map_claimed") == "CLAIMED", \
+        "map victim never claimed a job"
+    assert events.get("reduce_claimed") == "RCLAIMED", \
+        "reduce victim never claimed a job"
+    it = stats.iterations[-1]
+    assert it.map.failed == 0 and it.reduce.failed == 0
+    assert it.map.count == N_SPLITS
+
+    result_store = get_storage_from(storage)
+    got = {k: vs[0] for k, vs in iter_results(result_store, "result")}
+    assert got == dict(golden)
