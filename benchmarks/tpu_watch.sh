@@ -1,0 +1,33 @@
+#!/bin/bash
+# Opportunistic TPU-window watcher (VERDICT r3 item 1): probe the axon
+# tunnel from a killable subprocess every ~9 min; on the first open
+# window, regenerate every TPU artifact (kernel bench incl. the fixed
+# flash entries, block-size sweeps, the flagship bench) and exit. Every
+# probe is appended to benchmarks/results/tpu_probe_log.txt — the
+# committed evidence of whether a window ever opened this round.
+set -u
+cd "$(dirname "$0")/.."
+LOG=benchmarks/results/tpu_probe_log.txt
+
+probe () {
+  python - <<'PY'
+import sys
+sys.path.insert(0, ".")
+from lua_mapreduce_tpu.utils.jax_env import probe_backend
+sys.exit(0 if probe_backend(timeout_s=120.0, fresh=True) else 1)
+PY
+}
+
+while true; do
+  if probe; then
+    echo "$(date -u +%FT%TZ) OPEN — starting artifact regeneration" >> "$LOG"
+    python benchmarks/kernel_bench.py \
+        > /tmp/kernel_bench_watch.log 2>&1
+    echo "$(date -u +%FT%TZ) kernel_bench rc=$?" >> "$LOG"
+    benchmarks/hw_sprint.sh >> /tmp/hw_sprint_watch.log 2>&1
+    echo "$(date -u +%FT%TZ) sprint chain rc=$?" >> "$LOG"
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) closed" >> "$LOG"
+  sleep 540
+done

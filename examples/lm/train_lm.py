@@ -36,6 +36,43 @@ def synthetic_batch(rng, vocab: int, batch: int, seq: int):
     return toks[:, :-1].astype(np.int32), toks[:, 1:].astype(np.int32)
 
 
+# char-level tokenizer for the real-text mode: 64 classes, everything
+# outside the set folds to index 0 (space) — vocab stays MXU-irrelevant
+# small but the statistics are real English
+_CHARSET = (" abcdefghijklmnopqrstuvwxyz0123456789.,;:!?'\"()-_/=+*#%<>[]\n`|")
+_CHAR_TO_ID = {c: i for i, c in enumerate(_CHARSET)}
+REPO_DOCS = "repo-docs"          # sentinel: train on this repo's docs
+
+
+def load_corpus(data: str) -> np.ndarray:
+    """``data`` is a path to a text file, or REPO_DOCS for the repo's
+    own documentation (~80 KB of real English, checked in — the 'small
+    corpus' of VERDICT r3 item 4)."""
+    import os
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    if data == REPO_DOCS:
+        paths = [os.path.join(repo, p)
+                 for p in ("README.md", "docs/DESIGN.md", "SURVEY.md")]
+    else:
+        paths = [data]
+    text = "\n".join(open(p, encoding="utf-8", errors="replace").read()
+                     for p in paths).lower()
+    return np.array([_CHAR_TO_ID.get(c, 0) for c in text], np.int32)
+
+
+def corpus_batch(rng, data: np.ndarray, batch: int, seq: int):
+    if len(data) < seq + 2:
+        raise SystemExit(
+            f"corpus has {len(data)} tokens — needs at least seq+2 = "
+            f"{seq + 2} for one training window; use a bigger file or "
+            f"a smaller --seq")
+    off = rng.randint(0, len(data) - seq - 1, batch)
+    idx = off[:, None] + np.arange(seq + 1)
+    toks = data[idx]
+    return toks[:, :-1], toks[:, 1:]
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--dp", type=int, default=4)
@@ -61,8 +98,31 @@ def main() -> None:
     ap.add_argument("--ckpt", default=None,
                     help="storage spec for checkpoints, e.g. shared:/tmp/lm")
     ap.add_argument("--ckpt-every", type=int, default=10)
+    ap.add_argument("--data", default=None,
+                    help="char-level real-text mode: a text file path, "
+                         f"or '{REPO_DOCS}' for this repo's docs "
+                         "(default: the synthetic stride task)")
+    ap.add_argument("--target-loss", type=float, default=None,
+                    help="stop once train loss < target; --steps becomes "
+                         "the max budget and the run FAILS (exit 1) if "
+                         "the target is never reached")
+    ap.add_argument("--out-json", default=None,
+                    help="write the run summary (loss curve, tokens/sec) "
+                         "to this path")
     args = ap.parse_args()
+    summary = run(args)
+    if args.out_json:
+        import json
+        with open(args.out_json, "w") as f:
+            json.dump(summary, f, indent=1)
+            f.write("\n")
+    if args.target_loss is not None and not summary["reached_target"]:
+        raise SystemExit(
+            f"target loss {args.target_loss} not reached in "
+            f"{args.steps} steps (final {summary['losses'][-1][1]})")
 
+
+def run(args) -> dict:
     from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
     force_cpu_if_unavailable()
     import jax
@@ -114,22 +174,49 @@ def main() -> None:
         opt_state = opt.init(params)
 
     store = get_storage_from(args.ckpt) if args.ckpt else None
+    data = load_corpus(args.data) if args.data else None
+    target = getattr(args, "target_loss", None)
     rng = np.random.RandomState(0)
+    losses = []
+    reached = target is None
     t0 = time.time()
+    warm_t0 = None              # tokens/sec excludes the compile step
+    i = 0
     for i in range(1, args.steps + 1):
-        toks, tgts = synthetic_batch(rng, cfg.vocab, args.batch, args.seq)
+        if data is not None:
+            toks, tgts = corpus_batch(rng, data, args.batch, args.seq)
+        else:
+            toks, tgts = synthetic_batch(rng, cfg.vocab, args.batch,
+                                         args.seq)
         params, opt_state, loss = step(
             params, opt_state,
             *tfm.shard_batch(mesh, toks, tgts, schedule=schedule))
+        if i == 1:
+            warm_t0 = time.time()
+        # loss is only fetched (device→host sync) on the print cadence —
+        # a per-step fetch would serialize async dispatch and the
+        # reported tokens/sec would measure the synchronized regime
         if i == 1 or i % 5 == 0 or i == args.steps:
-            print(f"step {i:4d}  loss {float(loss):.4f}  "
+            lf = float(loss)
+            losses.append((i, round(lf, 4)))
+            print(f"step {i:4d}  loss {lf:.4f}  "
                   f"({time.time() - t0:.1f}s)", flush=True)
+            if target is not None and lf < target:
+                reached = True
+                print(f"target loss {target} reached at step {i}",
+                      flush=True)
+                break
         if store is not None and i % args.ckpt_every == 0:
             ckpt.save_pytree(store, "lm.ckpt", (params, opt_state))
             print(f"  checkpoint @ step {i}", flush=True)
     jax.block_until_ready(params)   # CPU backends: don't overlap the
     #                                   decode program with in-flight
     #                                   train collectives
+    steps_done = i
+    toks_per_step = args.batch * args.seq
+    warm_s = time.time() - (warm_t0 or t0)
+    tokens_per_sec = (toks_per_step * max(0, steps_done - 1)
+                      / max(warm_s, 1e-9))
     print(f"done: final loss {float(loss):.4f} "
           f"({args.attn} attention, dp={args.dp} sp={args.sp}, "
           f"grad_accum={args.grad_accum}, remat=on"
@@ -138,13 +225,47 @@ def main() -> None:
           + (", zero1" if args.zero1 else "")
           + (", bf16+f32-master" if args.bf16 else "") + ")")
 
-    # generate: parallel prompt prefill + KV-cached greedy decode
-    prompt = np.arange(8, dtype=np.int32)[None, :] % cfg.vocab
-    out = np.asarray(tfm.greedy_decode(
-        params, jnp.asarray(prompt), 8, cfg=cfg, use_prefill=True))[0]
-    print(f"prompt {prompt[0].tolist()} -> continuation "
-          f"{out[8:].tolist()} (stride-1 truth: "
-          f"{[(8 + i) % cfg.vocab for i in range(8)]})")
+    if data is None:
+        # generate: parallel prompt prefill + KV-cached greedy decode
+        prompt = np.arange(8, dtype=np.int32)[None, :] % cfg.vocab
+        out = np.asarray(tfm.greedy_decode(
+            params, jnp.asarray(prompt), 8, cfg=cfg, use_prefill=True))[0]
+        print(f"prompt {prompt[0].tolist()} -> continuation "
+              f"{out[8:].tolist()} (stride-1 truth: "
+              f"{[(8 + i) % cfg.vocab for i in range(8)]})")
+        sample = out.tolist()
+    else:
+        # sample a continuation of a corpus prompt, decoded to text;
+        # lengths scale with the model's positional budget, and ids the
+        # charset doesn't cover (vocab is padded to 64) print as '?'
+        p_len = min(32, max(4, cfg.max_seq // 4))
+        n_new = min(48, cfg.max_seq - p_len)
+        toks, _ = corpus_batch(rng, data, 1, p_len)
+        out = np.asarray(tfm.greedy_decode(
+            params, jnp.asarray(toks), n_new, cfg=cfg,
+            use_prefill=True))[0]
+        sample = "".join(_CHARSET[t] if t < len(_CHARSET) else "?"
+                         for t in out)
+        print(f"sample: {sample!r}")
+
+    return {
+        "data": args.data or "synthetic-stride",
+        "losses": losses,
+        "steps": steps_done,
+        "reached_target": reached,
+        "target_loss": target,
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "platform": jax.default_backend(),
+        "config": {
+            "dp": args.dp, "sp": args.sp, "seq": args.seq,
+            "batch": args.batch, "grad_accum": args.grad_accum,
+            "attn": args.attn, "modern": args.modern,
+            "zero1": args.zero1, "bf16": args.bf16,
+            "vocab": cfg.vocab, "d_model": cfg.d_model,
+            "n_layers": cfg.n_layers, "d_ff": cfg.d_ff,
+        },
+        "sample": sample,
+    }
 
 
 if __name__ == "__main__":
