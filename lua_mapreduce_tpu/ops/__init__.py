@@ -22,11 +22,13 @@ from __future__ import annotations
 import jax
 
 # Measured on a TPU v5e (benchmarks/results/kernels.json): XLA's conv
-# lowering beats the im2col+Pallas path (45.7 vs 7.9 TF/s on the ResNet
-# 56×56 block) and its large-matmul schedule beats the round-2 Pallas
-# one — a 256²-tile bandwidth roofline, diagnosed quantitatively in
-# docs/DESIGN.md §8; the size-adaptive 512² schedule staged there flips
-# this entry only when a sweep-validated artifact shows ≥0.9× XLA; the
+# lowering beats the im2col+Pallas path (46.1 vs 8.1 TF/s on the ResNet
+# 56×56 block) STRUCTURALLY — the im2col patch round trip alone costs
+# 1.9× XLA's whole runtime (DESIGN.md §8b), so conv2d is "xla"
+# permanently for this shape class. Matmul: the 512²-tile schedule
+# (DESIGN.md §8) measured 127.5 TF/s on the round-4 window — 2.38× the
+# old 256² tiles, validating the roofline diagnosis, but 0.83× XLA's
+# 153.8, short of the ≥0.9× flip rule; a wider-tile sweep is staged; the
 # Pallas pooling kernel beats XLA's reduce_window ~2.7×. Flash resolves
 # to Pallas on memory grounds, now measured (benchmarks/attn_memory.py →
 # results/attn_memory.json, DESIGN.md §9): the XLA composition's compiled
