@@ -24,7 +24,7 @@ import jax
 # Measured on a TPU v5e (benchmarks/results/kernels.json): XLA's conv
 # lowering beats the im2col+Pallas path (46.1 vs 8.1 TF/s on the ResNet
 # 56×56 block) STRUCTURALLY — the im2col patch round trip alone costs
-# 1.9× XLA's whole runtime (DESIGN.md §8b), so conv2d is "xla"
+# 1.75× XLA's whole runtime (DESIGN.md §8b), so conv2d is "xla"
 # permanently for this shape class. Matmul: the 512²-tile schedule
 # (DESIGN.md §8) measured 127.5 TF/s on the round-4 window — 2.38× the
 # old 256² tiles, validating the roofline diagnosis, but 0.83× XLA's

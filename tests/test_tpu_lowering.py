@@ -1,0 +1,147 @@
+"""TPU-target lowering regression for every Pallas kernel.
+
+Round 4's hardware window exposed that the flash kernels had NEVER
+lowered on TPU: Mosaic rejects (1, block_q) row-state blocks whenever
+B·H > 1, and CPU interpret mode — all the suite ran between hardware
+windows — never enforces block legality. The fix is ops/attention.py's
+lane-replicated row state; THIS file is the structural fix for the test
+gap: ``jax.export`` runs the full TPU lowering pipeline (including
+Mosaic's legality checks, verified to reproduce the exact round-3
+failure) on a CPU-only host, so a kernel that cannot lower on the chip
+now fails the suite on every box, between windows included.
+
+Export stops at lowering — nothing executes, so these are fast and
+numerics-free; interpret-mode parity tests elsewhere own correctness.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lua_mapreduce_tpu import ops
+from lua_mapreduce_tpu.ops.attention import _flash_pallas
+
+
+def export_tpu(f, *shapes):
+    """Lower ``f`` for the TPU target from the CPU host; raises on any
+    Mosaic legality violation."""
+    return jax.export.export(jax.jit(f), platforms=["tpu"])(*shapes)
+
+
+def _q(b, l, h, d, dtype=jnp.bfloat16):
+    return jax.ShapeDtypeStruct((b, l, h, d), dtype)
+
+
+class TestFlashLowering:
+    def test_forward_causal(self):
+        q = _q(2, 1024, 8, 128)
+        export_tpu(lambda q, k, v: _flash_pallas(q, k, v, True), q, q, q)
+
+    def test_forward_full(self):
+        q = _q(2, 512, 4, 128)
+        export_tpu(lambda q, k, v: _flash_pallas(q, k, v, False), q, q, q)
+
+    def test_forward_gqa(self):
+        q = _q(2, 512, 8, 128)
+        kv = _q(2, 512, 2, 128)
+        export_tpu(lambda q, k, v: _flash_pallas(q, k, v, True),
+                   q, kv, kv)
+
+    def test_forward_head_dim_64(self):
+        q = _q(2, 512, 4, 64)
+        export_tpu(lambda q, k, v: _flash_pallas(q, k, v, True), q, q, q)
+
+    def test_forward_ragged_seq_padding(self):
+        # odd L exercises _pad_seq + _clamp_blocks geometry on-chip
+        q = _q(1, 300, 2, 128)
+        export_tpu(lambda q, k, v: _flash_pallas(q, k, v, True), q, q, q)
+
+    def test_forward_windowed_offset(self):
+        q = _q(2, 512, 4, 128)
+        export_tpu(lambda q, k, v: _flash_pallas(
+            q, k, v, True, window=128, q_offset=64), q, q, q)
+
+    def test_forward_with_lse(self):
+        q = _q(2, 512, 4, 128)
+        export_tpu(lambda q, k, v: _flash_pallas(q, k, v, True,
+                                                 with_lse=True), q, q, q)
+
+    def test_grad_both_outputs(self):
+        """The training path: fused backward kernels (dq and dkv),
+        lse-cotangent fold included — the exact program ring training
+        runs per shard."""
+        q = _q(2, 512, 8, 128)
+        kv = _q(2, 512, 2, 128)
+
+        def loss(q_, k_, v_):
+            o, lse = ops.flash_attention(q_, k_, v_, causal=True,
+                                         return_lse=True,
+                                         backend="pallas")
+            return o.sum() + lse.sum()
+
+        export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, kv, kv)
+
+    def test_grad_windowed(self):
+        q = _q(1, 512, 4, 128)
+
+        def loss(q_, k_, v_):
+            return ops.flash_attention(q_, k_, v_, causal=True,
+                                       window=128,
+                                       backend="pallas").sum()
+
+        export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+
+
+class TestOtherKernelsLowering:
+    def test_matmul_default_blocks(self):
+        a = jax.ShapeDtypeStruct((1024, 1024), jnp.bfloat16)
+        export_tpu(lambda a, b: ops.matmul(a, b, backend="pallas"), a, a)
+
+    def test_matmul_wide_blocks(self):
+        # the 512²-tile auto schedule (DESIGN §8) must stay legal
+        a = jax.ShapeDtypeStruct((2048, 2048), jnp.bfloat16)
+        export_tpu(lambda a, b: ops.matmul(a, b, backend="pallas"), a, a)
+
+    def test_conv2d(self):
+        x = jax.ShapeDtypeStruct((8, 32, 32, 16), jnp.bfloat16)
+        w = jax.ShapeDtypeStruct((3, 3, 16, 32), jnp.bfloat16)
+        export_tpu(lambda x, w: ops.conv2d(x, w, backend="pallas"), x, w)
+
+    def test_maxpool(self):
+        x = jax.ShapeDtypeStruct((8, 32, 32, 32), jnp.bfloat16)
+        export_tpu(lambda x: ops.maxpool2d(x, backend="pallas"), x)
+
+    def test_avgpool(self):
+        x = jax.ShapeDtypeStruct((8, 32, 32, 32), jnp.bfloat16)
+        export_tpu(lambda x: ops.avgpool2d(x, backend="pallas"), x)
+
+    def test_log_softmax(self):
+        x = jax.ShapeDtypeStruct((256, 1024), jnp.bfloat16)
+        export_tpu(lambda x: ops.log_softmax(x, backend="pallas"), x)
+
+
+def test_export_actually_enforces_block_legality():
+    """Guard the guard: a deliberately illegal (1, block) row-state
+    block spec must be REJECTED by the export path — if a jax upgrade
+    ever stops running Mosaic legality checks under export, this test
+    fails and the whole file stops meaning anything."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kern(x_ref, r_ref):
+        r_ref[...] = x_ref[0].sum(axis=-1).reshape(1, 128)
+
+    def f(x):
+        return pl.pallas_call(
+            kern,
+            grid=(4, 2),
+            in_specs=[pl.BlockSpec((1, 128, 128), lambda b, i: (b, i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, 128), lambda b, i: (b, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((4, 256), jnp.float32),
+        )(x)
+
+    x = jax.ShapeDtypeStruct((4, 256, 128), jnp.float32)
+    with pytest.raises(ValueError, match="divisible by 8 and 128"):
+        export_tpu(f, x)
