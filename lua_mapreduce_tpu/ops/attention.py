@@ -290,6 +290,11 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
             pltpu.VMEM((block_q, d), jnp.float32),       # running output
         ],
+        # (bh, qi) carry no cross-iteration state (scratch re-inits at
+        # ki == 0); only the kv axis accumulates — telling Mosaic lets
+        # it parallelize/pipeline across the first two grid axes
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qb, kb, vb)
 
@@ -468,6 +473,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
         out_specs=spec_q,
         out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qb, kb, vb, dob, lse_r, delta_r)
 
@@ -497,6 +504,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
                    jax.ShapeDtypeStruct(vb.shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qb, kb, vb, dob, lse_r, delta_r)
 

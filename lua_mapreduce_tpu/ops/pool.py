@@ -65,6 +65,9 @@ def _pool_pallas(x, window, stride, mode, interpret=False):
         out_specs=pl.BlockSpec((1, ho, wo, c), lambda i: (i, 0, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n, ho, wo, c), x.dtype),
+        # each image is independent — let Mosaic parallelize the batch
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
         interpret=interpret,
     )(x)
 

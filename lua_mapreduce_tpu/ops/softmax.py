@@ -64,6 +64,9 @@ def _rowwise_pallas(x, kernel, block_rows=256, interpret=False):
         out_specs=pl.BlockSpec((block_rows, x2.shape[1]), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        # each row block is independent — let Mosaic parallelize
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
         interpret=interpret,
     )(x2)
     return out[:m, :n].reshape(orig_shape)
