@@ -141,6 +141,8 @@ def run(n_workers: int = 4, corpus_dir: str = "/tmp/wc_corpus") -> dict:
 if __name__ == "__main__":
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 4
     d = sys.argv[2] if len(sys.argv) > 2 else "/tmp/wc_corpus"
+    if len(sys.argv) > 3:       # scaled-pool runs keep their own artifact
+        RESULTS = os.path.abspath(sys.argv[3])   # noqa: F811
     result = run(n, d)
     # second leg: same engine with the native layer killed
     # (LMR_DISABLE_NATIVE=1) — the honest within-framework measure of
