@@ -98,6 +98,10 @@ def main() -> None:
     ap.add_argument("--ckpt", default=None,
                     help="storage spec for checkpoints, e.g. shared:/tmp/lm")
     ap.add_argument("--ckpt-every", type=int, default=10)
+    ap.add_argument("--resume", action="store_true",
+                    help="continue from --ckpt's lm.ckpt if present; "
+                         "batches are per-step seeded, so the resumed "
+                         "run is exactly the run that never stopped")
     ap.add_argument("--data", default=None,
                     help="char-level real-text mode: a text file path, "
                          f"or '{REPO_DOCS}' for this repo's docs "
@@ -117,9 +121,10 @@ def main() -> None:
             json.dump(summary, f, indent=1)
             f.write("\n")
     if args.target_loss is not None and not summary["reached_target"]:
+        final = summary["losses"][-1][1] if summary["losses"] else "n/a"
         raise SystemExit(
             f"target loss {args.target_loss} not reached in "
-            f"{args.steps} steps (final {summary['losses'][-1][1]})")
+            f"{args.steps} steps (final {final})")
 
 
 def run(args) -> dict:
@@ -176,13 +181,33 @@ def run(args) -> dict:
     store = get_storage_from(args.ckpt) if args.ckpt else None
     data = load_corpus(args.data) if args.data else None
     target = getattr(args, "target_loss", None)
-    rng = np.random.RandomState(0)
+    start_step = 0
+    if (store is not None and getattr(args, "resume", False)
+            and store.exists("lm.ckpt")):
+        # resume-EXACT: the checkpoint carries (params, opt_state, step);
+        # batches are derived per-step from the seed below, so a resumed
+        # run replays the identical remaining data stream — continuing
+        # from step k is bit-for-bit the run that never stopped
+        # (the reference's task-doc resume matrix, applied to the LM)
+        tmpl = {"params": params, "opt": opt_state,
+                "step": jnp.zeros((), jnp.int32)}
+        # strict load: a checkpoint from a different run configuration
+        # (other dtype policy, other zero1 sharding) must fail HERE with
+        # the loader's clear message, not deep inside the first step
+        state = ckpt.load_pytree(store, "lm.ckpt", tmpl,
+                                 check_shapes=True, check_dtypes=True)
+        params, opt_state = state["params"], state["opt"]
+        start_step = int(state["step"])
+        print(f"resumed from checkpoint at step {start_step}", flush=True)
     losses = []
     reached = target is None
     t0 = time.time()
     warm_t0 = None              # tokens/sec excludes the compile step
-    i = 0
-    for i in range(1, args.steps + 1):
+    i = start_step
+    for i in range(start_step + 1, args.steps + 1):
+        # per-step seeded batches (not one sequential stream): resume at
+        # step k sees exactly the batches steps k+1.. would have seen
+        rng = np.random.RandomState(1000 + 7919 * i)
         if data is not None:
             toks, tgts = corpus_batch(rng, data, args.batch, args.seq)
         else:
@@ -191,12 +216,12 @@ def run(args) -> dict:
         params, opt_state, loss = step(
             params, opt_state,
             *tfm.shard_batch(mesh, toks, tgts, schedule=schedule))
-        if i == 1:
+        if i == start_step + 1:
             warm_t0 = time.time()
         # loss is only fetched (device→host sync) on the print cadence —
         # a per-step fetch would serialize async dispatch and the
         # reported tokens/sec would measure the synchronized regime
-        if i == 1 or i % 5 == 0 or i == args.steps:
+        if i == start_step + 1 or i % 5 == 0 or i == args.steps:
             lf = float(loss)
             losses.append((i, round(lf, 4)))
             print(f"step {i:4d}  loss {lf:.4f}  "
@@ -207,25 +232,33 @@ def run(args) -> dict:
                       flush=True)
                 break
         if store is not None and i % args.ckpt_every == 0:
-            ckpt.save_pytree(store, "lm.ckpt", (params, opt_state))
+            ckpt.save_pytree(store, "lm.ckpt",
+                             {"params": params, "opt": opt_state,
+                              "step": jnp.asarray(i, jnp.int32)})
             print(f"  checkpoint @ step {i}", flush=True)
     jax.block_until_ready(params)   # CPU backends: don't overlap the
     #                                   decode program with in-flight
     #                                   train collectives
+    ran_any = i > start_step
     steps_done = i
     toks_per_step = args.batch * args.seq
     warm_s = time.time() - (warm_t0 or t0)
-    tokens_per_sec = (toks_per_step * max(0, steps_done - 1)
-                      / max(warm_s, 1e-9))
-    print(f"done: final loss {float(loss):.4f} "
-          f"({args.attn} attention, dp={args.dp} sp={args.sp}, "
-          f"grad_accum={args.grad_accum}, remat=on"
-          + (", llama-style" if args.modern else "")
-          + (f", window={args.window}" if args.window else "")
-          + (", zero1" if args.zero1 else "")
-          + (", bf16+f32-master" if args.bf16 else "") + ")")
+    tokens_per_sec = (toks_per_step * max(0, steps_done - start_step - 1)
+                      / max(warm_s, 1e-9)) if ran_any else 0.0
+    if ran_any:
+        print(f"done: final loss {float(loss):.4f} "
+              f"({args.attn} attention, dp={args.dp} sp={args.sp}, "
+              f"grad_accum={args.grad_accum}, remat=on"
+              + (", llama-style" if args.modern else "")
+              + (f", window={args.window}" if args.window else "")
+              + (", zero1" if args.zero1 else "")
+              + (", bf16+f32-master" if args.bf16 else "") + ")")
 
-    if data is None:
+    if not ran_any:                 # resumed at/past the whole budget:
+        sample = None               # params are loaded, nothing to train
+        print(f"checkpoint already at step {start_step} >= --steps "
+              f"{args.steps}; nothing to train", flush=True)
+    elif data is None:
         # generate: parallel prompt prefill + KV-cached greedy decode
         prompt = np.arange(8, dtype=np.int32)[None, :] % cfg.vocab
         out = np.asarray(tfm.greedy_decode(
@@ -252,6 +285,7 @@ def run(args) -> dict:
         "data": args.data or "synthetic-stride",
         "losses": losses,
         "steps": steps_done,
+        "resumed_at": start_step or None,
         "reached_target": reached,
         "target_loss": target,
         "tokens_per_sec": round(tokens_per_sec, 1),

@@ -247,3 +247,41 @@ def test_char_lm_converges_on_real_text():
     summary = run(args)
     assert summary["reached_target"], summary["losses"]
     assert summary["losses"][0][1] > 3.4     # started near ln(64)
+
+
+@pytest.mark.heavy
+def test_lm_resume_is_exact(tmp_path):
+    """Kill-and-resume equals never-stopped (the reference's resume
+    matrix applied to the LM family): a 40-step run and a 20-step run
+    resumed for the back 20 must produce IDENTICAL logged losses on the
+    shared steps — per-step seeded batches + checkpointed
+    (params, opt_state, step) leave no divergence anywhere."""
+    import argparse
+
+    from examples.lm.train_lm import run
+
+    def mk(steps, resume):
+        return argparse.Namespace(
+            dp=4, sp=2, seq=64, batch=4, steps=steps, grad_accum=1,
+            attn="zigzag", kv_heads=0, modern=False, window=0,
+            zero1=False, bf16=False, ckpt=f"shared:{tmp_path}/ck",
+            ckpt_every=10, data=None, target_loss=None, out_json=None,
+            resume=resume)
+
+    straight = run(mk(40, resume=False))
+
+    import shutil
+    shutil.rmtree(tmp_path / "ck")
+    first = run(mk(20, resume=False))       # writes ckpt at step 20
+    second = run(mk(40, resume=True))       # resumes at 20, runs 21-40
+
+    assert second["resumed_at"] == 20, second
+    tail = {s: l for s, l in straight["losses"] if s > 20}
+    tail2 = {s: l for s, l in second["losses"] if s > 20}
+    # shared cadence steps must agree exactly
+    shared = set(tail) & set(tail2)
+    assert shared, (straight["losses"], second["losses"])
+    for s in sorted(shared):
+        assert tail[s] == tail2[s], (s, tail[s], tail2[s])
+    # and the front half really trained (sanity that first ran)
+    assert first["steps"] == 20
