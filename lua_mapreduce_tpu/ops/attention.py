@@ -104,6 +104,53 @@ def _tile_live(qi, ki, block_q: int, block_k: int, causal: bool,
     return live
 
 
+def _kv_clamp(qi, ki, *, block_q, block_k, causal, window, q_offset,
+              n_kv):
+    """Clamp a kv block index into q-block ``qi``'s LIVE range — the
+    dead-tile DMA elision. ``pl.when`` skips the masked COMPUTE, but the
+    pipeline still fetches every tile the index map names; re-mapping a
+    dead step onto the nearest live block makes consecutive indices
+    equal, and Pallas skips the copy when the index does not change.
+    Causal halves kv traffic; a sliding window cuts it to O(window/L).
+    Exactly _tile_live's algebra: live ⟹ clamp is the identity, so live
+    steps always see their own tile (pinned by the interpret-mode parity
+    suite across causal/window/offset/GQA)."""
+    if not (causal or window):
+        return ki
+    row0 = qi * block_q + q_offset
+    hi = ((row0 + block_q - 1) // block_k) if causal else n_kv - 1
+    lo = ((row0 - window + 1) // block_k) if window else 0
+    # bounds sanitization: a fully-dead geometry (every tile of this
+    # grid row pruned) may cross the bounds or push them out of range;
+    # the clamp must still emit an IN-RANGE index (any one — compute is
+    # skipped), never a negative or overflowing DMA offset
+    lo = jnp.clip(lo, 0, n_kv - 1)
+    hi = jnp.clip(hi, lo, n_kv - 1)
+    return jnp.clip(ki, lo, hi)
+
+
+def _q_clamp(qi, ki, *, block_q, block_k, causal, window, q_offset,
+             n_q):
+    """The dkv-kernel twin of _kv_clamp: clamp a q block index into kv
+    block ``ki``'s live range (q innermost there). Same liveness
+    algebra transposed: causal gives the LOWER bound (q blocks above
+    the diagonal are dead), the window gives the UPPER bound (q rows
+    too far past the kv block see nothing)."""
+    if not (causal or window):
+        return qi
+    lo = ((ki * block_k - q_offset) // block_q) if causal else 0
+    # strict inequality: row0 < ki·bk + bk - 1 + window - q_offset,
+    # so the last live block is (T - 1) // bq
+    hi = (((ki * block_k + block_k - 2 + window - q_offset) // block_q)
+          if window else n_q - 1)
+    # same bounds sanitization as _kv_clamp (hi can go NEGATIVE here
+    # when the kv block sits wholly behind the window — the banded
+    # ring's far hop): crossed bounds must still yield in-range indices
+    lo = jnp.clip(lo, 0, n_q - 1)
+    hi = jnp.clip(hi, lo, n_q - 1)
+    return jnp.clip(qi, lo, hi)
+
+
 def _attn_reference_xla(q, k, v, causal: bool, scale: float,
                         with_lse: bool = False, window: int = 0,
                         q_offset: int = 0):
@@ -113,6 +160,7 @@ def _attn_reference_xla(q, k, v, causal: bool, scale: float,
         v = jnp.repeat(v, group, axis=2)
     s = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    mask = None
     if causal or window:
         lq, lk = s.shape[-2], s.shape[-1]
         rows = jnp.arange(lq)[:, None]
@@ -120,6 +168,14 @@ def _attn_reference_xla(q, k, v, causal: bool, scale: float,
         mask = _tile_mask(rows, cols, causal, window, lk, q_offset)
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if mask is not None:
+        # a row with NO visible column (q_offset pushes it more than
+        # `window` past every key — the banded-ring far block) must emit
+        # ZERO, matching the kernel's convention (out 0, lse ≈ -inf, so
+        # ring merges weight it out); softmax over an all-masked row
+        # would otherwise return a meaningless uniform average
+        p = jnp.where(jnp.any(mask, axis=-1)[None, None, :, None],
+                      p, 0.0)
     out32 = jnp.einsum("bhlm,bmhd->blhd", p, v.astype(jnp.float32))
     if not with_lse:
         return out32.astype(q.dtype)
@@ -255,6 +311,10 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
     n_kv = kb.shape[1] // block_k
 
     kern = _flash_kernel if with_lse else _flash_kernel_nolse
+    clamp = functools.partial(_kv_clamp, block_q=block_q,
+                              block_k=block_k, causal=causal,
+                              window=window, q_offset=q_offset,
+                              n_kv=n_kv)
     spec_o = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
                           memory_space=pltpu.VMEM)
     spec_lse = pl.BlockSpec((1, block_q, _LANES),
@@ -277,10 +337,12 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d),
-                         lambda bh, qi, ki: (_kv_row(bh, h, hkv), ki, 0),
+                         lambda bh, qi, ki: (_kv_row(bh, h, hkv),
+                                             clamp(qi, ki), 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d),
-                         lambda bh, qi, ki: (_kv_row(bh, h, hkv), ki, 0),
+                         lambda bh, qi, ki: (_kv_row(bh, h, hkv),
+                                             clamp(qi, ki), 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[spec_o, spec_lse] if with_lse else [spec_o],
@@ -457,13 +519,23 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
     # row operands (lse, Δ) ride lane-replicated — see _LANES
     lse_r = _lane_rep(lse)
     delta_r = _lane_rep(delta)
+    # dead-tile DMA elision (see _kv_clamp/_q_clamp): dq walks kv
+    # innermost, dkv walks q innermost — each clamps its innermost
+    # operand maps onto the live band
+    kvc = functools.partial(_kv_clamp, block_q=block_q, block_k=block_k,
+                            causal=causal, window=window,
+                            q_offset=q_offset, n_kv=n_kv)
+    qc = functools.partial(_q_clamp, block_q=block_q, block_k=block_k,
+                           causal=causal, window=window,
+                           q_offset=q_offset, n_q=n_q)
     spec_q = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
                           memory_space=pltpu.VMEM)
     spec_row = pl.BlockSpec((1, block_q, _LANES),
                             lambda bh, i, j: (bh, i, 0),
                             memory_space=pltpu.VMEM)
     spec_kv = pl.BlockSpec(
-        (1, block_k, d), lambda bh, i, j: (_kv_row(bh, h, hkv), j, 0),
+        (1, block_k, d),
+        lambda bh, i, j: (_kv_row(bh, h, hkv), kvc(i, j), 0),
         memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
@@ -485,11 +557,12 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
         return (bhkv // hkv) * h + (bhkv % hkv) * group + i // n_q
 
     spec_q2 = pl.BlockSpec(
-        (1, block_q, d), lambda bh, j, i: (q_row(bh, i), i % n_q, 0),
+        (1, block_q, d),
+        lambda bh, j, i: (q_row(bh, i), qc(i % n_q, j), 0),
         memory_space=pltpu.VMEM)
     spec_row2 = pl.BlockSpec(
         (1, block_q, _LANES),
-        lambda bh, j, i: (q_row(bh, i), i % n_q, 0),
+        lambda bh, j, i: (q_row(bh, i), qc(i % n_q, j), 0),
         memory_space=pltpu.VMEM)
     spec_kv2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0),
                             memory_space=pltpu.VMEM)

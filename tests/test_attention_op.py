@@ -213,3 +213,41 @@ class TestFusedBackward:
         want = np.log(np.sum(np.exp(s), axis=-1))      # (b, h, l)
         got = np.asarray(lse).reshape(b, h, l)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_dma_elision_clamps_are_exact_and_in_range():
+    """The dead-tile DMA elision's two safety invariants, exhaustively
+    over awkward geometries (incl. the banded-ring far hop where
+    q_offset > window + block_k, which once drove _q_clamp's upper
+    bound NEGATIVE): (a) a clamped index is always in range — an
+    out-of-range block index becomes a wild DMA offset on hardware
+    while interpret mode silently wraps; (b) on every LIVE tile the
+    clamp is the identity — a clamped live step would silently compute
+    on the wrong tile."""
+    import numpy as np
+
+    from lua_mapreduce_tpu.ops.attention import (_kv_clamp, _q_clamp,
+                                                 _tile_live)
+
+    geoms = [
+        # (block_q, block_k, causal, window, q_offset, n_q, n_kv)
+        (128, 128, True, 0, 0, 8, 8),
+        (64, 128, True, 50, 128, 6, 3),
+        (128, 128, True, 50, 512, 4, 4),     # far hop: hi < 0 regression
+        (64, 128, True, 1, 0, 8, 4),         # window=1 off-by-one case
+        (128, 256, True, 300, 1024, 8, 4),
+        (8, 128, True, 17, 40, 5, 2),
+    ]
+    for bq, bk, causal, window, qo, n_q, n_kv in geoms:
+        for qi in range(n_q):
+            for ki in range(n_kv):
+                kw = dict(block_q=bq, block_k=bk, causal=causal,
+                          window=window, q_offset=qo)
+                kc = int(_kv_clamp(qi, ki, n_kv=n_kv, **kw))
+                qc = int(_q_clamp(qi, ki, n_q=n_q, **kw))
+                assert 0 <= kc < n_kv, (kw, qi, ki, kc)
+                assert 0 <= qc < n_q, (kw, qi, ki, qc)
+                live = _tile_live(qi, ki, bq, bk, causal, window, qo)
+                if live is not None and bool(np.asarray(live)):
+                    assert kc == ki, ("live tile re-mapped", kw, qi, ki)
+                    assert qc == qi, ("live tile re-mapped", kw, qi, ki)

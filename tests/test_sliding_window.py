@@ -306,3 +306,42 @@ class TestBandedRing:
         want = 3.0 * cfg.n_layers * (4.0 * l * d * 0.5 -
                                      4.0 * d * visible)
         assert abs(diff - want) < 1e-6
+
+
+class TestEmptyRows:
+    def test_rows_past_window_emit_zero_both_backends(self):
+        """Banded-ring far-block geometry: q rows pushed more than
+        `window` past every kv column have an EMPTY visible set. The
+        kernel emits zeros (lse ~ -inf, so ring merges weight the
+        partial out); the XLA oracle must match instead of returning
+        softmax's meaningless uniform average over an all-masked row —
+        the two paths' convention for empty rows is part of the
+        contract now (round 4: found by driving block_q=64 with a
+        misaligned offset; no prior test had an empty row)."""
+        import jax
+        import jax.numpy as jnp
+
+        from lua_mapreduce_tpu.ops import flash_attention
+
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(kq, (1, 160, 2, 64))
+        k = jax.random.normal(kk, (1, 160, 2, 64))
+        v = jax.random.normal(kv, (1, 160, 2, 64))
+        kw = dict(causal=True, window=50, q_offset=128)
+        a = flash_attention(q, k, v, backend="pallas_interpret",
+                            block_q=64, **kw)
+        b = flash_attention(q, k, v, backend="xla", **kw)
+        # rows 0..21 (global 128..149) still see keys; global rows from
+        # 160+50-1... exactly: global row r sees cols (r-50, min(r, 159)];
+        # empty once r - 50 >= 160 - 1 -> r >= 209 -> local row >= 81
+        assert float(jnp.max(jnp.abs(a - b))) < 3e-5
+        tail = jnp.abs(a[0, 90:])                 # deep in the empty zone
+        assert float(tail.max()) == 0.0, "empty rows must emit zero"
+        # gradients agree too (empty rows contribute nothing)
+        ga = jax.grad(lambda *x: flash_attention(
+            *x, backend="pallas_interpret", block_q=64, **kw).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(lambda *x: flash_attention(
+            *x, backend="xla", **kw).sum(), argnums=(0, 1, 2))(q, k, v)
+        for x, y in zip(ga, gb):
+            assert float(jnp.max(jnp.abs(x - y))) < 1e-3
