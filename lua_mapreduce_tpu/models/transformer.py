@@ -85,6 +85,9 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_capacity: int = 0
     moe_aux_weight: float = 0.01
+    # experts each token is routed to: 1 = switch, >1 = Mixtral-style
+    # top-k with combine weights renormalized over the selected k
+    moe_top_k: int = 1
     # rematerialization: recompute each block in the backward pass
     # instead of saving its activations — trades ~1/3 more FLOPs for
     # O(n_layers) less activation HBM, the standard long-context lever
@@ -168,6 +171,11 @@ def _check_moe(cfg: TransformerConfig, n_ep: Optional[int] = None) -> None:
         raise ValueError(
             "moe_experts > 0 requires an explicit moe_capacity (it is "
             "per routing group; see TransformerConfig)")
+    if cfg.moe_top_k < 1:
+        raise ValueError(f"moe_top_k must be >= 1, got {cfg.moe_top_k}")
+    if cfg.moe_experts and cfg.moe_top_k > cfg.moe_experts:
+        raise ValueError(f"moe_top_k={cfg.moe_top_k} exceeds "
+                         f"moe_experts={cfg.moe_experts}")
     if n_ep is not None and cfg.moe_experts % n_ep:
         raise ValueError(f"moe_experts={cfg.moe_experts} not divisible "
                          f"by the expert-parallel axis size {n_ep}")
@@ -274,11 +282,13 @@ def _ffn(params: Params, p: str, y, cfg: TransformerConfig,
     flat = y.reshape(t, d)
     if moe_axis is None:
         out, aux = _moe.moe_ffn_reference(params, flat, capacity=cap,
-                                          prefix=f"{p}_moe")
+                                          prefix=f"{p}_moe",
+                                          top_k=cfg.moe_top_k)
     else:
         out, aux = _moe.moe_ffn_shard(params, flat, capacity=cap,
                                       ep_axis=moe_axis,
-                                      prefix=f"{p}_moe")
+                                      prefix=f"{p}_moe",
+                                      top_k=cfg.moe_top_k)
     return out.reshape(b, l, d), aux
 
 

@@ -53,26 +53,55 @@ def init_moe(key, d_model: int, d_ff: int, n_experts: int,
     }
 
 
-def _route(x, router_w, n_experts: int, capacity: int):
-    """Top-1 routing with capacity: returns (dispatch (T,E,C) one-hot,
+def _route(x, router_w, n_experts: int, capacity: int, top_k: int = 1):
+    """Top-k routing with capacity: returns (dispatch (T,E,C) one-hot,
     combine (T,E,C) gate-weighted, aux_loss scalar). x is the flat
-    (T, d) token tile of ONE device."""
+    (T, d) token tile of ONE device.
+
+    ``top_k=1`` is the switch transformer; ``top_k>1`` is the
+    Mixtral-style generalization: each token is dispatched to its k
+    highest-gated experts, combine weights RENORMALIZED over the
+    selected k (pre-drop, so a capacity-dropped expert's share is lost
+    through the residual rather than silently inflating the survivor).
+    Capacity is per (expert, tile) across ALL k rounds — round j's
+    tokens take slots after rounds < j's, so total bucket occupancy
+    never exceeds C and the dispatch einsum shapes stay static."""
     gates = jax.nn.softmax(x.astype(jnp.float32) @ router_w.astype(
         jnp.float32), axis=-1)                          # (T, E)
-    expert = jnp.argmax(gates, axis=-1)                 # (T,)
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)
-    # position of each token within its expert's bucket (token order)
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # (T, E)
-    kept = onehot * (pos < capacity)                    # drop overflow
-    pos_c = jax.nn.one_hot(jnp.sum(pos, axis=-1).astype(jnp.int32),
-                           capacity, dtype=jnp.float32)  # (T, C)
-    dispatch = kept[:, :, None] * pos_c[:, None, :]     # (T, E, C)
-    gate = jnp.sum(gates * kept, axis=-1)               # (T,) kept gate
-    combine = dispatch * gate[:, None, None]
-    # switch aux loss: E * Σ_e fraction_routed_e * mean_prob_e
-    frac = jnp.mean(onehot, axis=0)
+    t = x.shape[0]
+    counts = jnp.zeros((n_experts,), jnp.float32)       # slots used
+    dispatch = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    sel_sum = jnp.zeros((t,), jnp.float32)              # renorm denom
+    frac = jnp.zeros((n_experts,), jnp.float32)
+    # all k choices in ONE top_k call — iterated argmax-and-mask over
+    # softmax probs re-picks expert 0 when non-selected gates underflow
+    # to exactly 0.0 (router margin > ~103 nats), silently consuming a
+    # foreign expert's capacity slot
+    _, topk_idx = jax.lax.top_k(gates, top_k)           # (T, k)
+    for j in range(top_k):
+        expert = topk_idx[:, j]                         # (T,)
+        onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)
+        # position of each token within its expert's bucket: this
+        # round's token order, offset by earlier rounds' occupancy
+        pos = ((jnp.cumsum(onehot, axis=0) - 1.0)
+               + counts[None, :]) * onehot              # (T, E)
+        kept = onehot * (pos < capacity)                # drop overflow
+        counts = counts + jnp.sum(kept, axis=0)
+        pos_c = jax.nn.one_hot(jnp.sum(pos, axis=-1).astype(jnp.int32),
+                               capacity, dtype=jnp.float32)  # (T, C)
+        disp_j = kept[:, :, None] * pos_c[:, None, :]   # (T, E, C)
+        gate_j = jnp.sum(gates * kept, axis=-1)         # (T,) kept gate
+        dispatch = dispatch + disp_j
+        combine = combine + disp_j * gate_j[:, None, None]
+        sel_sum = sel_sum + jnp.sum(gates * onehot, axis=-1)
+        frac = frac + jnp.mean(onehot, axis=0)
+    if top_k > 1:
+        combine = combine / jnp.maximum(sel_sum, 1e-9)[:, None, None]
+    # switch aux loss generalized: E * Σ_e (fraction routed_e / k) ×
+    # mean_prob_e (reduces to the switch loss at k = 1)
     prob = jnp.mean(gates, axis=0)
-    aux = n_experts * jnp.sum(frac * prob)
+    aux = n_experts * jnp.sum((frac / top_k) * prob)
     return dispatch, combine, aux
 
 
@@ -84,7 +113,7 @@ def _expert_ffn(w1, b1, w2, b2, x):
 
 
 def _moe_ffn(params: Params, x, capacity: int, prefix: str,
-             ep_axis) -> Tuple[jnp.ndarray, jnp.ndarray]:
+             ep_axis, top_k: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One body for both forms — ``ep_axis=None`` keeps everything local
     (the oracle); a mesh axis inserts the two all_to_all shuffles. The
     two forms are contractually golden-diffed, so they MUST share this
@@ -92,7 +121,8 @@ def _moe_ffn(params: Params, x, capacity: int, prefix: str,
     w = {k[len(prefix) + 1:]: v for k, v in params.items()
          if k.startswith(prefix + "_")}
     n_experts = w["router_W"].shape[1]          # GLOBAL expert count
-    dispatch, combine, aux = _route(x, w["router_W"], n_experts, capacity)
+    dispatch, combine, aux = _route(x, w["router_W"], n_experts, capacity,
+                                    top_k=top_k)
     xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
     if ep_axis is not None:
         # (E, C, d) → (E/ep, ep·C, d): device p receives every peer's
@@ -116,15 +146,15 @@ def _moe_ffn(params: Params, x, capacity: int, prefix: str,
 
 
 def moe_ffn_reference(params: Params, x, *, capacity: int,
-                      prefix: str = "moe") -> Tuple[jnp.ndarray,
-                                                    jnp.ndarray]:
+                      prefix: str = "moe", top_k: int = 1
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Single-device oracle: (T, d) tokens → ((T, d) out, aux loss)."""
-    return _moe_ffn(params, x, capacity, prefix, None)
+    return _moe_ffn(params, x, capacity, prefix, None, top_k=top_k)
 
 
 def moe_ffn_shard(params: Params, x, *, capacity: int, ep_axis: str,
-                  prefix: str = "moe") -> Tuple[jnp.ndarray,
-                                                jnp.ndarray]:
+                  prefix: str = "moe", top_k: int = 1
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Expert-parallel form (inside shard_map): router weights are
     replicated, expert weights are LOCAL slices (E/ep experts per
     device); two all_to_alls move token buckets out and back.
@@ -134,4 +164,4 @@ def moe_ffn_shard(params: Params, x, *, capacity: int, ep_axis: str,
     reference run over the concatenated tiles with per-tile routing
     produces identical outputs (the golden-diff in tests).
     """
-    return _moe_ffn(params, x, capacity, prefix, ep_axis)
+    return _moe_ffn(params, x, capacity, prefix, ep_axis, top_k=top_k)
