@@ -282,6 +282,32 @@ def bench_flash_grad(b, heads, seq, d, causal, dtype):
     return _bench_pair(make)
 
 
+def bench_q8_matmul(m, k, n):
+    """Weight-only int8 matmul at decode shapes (ops/q8.py): the pallas
+    kernel streams int8 weight tiles; the XLA side is the bf16 matmul it
+    replaces (the serving baseline), so speedup_pallas_vs_xla IS the
+    weight-traffic win at memory-bound shapes (ideal ≈ 2×)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lua_mapreduce_tpu import ops
+
+    def make():
+        kx, kw = jax.random.split(jax.random.PRNGKey(0))
+        x = jax.random.normal(kx, (m, k), jnp.bfloat16)
+        w = jax.random.normal(kw, (k, n), jnp.float32)
+        q, s = ops.quantize_q8(w)
+        wb = w.astype(jnp.bfloat16)
+        sv = s.reshape(-1)
+        flops = 2.0 * m * k * n
+        return (lambda x, q, sv, wb: ops.q8_matmul(x, q, sv,
+                                                   backend="pallas"),
+                lambda x, q, sv, wb: (x @ wb),
+                (x, q, sv, wb), flops)
+
+    return _bench_pair(make)
+
+
 def bench_softmax(rows, cols, dtype, block_rows=256):
     # block_rows * cols * dtype must fit scoped VMEM (16MB on v5e);
     # vocab-wide rows (32k) need a shorter block
@@ -635,6 +661,10 @@ def main() -> None:
             # vocab-wide rows need short blocks to fit scoped VMEM
             "log_softmax_8192x32768": lambda: bench_softmax(
                 8192, 32768, bf16, block_rows=64),
+            # weight-only int8 at decode matvec shapes (ops/q8.py):
+            # batch-8 tokens against an LM FFN weight
+            "q8_matvec_b8_4096x16384": lambda: bench_q8_matmul(
+                8, 4096, 16384),
             "maxpool_b256_64x64x32": lambda: bench_pool(256, 64, 64, 32,
                                                         bf16),
             # whole-train-step: the long-context LM family end to end

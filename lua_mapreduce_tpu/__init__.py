@@ -66,6 +66,10 @@ def utest():
     from lua_mapreduce_tpu.store import memfs, router
     from lua_mapreduce_tpu.utils import stats
 
+    # host-path modules ONLY: the sweep runs in the ambient env (test.sh)
+    # where any jax compute would initialize — and hang on — a wedged
+    # accelerator tunnel; jax-computing modules (ops/*) self-test under
+    # the cpu-pinned pytest conftest instead (tests/test_q8.py etc.)
     for mod in (tuples, heap, serialize, merge, jobstore, memfs, contract,
                 router, persistent_table, stats, worker, server):
         if hasattr(mod, "utest"):

@@ -46,6 +46,11 @@ _TPU_AUTO_POLICY = {
     "maxpool2d": "pallas",
     "avgpool2d": "pallas",
     "flash_attention": "pallas",
+    # weight-only int8: the kernel is the POINT (int8 tiles streamed
+    # from HBM, dequant in VMEM) — the XLA composition materializes a
+    # dequantized bf16 copy that jit hoists out of decode loops,
+    # forfeiting the halved weight traffic the op exists for
+    "q8_matmul": "pallas",
 }
 
 
@@ -70,9 +75,11 @@ from lua_mapreduce_tpu.ops.softmax import log_softmax, softmax  # noqa: E402
 from lua_mapreduce_tpu.ops.conv import conv2d  # noqa: E402
 from lua_mapreduce_tpu.ops.pool import avgpool2d, maxpool2d  # noqa: E402
 from lua_mapreduce_tpu.ops.attention import flash_attention  # noqa: E402
+from lua_mapreduce_tpu.ops.q8 import q8_matmul, quantize_q8  # noqa: E402
 
 __all__ = [
     "default_backend", "resolve_backend",
     "matmul", "log_softmax", "softmax", "conv2d",
     "maxpool2d", "avgpool2d", "flash_attention",
+    "q8_matmul", "quantize_q8",
 ]

@@ -1,0 +1,83 @@
+"""Weight-only int8 matmul (ops/q8.py): quantization error bounds,
+kernel-vs-oracle parity, shape handling, and validation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lua_mapreduce_tpu import ops
+from lua_mapreduce_tpu.ops.q8 import _dequant_matmul_xla
+
+
+def _wx(seed, m, k, n):
+    rng = np.random.RandomState(seed)
+    w = jnp.asarray(rng.randn(k, n).astype(np.float32))
+    x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+    return w, x
+
+
+def test_quantize_roundtrip_error_bound():
+    w, _ = _wx(0, 1, 128, 256)
+    q, s = ops.quantize_q8(w)
+    assert q.dtype == jnp.int8 and s.shape == (1, 256)
+    # symmetric per-channel: error <= half a quantization step per entry
+    step = np.asarray(s)[0]
+    err = np.abs(np.asarray(q, np.float32) * np.asarray(s) -
+                 np.asarray(w))
+    assert (err <= step[None, :] * 0.5 + 1e-7).all()
+
+
+def test_kernel_matches_oracle_bf16_matched():
+    """Interpret kernel vs the SAME-precision oracle (bf16 x, f32
+    accumulate, post-scale): agreement to accumulation noise."""
+    w, x = _wx(1, 4, 300, 500)               # ragged: padding paths
+    q, s = ops.quantize_q8(w)
+    got = ops.q8_matmul(x, q, s.reshape(-1),
+                        backend="pallas_interpret")
+    want = _dequant_matmul_xla(x.astype(jnp.bfloat16), q,
+                               s.reshape(1, -1))
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_quantized_matmul_close_to_full_precision():
+    """End-to-end quantization error at the op level stays small
+    relative to the output scale (the serving-accuracy argument)."""
+    w, x = _wx(2, 8, 512, 256)
+    q, s = ops.quantize_q8(w)
+    got = ops.q8_matmul(x, q, s.reshape(-1), backend="xla")
+    want = x @ w
+    denom = float(jnp.std(want))
+    rel = float(jnp.max(jnp.abs(got - want))) / denom
+    assert rel < 0.05, rel
+
+
+def test_single_row_matvec():
+    w, x = _wx(3, 1, 256, 128)               # the decode matvec shape
+    q, s = ops.quantize_q8(w)
+    got = ops.q8_matmul(x, q, s.reshape(-1),
+                        backend="pallas_interpret")
+    want = _dequant_matmul_xla(x.astype(jnp.bfloat16), q,
+                               s.reshape(1, -1))
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_validation():
+    w, x = _wx(4, 2, 64, 32)
+    q, s = ops.quantize_q8(w)
+    with pytest.raises(ValueError, match="int8"):
+        ops.q8_matmul(x, w, s.reshape(-1))
+    with pytest.raises(ValueError, match="contraction"):
+        ops.q8_matmul(x[:, :32], q, s.reshape(-1))
+    with pytest.raises(ValueError, match="channels"):
+        ops.q8_matmul(x, q, s.reshape(-1)[:16])
+
+
+def test_module_utest():
+    from lua_mapreduce_tpu.ops import q8
+
+    q8.utest()

@@ -145,3 +145,21 @@ def test_export_actually_enforces_block_legality():
     x = jax.ShapeDtypeStruct((4, 256, 128), jnp.float32)
     with pytest.raises(ValueError, match="divisible by 8 and 128"):
         export_tpu(f, x)
+
+
+class TestQ8Lowering:
+    def test_q8_matmul_decode_shapes(self):
+        x = jax.ShapeDtypeStruct((8, 4096), jnp.bfloat16)
+        q = jax.ShapeDtypeStruct((4096, 16384), jnp.int8)
+        s = jax.ShapeDtypeStruct((16384,), jnp.float32)
+        export_tpu(lambda x, q, s: ops.q8_matmul(x, q, s,
+                                                 backend="pallas"),
+                   x, q, s)
+
+    def test_q8_matmul_ragged(self):
+        x = jax.ShapeDtypeStruct((1, 300), jnp.float32)
+        q = jax.ShapeDtypeStruct((300, 500), jnp.int8)
+        s = jax.ShapeDtypeStruct((500,), jnp.float32)
+        export_tpu(lambda x, q, s: ops.q8_matmul(x, q, s,
+                                                 backend="pallas"),
+                   x, q, s)
