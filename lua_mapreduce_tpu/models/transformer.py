@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from lua_mapreduce_tpu.ops.attention import _tile_mask, flash_attention
+from lua_mapreduce_tpu.ops.q8 import q8_matmul, quantize_q8
 from lua_mapreduce_tpu.parallel import moe as _moe
 from lua_mapreduce_tpu.parallel import zero1 as _z1
 from lua_mapreduce_tpu.parallel.pipeline import pipeline_apply
@@ -232,6 +233,41 @@ def init_transformer(key, cfg: TransformerConfig = TransformerConfig(),
     return params
 
 
+def _mm(params: Params, key: str, y):
+    """``y @ params[key]`` — through the weight-only int8 kernel when
+    the param dict carries a quantized entry (``key::q8`` +
+    ``key::scale``, see :func:`quantize_lm`). The branch is on dict
+    STRUCTURE, so it is resolved at trace time and costs nothing."""
+    qk = key + "::q8"
+    if qk in params:
+        shp = y.shape
+        out = q8_matmul(y.reshape(-1, shp[-1]), params[qk],
+                        params[key + "::scale"])
+        return out.reshape(*shp[:-1], out.shape[-1])
+    return y @ params[key]
+
+
+def quantize_lm(params: Params) -> Params:
+    """Weight-only int8 SERVING copy of an LM's parameters: every
+    per-block 2-D projection (qkv / out / ff*) is replaced by
+    ``name::q8`` (int8) + ``name::scale`` (f32 per output channel);
+    biases, norms, embeddings (and the tied head) stay full precision.
+    Use with the single-device inference paths (``greedy_decode``,
+    ``prefill``) — training and the sharded forward reject quantized
+    dicts loudly (the original keys are gone). ~4× smaller weights
+    than f32, ~2× less decode HBM traffic than bf16 (ops/q8.py)."""
+    out = {}
+    for k, v in params.items():
+        if (k.endswith("_W") and v.ndim == 2
+                and ("_qkv_" in k or "_out_" in k or "_ff" in k)):
+            q, s = quantize_q8(v)
+            out[k + "::q8"] = q
+            out[k + "::scale"] = s.reshape(-1)
+        else:
+            out[k] = v
+    return out
+
+
 def _layer_norm(x, g, b, eps=1e-5):
     mu = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
@@ -271,11 +307,12 @@ def _ffn(params: Params, p: str, y, cfg: TransformerConfig,
     reference routing when ``moe_axis`` is None). Returns (out, aux)."""
     if not cfg.moe_experts:
         if cfg.ffn == "swiglu":
-            gate = jax.nn.silu(y @ params[f"{p}_ff1_W"])
-            up = y @ params[f"{p}_ff3_W"]
-            return (gate * up) @ params[f"{p}_ff2_W"], 0.0
-        h = jax.nn.gelu(y @ params[f"{p}_ff1_W"] + params[f"{p}_ff1_b"])
-        return h @ params[f"{p}_ff2_W"] + params[f"{p}_ff2_b"], 0.0
+            gate = jax.nn.silu(_mm(params, f"{p}_ff1_W", y))
+            up = _mm(params, f"{p}_ff3_W", y)
+            return _mm(params, f"{p}_ff2_W", gate * up), 0.0
+        h = jax.nn.gelu(_mm(params, f"{p}_ff1_W", y)
+                        + params[f"{p}_ff1_b"])
+        return _mm(params, f"{p}_ff2_W", h) + params[f"{p}_ff2_b"], 0.0
     b, l, d = y.shape
     t = b * l
     cap = cfg.moe_capacity
@@ -309,7 +346,7 @@ def _block(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
     h, hd = cfg.n_heads, d // cfg.n_heads
     hkv = kv_heads(cfg)
     y = _norm(params, f"{p}_ln1", x, cfg)
-    qkv = y @ params[f"{p}_qkv_W"]              # (B, L, (H+2Hkv)·hd) MXU
+    qkv = _mm(params, f"{p}_qkv_W", y)          # (B, L, (H+2Hkv)·hd) MXU
     q = qkv[..., :h * hd].reshape(b, l, h, hd)
     k = qkv[..., h * hd:(h + hkv) * hd].reshape(b, l, hkv, hd)
     v = qkv[..., (h + hkv) * hd:].reshape(b, l, hkv, hd)
@@ -319,7 +356,7 @@ def _block(params: Params, i: int, x, cfg: TransformerConfig, attn_fn,
     if kv_sink is not None:
         kv_sink.append((k, v))
     a = attn_fn(q, k, v).reshape(b, l, d)
-    x = x + a @ params[f"{p}_out_W"]
+    x = x + _mm(params, f"{p}_out_W", a)
     y = _norm(params, f"{p}_ln2", x, cfg)
     out, aux = _ffn(params, p, y, cfg, moe_axis)
     return x + out, aux
@@ -554,7 +591,7 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
         for i in range(cfg.n_layers):
             pfx = f"L{i}"
             y = _norm(params, f"{pfx}_ln1", x, cfg)
-            qkv = y @ params[f"{pfx}_qkv_W"]
+            qkv = _mm(params, f"{pfx}_qkv_W", y)
             q = qkv[..., :h * hd].reshape(b, 1, h, hd)
             k = qkv[..., h * hd:(h + hkv) * hd].reshape(b, 1, hkv, hd)
             v = qkv[..., (h + hkv) * hd:].reshape(b, 1, hkv, hd)
@@ -589,7 +626,7 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
             a = jnp.einsum("bkgqm,bmkd->bqkgd", w.astype(cv.dtype), cv,
                            preferred_element_type=jnp.float32)
             a = a.astype(x.dtype).reshape(b, 1, cfg.d_model)
-            x = x + a @ params[f"{pfx}_out_W"]
+            x = x + _mm(params, f"{pfx}_out_W", a)
             y = _norm(params, f"{pfx}_ln2", x, cfg)
             ff, _ = _ffn(params, pfx, y, step_cfg, None)
             x = x + ff
