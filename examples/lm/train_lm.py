@@ -106,6 +106,16 @@ def main() -> None:
                     help="char-level real-text mode: a text file path, "
                          f"or '{REPO_DOCS}' for this repo's docs "
                          "(default: the synthetic stride task)")
+    ap.add_argument("--val-frac", type=float, default=0.1,
+                    help="corpus tail held out for validation "
+                         "(corpus mode only; 0 disables)")
+    ap.add_argument("--eval-every", type=int, default=25,
+                    help="steps between validation evals (corpus mode)")
+    ap.add_argument("--patience", type=int, default=0,
+                    help=">0: stop after this many evals without a new "
+                         "best validation loss (the reference's "
+                         "APRIL-ANN early-stopping discipline, "
+                         "common.lua:144-202)")
     ap.add_argument("--target-loss", type=float, default=None,
                     help="stop once train loss < target; --steps becomes "
                          "the max budget and the run FAILS (exit 1) if "
@@ -113,6 +123,9 @@ def main() -> None:
     ap.add_argument("--out-json", default=None,
                     help="write the run summary (loss curve, tokens/sec) "
                          "to this path")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="wrap the run in a jax.profiler device trace "
+                         "written to DIR (view with TensorBoard)")
     args = ap.parse_args()
     summary = run(args)
     if args.out_json:
@@ -128,9 +141,23 @@ def main() -> None:
 
 
 def run(args) -> dict:
+    import contextlib
+
     from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
     force_cpu_if_unavailable()
     import jax
+
+    # the trace starts AFTER the backend bootstrap above — entering it
+    # first would initialize (and possibly hang on) the tunnel backend
+    # before the CPU fallback could act
+    with contextlib.ExitStack() as _stack:
+        if getattr(args, "profile", None):
+            from lua_mapreduce_tpu.utils.profiling import device_trace
+            _stack.enter_context(device_trace(args.profile))
+        return _run_inner(args, jax)
+
+
+def _run_inner(args, jax) -> dict:
     import jax.numpy as jnp
     import optax
     from jax.sharding import Mesh
@@ -181,6 +208,33 @@ def run(args) -> dict:
     store = get_storage_from(args.ckpt) if args.ckpt else None
     data = load_corpus(args.data) if args.data else None
     target = getattr(args, "target_loss", None)
+    # validation: hold out the corpus TAIL (contiguous, so no train
+    # window ever overlaps it) and pin a fixed set of eval windows —
+    # the reference's train/validate split discipline for the LM family
+    val_frac = getattr(args, "val_frac", 0.0) if data is not None else 0.0
+    eval_every = max(1, getattr(args, "eval_every", 25) or 25)
+    patience = getattr(args, "patience", 0) or 0
+    val_batch = None
+    if val_frac > 0:
+        n_val = int(len(data) * val_frac)
+        if n_val < args.seq + 2:
+            raise SystemExit(
+                f"--val-frac {val_frac} keeps only {n_val} tokens — "
+                f"needs at least seq+2 = {args.seq + 2}")
+        train_data, val_data = data[:-n_val], data[-n_val:]
+        data = train_data
+        n_win = min(16, max(1, (len(val_data) - 1) // args.seq))
+        offs = np.linspace(0, len(val_data) - args.seq - 1, n_win,
+                           dtype=np.int64)
+        idx = offs[:, None] + np.arange(args.seq + 1)
+        vt = val_data[idx]
+        val_batch = (jnp.asarray(vt[:, :-1]), jnp.asarray(vt[:, 1:]))
+
+        @jax.jit
+        def val_loss_fn(p, toks, tgts):
+            logits = tfm.transformer_apply(p, toks, cfg=cfg)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tgts).mean()
     start_step = 0
     if (store is not None and getattr(args, "resume", False)
             and store.exists("lm.ckpt")):
@@ -200,6 +254,9 @@ def run(args) -> dict:
         start_step = int(state["step"])
         print(f"resumed from checkpoint at step {start_step}", flush=True)
     losses = []
+    val_losses = []
+    best_val, best_step, stopped_early = None, start_step, False
+    best_params = None
     reached = target is None
     t0 = time.time()
     warm_t0 = None              # tokens/sec excludes the compile step
@@ -231,6 +288,26 @@ def run(args) -> dict:
                 print(f"target loss {target} reached at step {i}",
                       flush=True)
                 break
+        if val_batch is not None and i % eval_every == 0:
+            # CPU backends: the train step's in-flight collectives must
+            # drain before another compiled program launches
+            jax.block_until_ready(params)
+            vl = float(val_loss_fn(params, *val_batch))
+            val_losses.append((i, round(vl, 4)))
+            if best_val is None or vl < best_val:
+                best_val, best_step = vl, i
+                if patience:
+                    # the train step donates its param buffers, so a
+                    # live reference would dangle — snapshot to host
+                    best_params = jax.device_get(params)
+            print(f"  val  {i:4d}  loss {vl:.4f}"
+                  + ("  (best)" if best_step == i else ""), flush=True)
+            if patience and (i - best_step) >= patience * eval_every:
+                stopped_early = True
+                print(f"early stop at step {i}: no val improvement "
+                      f"since step {best_step} "
+                      f"({patience} evals)", flush=True)
+                break
         if store is not None and i % args.ckpt_every == 0:
             ckpt.save_pytree(store, "lm.ckpt",
                              {"params": params, "opt": opt_state,
@@ -239,6 +316,17 @@ def run(args) -> dict:
     jax.block_until_ready(params)   # CPU backends: don't overlap the
     #                                   decode program with in-flight
     #                                   train collectives
+    if patience and best_params is not None:
+        # the early-stopping DELIVERABLE is the best-validation model
+        # (common.lua:144-202's discipline, as train/harness.fit does):
+        # restore it for the final checkpoint, sample, and caller
+        params = jax.device_put(best_params)
+        if store is not None:
+            ckpt.save_pytree(store, "lm.ckpt",
+                             {"params": params, "opt": opt_state,
+                              "step": jnp.asarray(best_step, jnp.int32)})
+            print(f"  checkpoint restored to best-val step {best_step}",
+                  flush=True)
     ran_any = i > start_step
     steps_done = i
     toks_per_step = args.batch * args.seq
@@ -284,6 +372,10 @@ def run(args) -> dict:
     return {
         "data": args.data or "synthetic-stride",
         "losses": losses,
+        "val_losses": val_losses,
+        "best_val": best_val,
+        "best_step": best_step if best_val is not None else None,
+        "stopped_early": stopped_early,
         "steps": steps_done,
         "resumed_at": start_step or None,
         "reached_target": reached,

@@ -1,0 +1,41 @@
+"""Device-level tracing: the TPU-native deepening of utils/stats.py.
+
+The reference's observability is host-side phase timing (map/reduce
+cluster times, utils/stats.py's analog of server.lua's counters). On an
+accelerator the interesting time is INSIDE the jitted step — kernel
+schedules, collective overlap, HBM stalls — which only the XLA profiler
+sees. :func:`device_trace` wraps any region in a jax.profiler trace
+whose output TensorBoard (or xprof) renders; train_lm's ``--profile``
+flag wires it around the train loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Trace everything inside the ``with`` to ``log_dir`` (created if
+    missing). Traces include host Python annotations and, on TPU, the
+    device timeline; view with TensorBoard's profile plugin.
+
+    NOTE: entering the trace initializes the JAX backend — callers that
+    need the CPU fallback (utils/jax_env.force_cpu_if_unavailable) must
+    run it BEFORE this context, which is why train_lm starts its trace
+    inside run() after the bootstrap, never around it."""
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir):
+        yield log_dir
+
+
+def annotate(name: str):
+    """Named sub-span inside a device_trace (jax.profiler.TraceAnnotation
+    passthrough) — marks host-side phases so device ops group under
+    readable labels."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

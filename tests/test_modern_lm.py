@@ -3,6 +3,7 @@ preset), composing with GQA and every execution form. Discipline as
 everywhere: each sharded/incremental path golden-diffed against the
 single-device oracle."""
 
+import os
 import dataclasses
 
 import jax
@@ -285,3 +286,45 @@ def test_lm_resume_is_exact(tmp_path):
         assert tail[s] == tail2[s], (s, tail[s], tail2[s])
     # and the front half really trained (sanity that first ran)
     assert first["steps"] == 20
+
+
+@pytest.mark.heavy
+def test_char_lm_validation_tracking():
+    """Corpus-mode validation: a held-out tail is evaluated on a fixed
+    window set every eval_every steps; best-so-far tracking feeds the
+    reference-style early stopping (common.lua:144-202's discipline).
+    Learning must show up on the HELD-OUT split, not just train."""
+    import argparse
+
+    from examples.lm.train_lm import run
+
+    args = argparse.Namespace(
+        dp=4, sp=2, seq=64, batch=8, steps=60, grad_accum=1,
+        attn="zigzag", kv_heads=0, modern=True, window=0, zero1=False,
+        bf16=False, ckpt=None, ckpt_every=10, data="repo-docs",
+        target_loss=None, out_json=None, resume=False,
+        val_frac=0.1, eval_every=15, patience=0)
+    s = run(args)
+    assert len(s["val_losses"]) == 4, s["val_losses"]
+    first_val = s["val_losses"][0][1]
+    assert s["best_val"] is not None and s["best_val"] < first_val
+    assert s["best_step"] >= 15 and s["stopped_early"] is False
+
+
+def test_device_trace_writes_profile(tmp_path):
+    """utils/profiling.device_trace captures a jit region into a
+    TensorBoard-readable trace directory."""
+    import jax
+    import jax.numpy as jnp
+
+    from lua_mapreduce_tpu.utils.profiling import annotate, device_trace
+
+    d = str(tmp_path / "trace")
+    with device_trace(d):
+        with annotate("tiny-matmul"):
+            x = jnp.ones((64, 64))
+            jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    files = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+    assert files, "no trace output written"
+    assert any("trace" in f or f.endswith(".pb") or ".xplane." in f
+               for f in files), files
