@@ -248,14 +248,18 @@ def _mm(params: Params, key: str, y):
 
 
 def quantize_lm(params: Params) -> Params:
-    """Weight-only int8 SERVING copy of an LM's parameters: every
-    per-block 2-D projection (qkv / out / ff*) is replaced by
+    """Weight-only int8 SERVING copy of an LM's DENSE projection
+    weights: every per-block 2-D projection (qkv / out / ff*) is
+    replaced by
     ``name::q8`` (int8) + ``name::scale`` (f32 per output channel);
     biases, norms, embeddings (and the tied head) stay full precision.
     Use with the single-device inference paths (``greedy_decode``,
     ``prefill``) — training and the sharded forward reject quantized
     dicts loudly (the original keys are gone). ~4× smaller weights
-    than f32, ~2× less decode HBM traffic than bf16 (ops/q8.py)."""
+    than f32, ~2× less decode HBM traffic than bf16 (ops/q8.py).
+    MoE expert stacks (3-D, einsum-dispatched) and embeddings stay full
+    precision — for dense models the quantized projections are the
+    decode-bandwidth bulk."""
     out = {}
     for k, v in params.items():
         if (k.endswith("_W") and v.ndim == 2
