@@ -19,6 +19,12 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the sweep's candidate (block_q, block_k) schedules — module-level so
+# tests/test_tpu_lowering.py exports every one (fwd AND grad) and an
+# illegal candidate can never burn a hardware window
+CANDIDATES = [(64, 128), (128, 128), (128, 256), (256, 128), (256, 256),
+              (128, 512), (512, 128)]
 sys.path.insert(0, REPO)
 
 from benchmarks.kernel_bench import _call_overhead, _measure_op  # noqa: E402
@@ -74,8 +80,7 @@ def main():
         print(json.dumps({"skipped": "not on TPU"}))
         return
 
-    cands = [(64, 128), (128, 128), (128, 256), (256, 128), (256, 256),
-             (128, 512), (512, 128)]
+    cands = CANDIDATES
     results = {}
     for seq in (int(s) for s in args.seqs.split(",")):
         for grad in (False, True):

@@ -26,6 +26,21 @@ sys.path.insert(0, REPO)
 from benchmarks.kernel_bench import _call_overhead, _measure_op  # noqa: E402
 
 
+def candidates():
+    """Candidate (bm, bn, bk) schedules under the ~14 MB VMEM budget
+    (double-buffered bf16 A/B tiles + f32 accumulator + out tile) —
+    module-level so tests/test_tpu_lowering.py exports every one and an
+    illegal candidate can never burn a hardware window."""
+    out = []
+    for bm, bn in itertools.product((256, 512, 768, 1024), repeat=2):
+        for bk in (256, 512, 1024, 2048):
+            vmem = (2 * (bm * bk + bk * bn) * 2        # A,B bf16 ×2 buffers
+                    + bm * bn * 4 + bm * bn * 2)       # acc f32 + out
+            if vmem <= 14 * 2**20:
+                out.append((bm, bn, bk))
+    return out
+
+
 def time_config(n, bm, bn, bk, target_s=0.35):
     """Per-op seconds for an n³ bf16 matmul with the given blocks —
     measured through kernel_bench._measure_op, the single implementation
@@ -66,13 +81,7 @@ def main():
     sizes = [int(s) for s in args.sizes.split(",")]
     # candidate schedules: (bm, bn, bk); VMEM budget ~16 MB on v5e with
     # double-buffered A/B tiles + f32 accumulator + out tile
-    cands = []
-    for bm, bn in itertools.product((256, 512, 768, 1024), repeat=2):
-        for bk in (256, 512, 1024, 2048):
-            vmem = (2 * (bm * bk + bk * bn) * 2        # A,B bf16 ×2 buffers
-                    + bm * bn * 4 + bm * bn * 2)       # acc f32 + out
-            if vmem <= 14 * 2**20:
-                cands.append((bm, bn, bk))
+    cands = candidates()
 
     results = {}
     for n in sizes:

@@ -163,3 +163,33 @@ class TestQ8Lowering:
         export_tpu(lambda x, q, s: ops.q8_matmul(x, q, s,
                                                  backend="pallas"),
                    x, q, s)
+
+
+def test_every_tuner_candidate_lowers():
+    """The block-size sweeps (benchmarks/flash_tune.py, matmul_tune.py)
+    run on rare, short hardware windows — a Mosaic-illegal candidate
+    would burn the window on compile errors. Export every candidate
+    the tuners enumerate (shared module-level definitions, so the
+    tuners and this guard cannot drift), the flash ones through the
+    BACKWARD kernels too (the sweep times fwd+bwd)."""
+    from benchmarks.flash_tune import CANDIDATES as FLASH_CANDS
+    from benchmarks.matmul_tune import candidates as matmul_cands
+    from lua_mapreduce_tpu.ops.attention import _flash_pallas
+    from lua_mapreduce_tpu.ops.matmul import _matmul_pallas
+
+    q = jax.ShapeDtypeStruct((4, 2048, 8, 128), jnp.bfloat16)
+    for bq, bk in FLASH_CANDS:
+        export_tpu(lambda q_, k_, v_, bq=bq, bk=bk: _flash_pallas(
+            q_, k_, v_, True, block_q=bq, block_k=bk), q, q, q)
+
+        def loss(q_, k_, v_, bq=bq, bk=bk):
+            return ops.flash_attention(q_, k_, v_, causal=True,
+                                       backend="pallas", block_q=bq,
+                                       block_k=bk).sum()
+
+        export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+
+    a = jax.ShapeDtypeStruct((4096, 4096), jnp.bfloat16)
+    for bm, bn, bkk in matmul_cands():
+        export_tpu(lambda x, y, bm=bm, bn=bn, bkk=bkk: _matmul_pallas(
+            x, y, block_m=bm, block_n=bn, block_k=bkk), a, a)
