@@ -257,62 +257,71 @@ def _run_inner(args, jax) -> dict:
     val_losses = []
     best_val, best_step, stopped_early = None, start_step, False
     best_params = None
+    saver = ckpt.AsyncCheckpoint()
     reached = target is None
     t0 = time.time()
     warm_t0 = None              # tokens/sec excludes the compile step
     i = start_step
-    for i in range(start_step + 1, args.steps + 1):
-        # per-step seeded batches (not one sequential stream): resume at
-        # step k sees exactly the batches steps k+1.. would have seen
-        rng = np.random.RandomState(1000 + 7919 * i)
-        if data is not None:
-            toks, tgts = corpus_batch(rng, data, args.batch, args.seq)
-        else:
-            toks, tgts = synthetic_batch(rng, cfg.vocab, args.batch,
-                                         args.seq)
-        params, opt_state, loss = step(
-            params, opt_state,
-            *tfm.shard_batch(mesh, toks, tgts, schedule=schedule))
-        if i == start_step + 1:
-            warm_t0 = time.time()
-        # loss is only fetched (device→host sync) on the print cadence —
-        # a per-step fetch would serialize async dispatch and the
-        # reported tokens/sec would measure the synchronized regime
-        if i == start_step + 1 or i % 5 == 0 or i == args.steps:
-            lf = float(loss)
-            losses.append((i, round(lf, 4)))
-            print(f"step {i:4d}  loss {lf:.4f}  "
-                  f"({time.time() - t0:.1f}s)", flush=True)
-            if target is not None and lf < target:
-                reached = True
-                print(f"target loss {target} reached at step {i}",
-                      flush=True)
-                break
-        if val_batch is not None and i % eval_every == 0:
-            # CPU backends: the train step's in-flight collectives must
-            # drain before another compiled program launches
-            jax.block_until_ready(params)
-            vl = float(val_loss_fn(params, *val_batch))
-            val_losses.append((i, round(vl, 4)))
-            if best_val is None or vl < best_val:
-                best_val, best_step = vl, i
-                if patience:
-                    # the train step donates its param buffers, so a
-                    # live reference would dangle — snapshot to host
-                    best_params = jax.device_get(params)
-            print(f"  val  {i:4d}  loss {vl:.4f}"
-                  + ("  (best)" if best_step == i else ""), flush=True)
-            if patience and (i - best_step) >= patience * eval_every:
-                stopped_early = True
-                print(f"early stop at step {i}: no val improvement "
-                      f"since step {best_step} "
-                      f"({patience} evals)", flush=True)
-                break
-        if store is not None and i % args.ckpt_every == 0:
-            ckpt.save_pytree(store, "lm.ckpt",
+    try:
+        for i in range(start_step + 1, args.steps + 1):
+            # per-step seeded batches (not one sequential stream): resume at
+            # step k sees exactly the batches steps k+1.. would have seen
+            rng = np.random.RandomState(1000 + 7919 * i)
+            if data is not None:
+                toks, tgts = corpus_batch(rng, data, args.batch, args.seq)
+            else:
+                toks, tgts = synthetic_batch(rng, cfg.vocab, args.batch,
+                                             args.seq)
+            params, opt_state, loss = step(
+                params, opt_state,
+                *tfm.shard_batch(mesh, toks, tgts, schedule=schedule))
+            if i == start_step + 1:
+                warm_t0 = time.time()
+            # loss is only fetched (device→host sync) on the print cadence —
+            # a per-step fetch would serialize async dispatch and the
+            # reported tokens/sec would measure the synchronized regime
+            if i == start_step + 1 or i % 5 == 0 or i == args.steps:
+                lf = float(loss)
+                losses.append((i, round(lf, 4)))
+                print(f"step {i:4d}  loss {lf:.4f}  "
+                      f"({time.time() - t0:.1f}s)", flush=True)
+                if target is not None and lf < target:
+                    reached = True
+                    print(f"target loss {target} reached at step {i}",
+                          flush=True)
+                    break
+            if val_batch is not None and i % eval_every == 0:
+                # CPU backends: the train step's in-flight collectives must
+                # drain before another compiled program launches
+                jax.block_until_ready(params)
+                vl = float(val_loss_fn(params, *val_batch))
+                val_losses.append((i, round(vl, 4)))
+                if best_val is None or vl < best_val:
+                    best_val, best_step = vl, i
+                    if patience:
+                        # the train step donates its param buffers, so a
+                        # live reference would dangle — snapshot to host
+                        best_params = jax.device_get(params)
+                print(f"  val  {i:4d}  loss {vl:.4f}"
+                      + ("  (best)" if best_step == i else ""), flush=True)
+                if patience and (i - best_step) >= patience * eval_every:
+                    stopped_early = True
+                    print(f"early stop at step {i}: no val improvement "
+                          f"since step {best_step} "
+                          f"({patience} evals)", flush=True)
+                    break
+            if store is not None and i % args.ckpt_every == 0:
+                # async: the device→host snapshot is synchronous (consistent
+                # with this step), serialization + publish overlap training
+                saver.submit(store, "lm.ckpt",
                              {"params": params, "opt": opt_state,
                               "step": jnp.asarray(i, jnp.int32)})
-            print(f"  checkpoint @ step {i}", flush=True)
+                print(f"  checkpoint @ step {i}", flush=True)
+    finally:
+        # an exception mid-loop (OOM, NaN guard, SIGTERM) must not
+        # abandon the in-flight write: the 'checkpoint @ step' log
+        # line is only ever true because this wait always runs
+        saver.wait()
     jax.block_until_ready(params)   # CPU backends: don't overlap the
     #                                   decode program with in-flight
     #                                   train collectives

@@ -42,24 +42,91 @@ def _leaf_dtype_name(leaf: Any) -> str:
     return str(np.dtype(d) if d is not None else np.result_type(leaf))
 
 
-def save_pytree(store, name: str, tree: Any) -> None:
-    """Atomically publish ``tree`` as checkpoint file ``name``."""
-    leaves, treedef = jax.tree.flatten(tree)
+def _save_flat(store, name: str, leaves: list, dtypes: list,
+               treedef_str: str) -> None:
+    """The single checkpoint-format writer (sync and async paths both).
+
+    ``leaves`` is CONSUMED: each slot is released as soon as its bytes
+    are written, so a caller handing over host snapshots (the async
+    path) holds at most snapshot + one serialization buffer, and the
+    sync path keeps its one-leaf-at-a-time host-RSS discipline."""
     b = store.builder()
     # v2 manifests record each leaf's dtype NAME: numpy serializes
     # ml_dtypes leaves (bfloat16 and friends) as raw void arrays, and
     # without the name a loader can only guess the original dtype by
     # itemsize — bfloat16 vs float16 would silently reinterpret bits.
-    b.write(json.dumps({"v": 2, "n": len(leaves),
-                        "dtypes": [_leaf_dtype_name(x) for x in leaves],
-                        "treedef": str(treedef)}) + "\n")
-    # one leaf materialized at a time: a multi-GB params+opt_state tree
-    # must not double its host RSS during save
-    for leaf in leaves:
+    b.write(json.dumps({"v": 2, "n": len(leaves), "dtypes": dtypes,
+                        "treedef": treedef_str}) + "\n")
+    for i in range(len(leaves)):
+        leaf, leaves[i] = leaves[i], None       # eager release
         buf = io.BytesIO()
         np.save(buf, np.asarray(leaf), allow_pickle=False)
         b.write(base64.b64encode(buf.getvalue()).decode() + "\n")
     b.build(name)
+
+
+def save_pytree(store, name: str, tree: Any) -> None:
+    """Atomically publish ``tree`` as checkpoint file ``name``."""
+    leaves, treedef = jax.tree.flatten(tree)
+    _save_flat(store, name, list(leaves),
+               [_leaf_dtype_name(x) for x in leaves], str(treedef))
+
+
+class AsyncCheckpoint:
+    """Background checkpoint writer: overlap serialization/IO with
+    training.
+
+    ``submit(store, name, tree)`` snapshots the tree to HOST memory
+    SYNCHRONOUSLY (device_get — consistent with the submitting step,
+    and safe against the train step's donated buffers), then hands
+    serialization + the atomic store publish to a worker thread. At
+    most one write is in flight: submitting while the previous write
+    runs blocks until it lands (a checkpoint cadence faster than
+    storage can absorb should throttle training visibly, not queue
+    snapshots without bound). ``wait()`` blocks until the last write
+    is durable and re-raises any background failure — call it before
+    declaring a run finished.
+
+    The reference's analog is the APRIL-ANN example's synchronous
+    GridFS model write each iteration (common.lua:24-29); this is that
+    capability minus the train-loop stall — the save cost that remains
+    on the critical path is one device→host fetch."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._thread = None
+        self._error = None
+
+    def submit(self, store, name: str, tree: Any) -> None:
+        import threading
+
+        self.wait()                       # one in-flight write max
+        leaves, treedef = jax.tree.flatten(tree)
+        dtypes = [_leaf_dtype_name(x) for x in leaves]
+        host = [jax.device_get(x) for x in leaves]  # the sync part
+
+        def _write():
+            try:
+                # _save_flat consumes the snapshot leaf by leaf, so
+                # host memory drains as the write progresses instead of
+                # pinning the full tree until the publish
+                _save_flat(store, name, host, dtypes, str(treedef))
+            except BaseException as e:    # surfaced by wait()
+                self._error = e
+
+        self._thread = threading.Thread(target=_write, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from err
 
 
 def load_pytree(store, name: str, like: Any, *,

@@ -248,3 +248,56 @@ def test_digits_sheet_accuracy_both_paths_agree():
     assert out["tpu_native_path"]["val_accuracy"] >= 0.9, out
     assert out["mapreduce_path"]["val_accuracy"] >= 0.9, out
     assert out["agree_within"] <= 0.05, out
+
+
+class TestAsyncCheckpoint:
+    def test_background_save_round_trips(self):
+        from lua_mapreduce_tpu.store.memfs import MemStore
+
+        store = MemStore()
+        tree = {"w": jnp.arange(12.0).reshape(3, 4),
+                "b": jnp.ones((4,), jnp.bfloat16)}
+        ac = ckpt.AsyncCheckpoint()
+        ac.submit(store, "a.ckpt", tree)
+        ac.wait()
+        got = ckpt.load_pytree(store, "a.ckpt", tree)
+        np.testing.assert_array_equal(np.asarray(got["w"], np.float32),
+                                      np.asarray(tree["w"], np.float32))
+        assert got["b"].dtype == jnp.bfloat16
+
+    def test_snapshot_is_taken_at_submit_time(self):
+        """The write must capture the tree AS SUBMITTED even if the
+        caller's arrays are replaced (donated/overwritten) before the
+        background write finishes."""
+        from lua_mapreduce_tpu.store.memfs import MemStore
+
+        store = MemStore()
+        ac = ckpt.AsyncCheckpoint()
+        tree = {"x": jnp.zeros((256, 256))}
+        ac.submit(store, "s.ckpt", tree)
+        tree["x"] = jnp.ones((256, 256))       # caller moves on
+        ac.wait()
+        got = ckpt.load_pytree(store, "s.ckpt", tree)
+        assert float(np.asarray(got["x"]).max()) == 0.0
+
+    def test_wait_reraises_background_failure(self):
+        class BrokenStore:
+            def builder(self):
+                raise IOError("disk gone")
+
+        ac = ckpt.AsyncCheckpoint()
+        ac.submit(BrokenStore(), "x.ckpt", {"a": jnp.zeros(3)})
+        with pytest.raises(RuntimeError, match="async checkpoint"):
+            ac.wait()
+        ac.wait()          # error is consumed; idle wait is clean
+
+    def test_serializes_overlapping_submits(self):
+        from lua_mapreduce_tpu.store.memfs import MemStore
+
+        store = MemStore()
+        ac = ckpt.AsyncCheckpoint()
+        for i in range(5):
+            ac.submit(store, "r.ckpt", {"i": jnp.full((64,), float(i))})
+        ac.wait()
+        got = ckpt.load_pytree(store, "r.ckpt", {"i": jnp.zeros(64)})
+        assert float(np.asarray(got["i"])[0]) == 4.0
