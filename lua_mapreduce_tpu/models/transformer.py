@@ -233,6 +233,17 @@ def init_transformer(key, cfg: TransformerConfig = TransformerConfig(),
     return params
 
 
+def _head(params: Params, x):
+    """The tied LM head ``x @ tok_emb.T`` — through the int8 kernel when
+    the serving dict carries ``head::q8`` (quantize_lm). At production
+    vocab sizes this is THE decode-bandwidth matmul; the embedding
+    GATHER keeps the full-precision tok_emb (it reads only B rows per
+    step, negligible traffic)."""
+    if "head::q8" in params:
+        return _mm(params, "head", x)       # one q8 dispatch path only
+    return x @ params["tok_emb"].T
+
+
 def _mm(params: Params, key: str, y):
     """``y @ params[key]`` — through the weight-only int8 kernel when
     the param dict carries a quantized entry (``key::q8`` +
@@ -251,12 +262,17 @@ def quantize_lm(params: Params) -> Params:
     """Weight-only int8 SERVING copy of an LM's DENSE projection
     weights: every per-block 2-D projection (qkv / out / ff*) is
     replaced by
-    ``name::q8`` (int8) + ``name::scale`` (f32 per output channel);
-    biases, norms, embeddings (and the tied head) stay full precision.
+    ``name::q8`` (int8) + ``name::scale`` (f32 per output channel),
+    and the tied head gets an int8 copy (``head::q8``) while tok_emb
+    stays full precision for the embedding gather; biases and norms
+    are untouched.
     Use with the single-device inference paths (``greedy_decode``,
     ``prefill``) — training and the sharded forward reject quantized
-    dicts loudly (the original keys are gone). ~4× smaller weights
-    than f32, ~2× less decode HBM traffic than bf16 (ops/q8.py).
+    dicts loudly (the original keys are gone). Dense PROJECTIONS are
+    4× smaller than f32; the embedding table itself grows 1.25×
+    (f32 gather copy + int8 head copy) — the head quantization buys
+    decode BANDWIDTH (int8 streamed per step), not footprint, so at
+    embedding-dominated sizes the dict shrinks less than 4× overall.
     MoE expert stacks (3-D, einsum-dispatched) and embeddings stay full
     precision — for dense models the quantized projections are the
     decode-bandwidth bulk."""
@@ -269,6 +285,11 @@ def quantize_lm(params: Params) -> Params:
             out[k + "::scale"] = s.reshape(-1)
         else:
             out[k] = v
+    # the tied head gets an int8 COPY (tok_emb stays for the gather):
+    # at production vocab the head is the decode-bandwidth matmul
+    qh, sh = quantize_q8(jnp.transpose(params["tok_emb"]))
+    out["head::q8"] = qh
+    out["head::scale"] = sh.reshape(-1)
     return out
 
 
@@ -397,7 +418,7 @@ def _forward(params: Params, tokens, pos, cfg: TransformerConfig,
             x, aux = block(params, i, x, cfg, attn_fn, pos)
         aux_total = aux_total + aux
     x = _norm(params, "lnf", x, cfg)
-    return x @ params["tok_emb"].T, aux_total           # tied head
+    return _head(params, x), aux_total                  # tied head
 
 
 def prefill(params: Params, prompt, *,
@@ -635,7 +656,7 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
             ff, _ = _ffn(params, pfx, y, step_cfg, None)
             x = x + ff
         x = _norm(params, "lnf", x, cfg)
-        logits = (x @ params["tok_emb"].T)[:, 0]        # (B, vocab)
+        logits = _head(params, x)[:, 0]                 # (B, vocab)
         nxt = select(logits, t)
         return (caches, nxt), nxt
 

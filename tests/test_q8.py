@@ -102,6 +102,9 @@ class TestQuantizedLM:
         # embeddings / norms / biases untouched
         assert "tok_emb" in qp and "L0_ln1_g" in qp
         assert "L0_ff1_b" in qp
+        # tied head: int8 COPY alongside the full-precision gather table
+        assert qp["head::q8"].dtype == jnp.int8
+        assert qp["head::q8"].shape == qp["tok_emb"].shape[::-1]
 
     def test_quantized_forward_logits_close(self):
         import numpy as np
