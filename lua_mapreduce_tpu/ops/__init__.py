@@ -62,6 +62,24 @@ def default_backend(op: str | None = None) -> str:
     return _TPU_AUTO_POLICY.get(op, "pallas")
 
 
+def out_struct(shape, dtype, *like) -> jax.ShapeDtypeStruct:
+    """``pallas_call`` out_shape that survives shard_map's vma typing.
+
+    JAX ≥0.9 checks varying-mesh-axes (vma) types inside ``shard_map``
+    and rejects a plain ``ShapeDtypeStruct`` out_shape; the output of a
+    kernel varies over exactly the union of axes its operands vary over,
+    so that union is propagated from ``like``. Outside shard_map every
+    operand's vma is empty and this degrades to the plain struct.
+    """
+    try:
+        vma = (frozenset().union(*(jax.typeof(a).vma for a in like))
+               if like else frozenset())
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):
+        # older JAX: no jax.typeof/.vma/vma kwarg — and no vma checking
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def resolve_backend(backend: str, op: str | None = None) -> str:
     if backend == "auto":
         return default_backend(op)

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from lua_mapreduce_tpu.ops import resolve_backend
+from lua_mapreduce_tpu.ops import out_struct, resolve_backend
 
 _NEG_INF = -1e30
 
@@ -323,10 +323,10 @@ def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
     # the lse path serves partial-merge callers (ring folds): its out
     # stays f32 so P merged partials round ONCE at the caller's final
     # cast, not once per ring step
-    shape_o = jax.ShapeDtypeStruct(
-        qb.shape, jnp.float32 if with_lse else q.dtype)
-    shape_lse = jax.ShapeDtypeStruct((b * h, qb.shape[1], _LANES),
-                                     jnp.float32)
+    shape_o = out_struct(
+        qb.shape, jnp.float32 if with_lse else q.dtype, qb, kb, vb)
+    shape_lse = out_struct((b * h, qb.shape[1], _LANES), jnp.float32,
+                           qb, kb, vb)
     res = pl.pallas_call(
         functools.partial(kern, scale=scale, causal=causal,
                           seq_len=l, block_q=block_q, block_k=block_k,
@@ -543,7 +543,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
         grid=(b * h, n_q, n_kv),
         in_specs=[spec_q, spec_kv, spec_kv, spec_q, spec_row, spec_row],
         out_specs=spec_q,
-        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        out_shape=out_struct(qb.shape, q.dtype, qb, kb, vb, dob),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -573,8 +573,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
         in_specs=[spec_q2, spec_kv2, spec_kv2, spec_q2, spec_row2,
                   spec_row2],
         out_specs=[spec_kv2, spec_kv2],
-        out_shape=[jax.ShapeDtypeStruct(kb.shape, k.dtype),
-                   jax.ShapeDtypeStruct(vb.shape, v.dtype)],
+        out_shape=[out_struct(kb.shape, k.dtype, qb, kb, vb, dob),
+                   out_struct(vb.shape, v.dtype, qb, kb, vb, dob)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(

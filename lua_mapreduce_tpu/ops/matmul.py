@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from lua_mapreduce_tpu.ops import resolve_backend
+from lua_mapreduce_tpu.ops import out_struct, resolve_backend
 
 
 def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref, *, n_k: int):
@@ -116,8 +116,8 @@ def _matmul_pallas(a, b, block_m: int | None = None,
         ],
         out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, kk: (i, j),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((ap.shape[0], bp.shape[1]),
-                                       out_dtype),
+        out_shape=out_struct((ap.shape[0], bp.shape[1]), out_dtype,
+                             ap, bp),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),

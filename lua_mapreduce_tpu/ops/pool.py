@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from lua_mapreduce_tpu.ops import resolve_backend
+from lua_mapreduce_tpu.ops import out_struct, resolve_backend
 from lua_mapreduce_tpu.ops.conv import _norm_stride
 
 
@@ -64,7 +64,7 @@ def _pool_pallas(x, window, stride, mode, interpret=False):
                                memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((1, ho, wo, c), lambda i: (i, 0, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n, ho, wo, c), x.dtype),
+        out_shape=out_struct((n, ho, wo, c), x.dtype, x),
         # each image is independent — let Mosaic parallelize the batch
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)),

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from lua_mapreduce_tpu.ops import resolve_backend
+from lua_mapreduce_tpu.ops import out_struct, resolve_backend
 
 
 def quantize_q8(w, axis: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -111,8 +111,8 @@ def _q8_matmul_pallas(x, q, s, block_m=256, block_n=512, block_k=512,
         out_specs=pl.BlockSpec((block_m, block_n),
                                lambda mi, ni, ki: (mi, ni),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((xb.shape[0], qb.shape[1]),
-                                       x.dtype),
+        out_shape=out_struct((xb.shape[0], qb.shape[1]), x.dtype,
+                             xb, qb, sb),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from lua_mapreduce_tpu.ops import resolve_backend
+from lua_mapreduce_tpu.ops import out_struct, resolve_backend
 
 
 def _log_softmax_kernel(x_ref, o_ref):
@@ -63,7 +63,7 @@ def _rowwise_pallas(x, kernel, block_rows=256, interpret=False):
                                memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((block_rows, x2.shape[1]), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        out_shape=out_struct(x2.shape, x.dtype, x2),
         # each row block is independent — let Mosaic parallelize
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)),
