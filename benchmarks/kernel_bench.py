@@ -282,6 +282,46 @@ def bench_flash_grad(b, heads, seq, d, causal, dtype):
     return _bench_pair(make)
 
 
+def bench_flash_grad_error(b=2, heads=8, seq=2048, d=128):
+    """bf16 training-gradient error of the fused backward vs the XLA
+    oracle ON CHIP (ADVICE r3: the return_lse backward runs its dp/dv
+    dots in q.dtype — the MXU tradeoff the docstring documents; this
+    pins its actual size where the MXU does the rounding, not the CPU
+    emulation). Error is relative to the f32 oracle grads' scale."""
+    import jax
+    import jax.numpy as jnp
+
+    from lua_mapreduce_tpu import ops
+
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q, k, v = (jax.random.normal(kk, (b, seq, heads, d), jnp.bfloat16)
+               for kk in ks)
+
+    def loss(q, k, v, backend):
+        o, lse = ops.flash_attention(q, k, v, causal=True,
+                                     return_lse=True, backend=backend)
+        return (jnp.sum(o.astype(jnp.float32) ** 2)
+                + 0.1 * jnp.sum(lse))
+
+    out = {}
+    import functools as ft
+    gp = jax.jit(jax.grad(ft.partial(loss, backend="pallas"),
+                          argnums=(0, 1, 2)))(q, k, v)
+    gx = jax.jit(jax.grad(ft.partial(loss, backend="xla"),
+                          argnums=(0, 1, 2)))(q, k, v)
+    import numpy as np
+    for name, a_, b_ in zip(("dq", "dk", "dv"), gp, gx):
+        a_ = np.asarray(a_, np.float64)
+        b_ = np.asarray(b_, np.float64)
+        scale = max(float(np.abs(b_).max()), 1e-30)
+        out[f"{name}_max_rel_err"] = round(
+            float(np.abs(a_ - b_).max()) / scale, 6)
+        out[f"{name}_mean_rel_err"] = round(
+            float(np.abs(a_ - b_).mean()) / scale, 8)
+    out["config"] = f"b{b} h{heads} L{seq} d{d} bf16 causal lse"
+    return out
+
+
 def bench_q8_matmul(m, k, n):
     """Weight-only int8 matmul at decode shapes (ops/q8.py): the pallas
     kernel streams int8 weight tiles; the XLA side is the bf16 matmul it
@@ -658,6 +698,10 @@ def main() -> None:
             # training path: fused Pallas backward vs XLA's O(L²) VJP
             "flash_grad_s2048_h8_d128_causal": lambda: bench_flash_grad(
                 4, 8, 2048, 128, True, bf16),
+            # numeric, not timing: bf16 grad error of the fused
+            # backward vs the f32-dot oracle, measured where the MXU
+            # rounds (ADVICE r3 item 3)
+            "flash_grad_bf16_error": bench_flash_grad_error,
             # vocab-wide rows need short blocks to fit scoped VMEM
             "log_softmax_8192x32768": lambda: bench_softmax(
                 8192, 32768, bf16, block_rows=64),

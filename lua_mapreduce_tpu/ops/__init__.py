@@ -21,23 +21,28 @@ from __future__ import annotations
 
 import jax
 
-# Measured on a TPU v5e (benchmarks/results/kernels.json): XLA's conv
-# lowering beats the im2col+Pallas path (46.1 vs 8.1 TF/s on the ResNet
-# 56×56 block) STRUCTURALLY — the im2col patch round trip alone costs
-# 1.75× XLA's whole runtime (DESIGN.md §8b), so conv2d is "xla"
-# permanently for this shape class. Matmul: the 512²-tile schedule
-# (DESIGN.md §8) measured 127.5 TF/s on the round-4 window — 2.38× the
-# old 256² tiles, validating the roofline diagnosis, but 0.83× XLA's
-# 153.8, short of the ≥0.9× flip rule; a wider-tile sweep is staged; the
-# Pallas pooling kernel beats XLA's reduce_window ~2.7×. Flash resolves
-# to Pallas on memory grounds, now measured (benchmarks/attn_memory.py →
-# results/attn_memory.json, DESIGN.md §9): the XLA composition's compiled
-# buffer assignment holds ~4 L²-sized temps across fwd+bwd — 4.13 GiB at
-# (b=2, h=8, L=4096, d=128) vs the fused kernel pair's 0.178 GiB of O(L)
-# residents (23×; 57× by L=8192) — while the Pallas pair (forward +
-# FlashAttention-2 backward re-materializing p from the saved logsumexp)
-# never materializes O(L²). Head-to-head speed entries (flash_* and
-# flash_grad_* in kernels.json) complete the picture on real-chip runs.
+# Measured on a TPU v5e (benchmarks/results/kernels.json, round-4
+# windows 2026-07-31): XLA's conv lowering beats the im2col+Pallas path
+# (46.1 vs 8.1 TF/s on the ResNet 56×56 block) STRUCTURALLY — the
+# im2col patch round trip alone costs 1.75× XLA's whole runtime
+# (DESIGN.md §8b), so conv2d is "xla" permanently for this shape class.
+# Matmul: the sweep-tuned wide tiles (matmul_tune.json baked into
+# _auto_blocks: (512-1024, 1024, 512)) measured 151.6 TF/s at 8192³ —
+# 2.8× the round-2 256² schedule, 0.90× XLA's 169.2 — still fractionally
+# under the ≥0.9× flip rule (0.896), so the policy holds at XLA: the
+# kernel exists for fusion sites XLA can't express, not to re-win dense
+# GEMM. The Pallas pooling kernel beats XLA's reduce_window ~2.7×.
+# Flash is Pallas on BOTH grounds, measured on-chip with the
+# sweep-tuned (256, 256) blocks (flash_tune.json):
+#   speed — fwd 2.02× XLA at L=2048 and 5.72× at L=4096, fused
+#   backward 2.41× (flash_*/flash_grad_* entries);
+#   memory — the XLA composition's compiled buffer assignment holds ~4
+#   L²-sized temps across fwd+bwd (attn_memory.json, TPU-keyed): 4.13
+#   GiB at (b=2, h=8, L=4096, d=128) vs the fused pair's 0.178 GiB of
+#   O(L) residents (23×; 57× by L=8192), the gap doubling per context
+#   doubling — while the Pallas pair (forward + FlashAttention-2
+#   backward re-materializing p from the saved logsumexp) never
+#   materializes O(L²).
 # Softmax is a wash; XLA wins on fusion-with-neighbors grounds.
 _TPU_AUTO_POLICY = {
     "matmul": "xla",

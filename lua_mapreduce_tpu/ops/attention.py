@@ -265,6 +265,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
+# Tuned defaults from the on-chip sweep (benchmarks/flash_tune.py →
+# results/flash_tune.json, v5e 2026-07-31): (256, 256) is best or
+# within 4% of best for fwd AND fwd+bwd at both L=2048 and L=4096 —
+# 2.5-2.8× the old (128, 128) schedule (42.6 vs 16.3 TF/s on the
+# training path at L=2048). Bigger KV blocks amortize the per-tile
+# softmax state updates; 256² keeps the f32 score tile at 256 KB.
+_DEFAULT_BLOCK_Q = 256
+_DEFAULT_BLOCK_K = 256
+
+
+def _resolve_blocks(block_q, block_k):
+    for nm, v in (("block_q", block_q), ("block_k", block_k)):
+        if v is not None and v <= 0:  # match ops/matmul.py's validation
+            raise ValueError(f"{nm} must be positive, got {v}")
+    return (_DEFAULT_BLOCK_Q if block_q is None else block_q,
+            _DEFAULT_BLOCK_K if block_k is None else block_k)
+
+
 def _clamp_blocks(l: int, block_q: int, block_k: int):
     """Shared fwd/bwd block clamping — the backward re-derives the
     forward's padded geometry from (l, block_q, block_k) and the two
@@ -296,13 +314,14 @@ def _kv_row(bh, h: int, hkv: int):
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret",
                               "with_lse", "window", "q_offset"))
-def _flash_pallas(q, k, v, causal, block_q=128, block_k=128,
+def _flash_pallas(q, k, v, causal, block_q=None, block_k=None,
                   interpret=False, with_lse=False, window=0,
                   q_offset=0):
     b, l, h, d = q.shape
     hkv = k.shape[2]
     scale = 1.0 / float(d) ** 0.5
 
+    block_q, block_k = _resolve_blocks(block_q, block_k)
     block_q, block_k = _clamp_blocks(l, block_q, block_k)
     qb = _pad_seq(_to_bh(q), block_q)
     kb = _pad_seq(_to_bh(k), block_k)
@@ -473,8 +492,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret",
                               "window", "q_offset"))
-def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
-                      block_k=128, interpret=False, g_lse=None,
+def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=None,
+                      block_k=None, interpret=False, g_lse=None,
                       window=0, q_offset=0):
     """Fused backward: (dq, dk, dv) with only O(L·d) HBM traffic.
 
@@ -492,6 +511,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q=128,
     group = h // hkv
     scale = 1.0 / float(d) ** 0.5
 
+    block_q, block_k = _resolve_blocks(block_q, block_k)
     block_q, block_k = _clamp_blocks(l, block_q, block_k)
     qb = _pad_seq(_to_bh(q), block_q)
     kb = _pad_seq(_to_bh(k), block_k)
@@ -663,8 +683,10 @@ _flash_p_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
-                    backend: str = "auto", block_q: int = 128,
-                    block_k: int = 128, return_lse: bool = False,
+                    backend: str = "auto",
+                    block_q: int | None = None,
+                    block_k: int | None = None,
+                    return_lse: bool = False,
                     window: int = 0, q_offset: int = 0):
     """Exact softmax attention, (B, L, H, D) → (B, L, H, D).
 

@@ -54,23 +54,30 @@ def _pad_to(x, m_mult, n_mult):
 
 
 def _auto_blocks(m: int, n: int, k: int) -> tuple:
-    """Size-adaptive (bm, bn, bk).
+    """Size-adaptive (bm, bn, bk), set by the on-chip sweep.
 
     HBM traffic is ``2·m·n·k·itemsize·(1/bm + 1/bn)`` (A re-read once
-    per N-tile, B once per M-tile; bk cancels), so the M/N tiles set the
-    arithmetic intensity: 256² tiles bound bf16 at ~64 TF/s on a v5e's
-    ~820 GB/s — under half the 197 TF/s MXU peak — while 512² tiles
-    lift the roofline to ~256 TF/s, past peak (compute-bound). VMEM at
-    (512, 512, 1024) bf16: double-buffered A+B 4 MB + f32 acc 1 MB +
-    out 0.5 MB ≈ 5.5 MB of the ~16 MB budget. Small problems keep 256²
+    per N-tile, B once per M-tile; bk cancels), so the M/N tiles set
+    the arithmetic intensity: 256² tiles bound bf16 at ~64 TF/s on a
+    v5e's ~820 GB/s — under half the 197 TF/s MXU peak — while the
+    wide tiles here lift the roofline past peak (compute-bound). The
+    round-4 sweep (benchmarks/matmul_tune.py →
+    results/matmul_tune.json, v5e 2026-07-31) measured the winners:
+    (1024, 1024, 512) at 4096³ (152.7 TF/s) and (512, 1024, 512) at
+    8192³ (171.4 TF/s in the sweep; 151.6 = 0.896× XLA through the
+    standard bench that governs the auto policy, kernels.json — the
+    shallower bm wins there on VMEM/pipeline pressure: the f32 acc at
+    bm=1024 is 4 MB). VMEM at
+    (512, 1024, 512) bf16: double-buffered A+B 3 MB + f32 acc 2 MB +
+    out 1 MB ≈ 6 MB of the ~16 MB budget. Small problems keep 256²
     (less padding waste, the pipeline still overlaps); tiny dims clamp
     in _matmul_pallas as before."""
     if min(m, n) >= 1024 and k >= 512:
-        # bk from {512, 1024} only: it must stay a multiple of the
-        # 128-lane native tiling (a raw k//4 could be e.g. 625 and
-        # break Mosaic lowering), and it cancels out of the traffic
-        # formula anyway — deeper only amortizes pipeline overhead
-        return 512, 512, (1024 if k >= 1024 else 512)
+        # bk stays a multiple of the 128-lane native tiling (a raw
+        # k//4 could be e.g. 625 and break Mosaic lowering); it cancels
+        # out of the traffic formula — the sweep found deeper bk only
+        # pays at bm=bn=512 (old schedule), not at the wide winners
+        return (512 if max(m, n) >= 8192 else 1024), 1024, 512
     return 256, 256, 256
 
 

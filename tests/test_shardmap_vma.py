@@ -37,8 +37,6 @@ from jax.sharding import AbstractMesh, Mesh, PartitionSpec as P
 
 from lua_mapreduce_tpu import ops
 
-jax.config.update("jax_threefry_partitionable", True)
-
 AMESH = AbstractMesh((4,), ("dp",))
 
 
