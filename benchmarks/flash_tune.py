@@ -24,7 +24,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # tests/test_tpu_lowering.py exports every one (fwd AND grad) and an
 # illegal candidate can never burn a hardware window
 CANDIDATES = [(64, 128), (128, 128), (128, 256), (256, 128), (256, 256),
-              (128, 512), (512, 128)]
+              (128, 512), (512, 128), (256, 512), (512, 256), (512, 512)]
 sys.path.insert(0, REPO)
 
 from benchmarks.kernel_bench import _call_overhead, _measure_op  # noqa: E402

@@ -379,7 +379,7 @@ def bench_pool(n, h, w, c, dtype):
 
 def bench_transformer_step(d_model=1024, n_heads=16, n_layers=8,
                            d_ff=4096, vocab=32768, seq=2048, batch=8,
-                           steps=10, modern=False) -> dict:
+                           steps=10, modern=False, moe_experts=0) -> dict:
     """Whole-train-step bench for the long-context model family: the
     framework's own LM train step (flash attention on the device-local
     path, fused grad all-reduce, optimizer) scanned ``steps`` times in
@@ -401,12 +401,19 @@ def bench_transformer_step(d_model=1024, n_heads=16, n_layers=8,
 
     kw = dict(vocab=vocab, d_model=d_model, n_heads=n_heads,
               n_layers=n_layers, d_ff=d_ff, max_seq=seq)
+    if moe_experts:
+        # switch-routed MoE FFNs; capacity = 2x the even-routing share
+        # of the device tile (the whole batch on one chip)
+        kw.update(moe_experts=moe_experts,
+                  moe_capacity=2 * batch * seq // moe_experts)
     cfg = (tfm.TransformerConfig.llama_style(n_kv_heads=n_heads // 4,
                                              **kw)
            if modern else tfm.TransformerConfig(**kw))
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
     params = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
                           tfm.init_transformer(jax.random.PRNGKey(0), cfg))
+    if moe_experts:
+        params = tfm.shard_params_moe(params, mesh)
     opt = optax.sgd(1e-3, momentum=0.9)
     step = tfm.make_train_step(cfg, mesh, opt, attn="ring")
     rng = np.random.RandomState(0)
@@ -437,7 +444,9 @@ def bench_transformer_step(d_model=1024, n_heads=16, n_layers=8,
         "config": (f"d{d_model} h{n_heads} L{n_layers} ff{d_ff} "
                    f"v{vocab} seq{seq} b{batch} bf16 ring+flash"
                    + (" llama-style(rope+rms+swiglu+gqa4:1)"
-                      if modern else "")),
+                      if modern else "")
+                   + (f" switch-moe{moe_experts}x(cap2x)"
+                      if moe_experts else "")),
         "ms_per_step": round(per_step * 1e3, 2),
         "tokens_per_sec": round(tok / per_step, 1),
         "mfu": round(mfu(model_flops, per_step), 4),
@@ -715,6 +724,10 @@ def main() -> None:
             "transformer_step_d1024_L8_s2048": bench_transformer_step,
             "transformer_step_llama_style": lambda: bench_transformer_step(
                 modern=True),
+            # expert-parallel family on-chip (dp=1: experts all local,
+            # the routing/capacity machinery still in the hot loop)
+            "transformer_step_moe8": lambda: bench_transformer_step(
+                moe_experts=8),
             # inference: long-prompt prefill vs from-scratch scan
             "decode_prompt3968_new128": bench_decode,
             # end-to-end conv training (BASELINE configs 3-4)

@@ -33,9 +33,9 @@ import jax
 # kernel exists for fusion sites XLA can't express, not to re-win dense
 # GEMM. The Pallas pooling kernel beats XLA's reduce_window ~2.7×.
 # Flash is Pallas on BOTH grounds, measured on-chip with the
-# sweep-tuned (256, 256) blocks (flash_tune.json):
-#   speed — fwd 2.02× XLA at L=2048 and 5.72× at L=4096, fused
-#   backward 2.41× (flash_*/flash_grad_* entries);
+# sweep-tuned (512, 512) blocks (flash_tune.json, two sweep rounds):
+#   speed — fwd 3.14× XLA at L=2048 and 9.69× at L=4096, fused
+#   backward 3.99× (flash_*/flash_grad_* entries);
 #   memory — the XLA composition's compiled buffer assignment holds ~4
 #   L²-sized temps across fwd+bwd (attn_memory.json, TPU-keyed): 4.13
 #   GiB at (b=2, h=8, L=4096, d=128) vs the fused pair's 0.178 GiB of

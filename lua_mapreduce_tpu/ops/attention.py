@@ -266,13 +266,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 
 
 # Tuned defaults from the on-chip sweep (benchmarks/flash_tune.py →
-# results/flash_tune.json, v5e 2026-07-31): (256, 256) is best or
-# within 4% of best for fwd AND fwd+bwd at both L=2048 and L=4096 —
-# 2.5-2.8× the old (128, 128) schedule (42.6 vs 16.3 TF/s on the
-# training path at L=2048). Bigger KV blocks amortize the per-tile
-# softmax state updates; 256² keeps the f32 score tile at 256 KB.
-_DEFAULT_BLOCK_Q = 256
-_DEFAULT_BLOCK_K = 256
+# results/flash_tune.json, v5e 2026-07-31, two rounds): (512, 512) is
+# the decisive winner at every swept shape — fwd 0.501 ms at L=2048
+# (vs 2.05 ms at the original (128, 128), 0.774 at (256, 256)) and
+# 1.80× the (256, 256) schedule on the L=4096 training path. Bigger
+# tiles amortize the per-tile online-softmax state updates and halve
+# the number of VMEM-refill boundaries; the f32 score tile at 512² is
+# 1 MB, q/kv tiles 128 KB each at d=128 — comfortably inside VMEM
+# with double buffering. Short sequences clamp down in _clamp_blocks;
+# explicit callers (tiny windows, odd geometries) can still override.
+_DEFAULT_BLOCK_Q = 512
+_DEFAULT_BLOCK_K = 512
 
 
 def _resolve_blocks(block_q, block_k):
