@@ -24,7 +24,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # tests/test_tpu_lowering.py exports every one (fwd AND grad) and an
 # illegal candidate can never burn a hardware window
 CANDIDATES = [(64, 128), (128, 128), (128, 256), (256, 128), (256, 256),
-              (128, 512), (512, 128), (256, 512), (512, 256), (512, 512)]
+              (128, 512), (512, 128), (256, 512), (512, 256), (512, 512),
+              # round-3 sweep: (512, 512) won everywhere; probe whether
+              # the trend continues (1 MB→2 MB f32 score tile)
+              (512, 1024), (1024, 512)]
 sys.path.insert(0, REPO)
 
 from benchmarks.kernel_bench import _call_overhead, _measure_op  # noqa: E402

@@ -704,6 +704,11 @@ def main() -> None:
                 4, 8, 2048, 128, True, bf16),
             "flash_s4096_h8_d128_causal": lambda: bench_flash(
                 2, 8, 4096, 128, True, bf16),
+            # book-length context: XLA's composition holds ~4 GiB of
+            # L² temps here (attn_memory.json) — the shape class the
+            # kernel exists for
+            "flash_s8192_h8_d128_causal": lambda: bench_flash(
+                1, 8, 8192, 128, True, bf16),
             # training path: fused Pallas backward vs XLA's O(L²) VJP
             "flash_grad_s2048_h8_d128_causal": lambda: bench_flash_grad(
                 4, 8, 2048, 128, True, bf16),
