@@ -733,6 +733,11 @@ def main() -> None:
             # the routing/capacity machinery still in the hot loop)
             "transformer_step_moe8": lambda: bench_transformer_step(
                 moe_experts=8),
+            # double the context, same tokens/step: the attention share
+            # of the step doubles — the regime flash's 9.7x-at-L=4096
+            # advantage feeds straight into MFU
+            "transformer_step_s4096": lambda: bench_transformer_step(
+                modern=True, seq=4096, batch=4),
             # inference: long-prompt prefill vs from-scratch scan
             "decode_prompt3968_new128": bench_decode,
             # end-to-end conv training (BASELINE configs 3-4)
