@@ -522,11 +522,14 @@ def bench_conv_train(model: str, batch: int, steps: int = 10) -> dict:
 
 def bench_decode(d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
                  vocab=32768, max_seq=4096, prompt_len=3968, n_new=128,
-                 batch=4) -> dict:
+                 batch=4, quantized=False) -> dict:
     """LM inference bench: long-prompt generation, prefill vs the
     from-scratch position scan. Reports prompt-ingestion speedup and
     decode tokens/sec — the serving-side counterpart of
-    bench_transformer_step (training) for the same model family."""
+    bench_transformer_step (training) for the same model family.
+    ``quantized=True`` serves through the weight-only int8 copy
+    (transformer.quantize_lm → ops/q8.py kernel): same contract, half
+    the weight traffic in the matvec-bound decode tail."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -539,6 +542,8 @@ def bench_decode(d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
     params = jax.tree.map(
         lambda x: x.astype(jnp.bfloat16),
         tfm.init_transformer(jax.random.PRNGKey(0), cfg))
+    if quantized:
+        params = tfm.quantize_lm(params)
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(rng.randint(0, vocab, (batch, prompt_len)),
                          jnp.int32)
@@ -740,6 +745,12 @@ def main() -> None:
                 modern=True, seq=4096, batch=4),
             # inference: long-prompt prefill vs from-scratch scan
             "decode_prompt3968_new128": bench_decode,
+            # the int8 serving copy of the same model (q8 kernel in
+            # every projection + the tied head): the decode tail is
+            # weight-traffic bound, so this is where q8's halved HBM
+            # bytes should show up end to end
+            "decode_prompt3968_new128_q8": lambda: bench_decode(
+                quantized=True),
             # end-to-end conv training (BASELINE configs 3-4)
             "lenet5_cifar_train_b1024": lambda: bench_conv_train(
                 "lenet5_cifar", 1024),

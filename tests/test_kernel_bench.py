@@ -34,6 +34,17 @@ def test_bench_decode_smoke():
     assert out["end_to_end_tokens_per_sec"] > 0
 
 
+def test_bench_decode_quantized_smoke():
+    """The int8 serving copy drives the same bench (q8 path resolves
+    to the XLA dequant composition off-TPU)."""
+    from benchmarks.kernel_bench import bench_decode
+
+    out = bench_decode(d_model=32, n_heads=4, n_layers=1, d_ff=64,
+                       vocab=64, max_seq=64, prompt_len=48, n_new=8,
+                       batch=2, quantized=True)
+    assert out["decode_tokens_per_sec"] > 0
+
+
 def test_bench_conv_train_unknown_model_rejected():
     from benchmarks.kernel_bench import bench_conv_train
 
