@@ -75,8 +75,11 @@ def corpus_batch(rng, data: np.ndarray, batch: int, seq: int):
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--dp", type=int, default=4)
-    ap.add_argument("--sp", type=int, default=2)
+    ap.add_argument("--dp", type=int, default=0,
+                    help="data-parallel mesh axis (0 = auto: factor "
+                         "the visible devices as dp x sp)")
+    ap.add_argument("--sp", type=int, default=0,
+                    help="sequence-parallel mesh axis (0 = auto)")
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--steps", type=int, default=30)
@@ -166,8 +169,24 @@ def _run_inner(args, jax) -> dict:
     from lua_mapreduce_tpu.store.router import get_storage_from
     from lua_mapreduce_tpu.train import checkpoint as ckpt
 
-    n = args.dp * args.sp
     devices = jax.devices()
+    if not args.dp and not args.sp:
+        # auto mesh: use the visible devices — sp=2 when it divides
+        # (the sequence-parallel path stays exercised), else pure dp;
+        # dp capped to the largest value the batch geometry supports
+        # (batch divides into dp, and each device's rows split into
+        # grad_accum microbatches). One real chip → dp=1 x sp=1.
+        nv = len(devices)
+        args.sp = 2 if nv % 2 == 0 else 1
+        ga = max(args.grad_accum, 1)
+        args.dp = next(
+            d for d in range(nv // args.sp, 0, -1)
+            if args.batch % d == 0 and (args.batch // d) % ga == 0)
+    elif not args.dp or not args.sp:
+        free = len(devices) // max(args.dp, args.sp, 1)
+        args.dp = args.dp or free
+        args.sp = args.sp or free
+    n = args.dp * args.sp
     if len(devices) < n:
         raise SystemExit(
             f"need {n} devices for dp={args.dp} x sp={args.sp}, have "
