@@ -34,6 +34,30 @@ def test_bench_decode_smoke():
     assert out["end_to_end_tokens_per_sec"] > 0
 
 
+def test_bench_transformer_step_moe_smoke():
+    """The MoE train-step bench entry at toy scale: router/capacity
+    machinery + shard_params_moe must survive the exact call the TPU
+    window makes (a new case must never burn a window on a crash)."""
+    from benchmarks.kernel_bench import bench_transformer_step
+
+    out = bench_transformer_step(d_model=32, n_heads=4, n_layers=1,
+                                 d_ff=64, vocab=64, seq=64, batch=4,
+                                 steps=2, moe_experts=2)
+    assert out["tokens_per_sec"] > 0
+    assert "switch-moe2x" in out["config"]
+
+
+def test_bench_transformer_step_long_seq_smoke():
+    """The seq-doubling entry's path (modern recipe at seq > d_ff)."""
+    from benchmarks.kernel_bench import bench_transformer_step
+
+    out = bench_transformer_step(d_model=32, n_heads=4, n_layers=1,
+                                 d_ff=64, vocab=64, seq=128, batch=2,
+                                 steps=2, modern=True)
+    assert out["tokens_per_sec"] > 0
+    assert "seq128" in out["config"]
+
+
 def test_bench_decode_quantized_smoke():
     """The int8 serving copy drives the same bench (q8 path resolves
     to the XLA dequant composition off-TPU)."""
