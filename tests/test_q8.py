@@ -121,6 +121,36 @@ class TestQuantizedLM:
             jnp.std(full))
         assert rel < 0.25, rel          # op-level 3-5% compounds per layer
 
+    def test_quantize_lm_modern_recipe(self):
+        """The llama-style config quantizes completely: SwiGLU's third
+        FFN matrix (ff3) and GQA's narrower kv projection are dense 2-D
+        projections too and must not slip through the name filter."""
+        import numpy as np
+
+        from lua_mapreduce_tpu.models import transformer as tfm
+
+        cfg = tfm.TransformerConfig.llama_style(
+            vocab=16, d_model=32, n_heads=4, n_kv_heads=2,
+            n_layers=2, d_ff=64, max_seq=32)
+        params = tfm.init_transformer(jax.random.PRNGKey(3), cfg)
+        qp = tfm.quantize_lm(params)
+        for name in ("L0_qkv_W", "L0_out_W", "L0_ff1_W", "L0_ff2_W",
+                     "L0_ff3_W"):
+            assert f"{name}::q8" in qp and name not in qp, name
+            assert qp[f"{name}::q8"].dtype == jnp.int8
+        # GQA: the quantized qkv projection keeps the narrow kv width
+        assert (qp["L0_qkv_W::q8"].shape
+                == params["L0_qkv_W"].shape)
+        toks = jnp.asarray(np.arange(16)[None, :] % 16, jnp.int32)
+        full = tfm.transformer_apply(params, toks, cfg=cfg)
+        quant = tfm.transformer_apply(qp, toks, cfg=cfg)
+        rel = float(jnp.max(jnp.abs(full - quant))) / float(
+            jnp.std(full))
+        assert rel < 0.25, rel
+        # the KV-cached decode path serves the quantized modern dict
+        out = tfm.greedy_decode(qp, toks[:, :8], 4, cfg=cfg)
+        assert out.shape == (1, 12)      # prompt + 4 generated
+
     @pytest.mark.heavy
     def test_quantized_decode_matches_full_on_trained_model(self):
         """The serving claim end to end: train the stride task, then
