@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from lua_mapreduce_tpu.ops.attention import _tile_mask, flash_attention
+from lua_mapreduce_tpu.ops.decode import decode_attention
 from lua_mapreduce_tpu.ops.q8 import q8_matmul, quantize_q8
 from lua_mapreduce_tpu.parallel import moe as _moe
 from lua_mapreduce_tpu.parallel import zero1 as _z1
@@ -596,8 +597,11 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     # "slot not yet filled" during the first w steps.
     roll = bool(cfg.window) and cfg.window < total
     cache_len = cfg.window if roll else total
+    # caches ride the scan carry as (B, H_kv, S, D) — per-(batch, head)
+    # rows contiguous, the ops/decode.py layout contract (no per-step
+    # transpose for the fused kernel OR the XLA einsums)
     caches = {
-        f"L{i}_{kv}": jnp.zeros((b, cache_len, hkv, hd),
+        f"L{i}_{kv}": jnp.zeros((b, hkv, cache_len, hd),
                                 params["tok_emb"].dtype)
         for i in range(cfg.n_layers) for kv in ("k", "v")
     }
@@ -625,31 +629,24 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
                 # same convention the prefill capture uses)
                 q = _rope(q, t[None], cfg.rope_base)
                 k = _rope(k, t[None], cfg.rope_base)
-            q = q.reshape(b, 1, hkv, g, hd)
+            # (B, 1, Hkv, D) → (B, Hkv, 1, D) cache-layout row
+            k = jnp.transpose(k, (0, 2, 1, 3))
+            v = jnp.transpose(v, (0, 2, 1, 3))
+            # head index = (kv head, group member), kv-head major —
+            # the grouping decode_attention's (B, Hkv, G, D) q expects
+            q = q.reshape(b, hkv, g, hd)
             slot = t % cache_len if roll else t
             ck = lax.dynamic_update_slice(
-                caches[f"{pfx}_k"], k, (0, slot, 0, 0))
+                caches[f"{pfx}_k"], k, (0, 0, slot, 0))
             cv = lax.dynamic_update_slice(
-                caches[f"{pfx}_v"], v, (0, slot, 0, 0))
+                caches[f"{pfx}_v"], v, (0, 0, slot, 0))
             caches = {**caches, f"{pfx}_k": ck, f"{pfx}_v": cv}
-            # grouped contraction: the g query heads of each kv head
-            # share its cache rows (g = 1 is exactly the MHA einsum)
-            s = jnp.einsum("bqkgd,bmkd->bkgqm", q, ck,
-                           preferred_element_type=jnp.float32)
-            s = s / jnp.sqrt(jnp.float32(hd))
-            seen = jnp.arange(cache_len)[None, None, None, None, :]
-            if roll:
-                # rolling containment = the window; mask only the
-                # slots not yet filled (first w steps)
-                vis = (seen <= t) | (t >= cache_len)
-            else:
-                # the SHARED mask definition (_tile_mask): rows = the
-                # single query position t, cols = cache slots
-                vis = _tile_mask(t, seen, True, cfg.window, total)
-            s = jnp.where(vis, s, _NEG_INF)
-            w = jax.nn.softmax(s, axis=-1)
-            a = jnp.einsum("bkgqm,bmkd->bqkgd", w.astype(cv.dtype), cv,
-                           preferred_element_type=jnp.float32)
+            # fused decode attention (ops/decode.py): flash-decode
+            # kernel on TPU, the identical einsum+mask+softmax
+            # composition elsewhere. Non-roll windows are total-length
+            # (roll covers window < total), so slot<=t IS the mask.
+            a = decode_attention(q, ck, cv, t, roll=roll,
+                                 backend="auto")
             a = a.astype(x.dtype).reshape(b, 1, cfg.d_model)
             x = x + _mm(params, f"{pfx}_out_W", a)
             y = _norm(params, f"{pfx}_ln2", x, cfg)
@@ -679,18 +676,23 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
         caches, last_logits = prefill(params, prompt, cfg=cfg,
                                       total=total, mesh=mesh, attn=attn,
                                       dp_axis=dp_axis, sp_axis=sp_axis)
+        # prefill's public contract is (B, S, H_kv, D); the decode scan
+        # holds (B, H_kv, S, D) — one transpose at the boundary, not
+        # one per step
+        caches = {n: jnp.transpose(c, (0, 2, 1, 3))
+                  for n, c in caches.items()}
         if roll:
             # fold the prompt cache into the rolling layout: slot j
             # holds the LAST prompt position ≡ j (mod w)
             if p_len >= cache_len:
                 j = jnp.arange(cache_len)
                 src = p_len - 1 - ((p_len - 1 - j) % cache_len)
-                caches = {n: c[:, src] for n, c in caches.items()}
+                caches = {n: c[:, :, src] for n, c in caches.items()}
             else:
                 # positions 0..p_len-1 land in slots 0..p_len-1 and the
                 # prefill cache is already zero-padded beyond them —
                 # a plain truncation IS the rolling layout
-                caches = {n: c[:, :cache_len]
+                caches = {n: c[:, :, :cache_len]
                           for n, c in caches.items()}
         tok1 = select(last_logits, p_len - 1)
         # remaining n_new - 1 positions ride the ordinary step scan

@@ -56,6 +56,12 @@ _TPU_AUTO_POLICY = {
     # dequantized bf16 copy that jit hoists out of decode loops,
     # forfeiting the halved weight traffic the op exists for
     "q8_matmul": "pallas",
+    # flash-decode (ops/decode.py): one query position vs the KV
+    # cache, chunk-streamed with dynamic dead-chunk DMA elision —
+    # built for the DESIGN §13 decode gap; first on-chip number
+    # pending the next window (decode_* bench entries route through
+    # greedy_decode and therefore through this policy)
+    "decode_attention": "pallas",
 }
 
 

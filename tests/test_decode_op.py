@@ -1,0 +1,100 @@
+"""ops/decode.py — fused decode attention.
+
+The XLA path is the exact composition the decode scan ran in-line
+before the op existed, so the long-standing token-exactness pins
+(decode vs full-forward oracle, prefill vs scan) transitively cover
+it; THIS file pins the Pallas kernel against that XLA path over the
+(shape, position, roll) matrix in interpret mode, and the kernel's
+Mosaic lowering lives in tests/test_tpu_lowering.py with the rest.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lua_mapreduce_tpu.ops.decode import decode_attention
+
+
+def _args(b, hkv, g, d, s_len, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, hkv, g, d), dtype)
+    k = jnp.asarray(rng.randn(b, hkv, s_len, d), dtype)
+    v = jnp.asarray(rng.randn(b, hkv, s_len, d), dtype)
+    return q, k, v
+
+
+class TestDecodeParity:
+    @pytest.mark.parametrize("shape", [(2, 4, 1, 64, 256),   # MHA g=1
+                                       (2, 2, 4, 64, 384),   # GQA
+                                       (1, 1, 8, 128, 512),
+                                       # ragged: s_len % block_s != 0
+                                       # exercises the ceil-divided
+                                       # grid's masked final block
+                                       (2, 2, 2, 64, 300),
+                                       (1, 2, 1, 64, 1000)])
+    def test_kernel_matches_xla(self, shape):
+        b, hkv, g, d, s_len = shape
+        q, k, v = _args(*shape)
+        for t in [0, 5, s_len // 2, s_len - 1]:
+            for roll in (False, True):
+                ref = decode_attention(q, k, v, jnp.int32(t), roll=roll,
+                                       backend="xla")
+                got = decode_attention(q, k, v, jnp.int32(t), roll=roll,
+                                       backend="pallas_interpret")
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(ref), rtol=2e-5,
+                    atol=2e-5, err_msg=f"t={t} roll={roll}")
+
+    def test_kernel_matches_xla_bf16(self):
+        """The real serving dtype: bf16 caches, ragged length."""
+        q, k, v = _args(2, 2, 2, 64, 300, seed=9, dtype=jnp.bfloat16)
+        for t in [0, 150, 299]:
+            ref = decode_attention(q, k, v, jnp.int32(t), backend="xla")
+            got = decode_attention(q, k, v, jnp.int32(t),
+                                   backend="pallas_interpret")
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-2, atol=2e-2)
+
+    def test_rolling_full_cache_all_slots_visible(self):
+        """t ≥ S in rolling mode: every slot holds a live position —
+        the containment-is-the-mask rule."""
+        q, k, v = _args(1, 2, 2, 64, 128, seed=3)
+        ref = decode_attention(q, k, v, jnp.int32(500), roll=True,
+                               backend="xla")
+        got = decode_attention(q, k, v, jnp.int32(500), roll=True,
+                               backend="pallas_interpret")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_t_zero_attends_only_first_slot(self):
+        """Degenerate start: exactly one visible slot; the online
+        softmax must not divide by a zero denominator."""
+        q, k, v = _args(1, 2, 1, 64, 256, seed=4)
+        got = decode_attention(q, k, v, jnp.int32(0),
+                               backend="pallas_interpret")
+        # one visible slot → output IS that slot's v row
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(v[:, :, 0:1, :]),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_inside_scan_traced_t(self):
+        """The real call shape: ``t`` is a traced scan counter, the
+        caches ride the carry."""
+        q, k, v = _args(1, 2, 1, 64, 128, seed=5)
+
+        def body(c, t):
+            return c, decode_attention(q, k, v, t,
+                                       backend="pallas_interpret")
+
+        _, outs = jax.lax.scan(body, 0, jnp.arange(4))
+        for i in range(4):
+            ref = decode_attention(q, k, v, jnp.int32(i), backend="xla")
+            np.testing.assert_allclose(np.asarray(outs[i]),
+                                       np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_bad_backend_rejected(self):
+        q, k, v = _args(1, 1, 1, 64, 128)
+        with pytest.raises(ValueError, match="unknown backend"):
+            decode_attention(q, k, v, jnp.int32(0), backend="cuda")

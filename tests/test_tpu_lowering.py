@@ -182,6 +182,34 @@ def test_resnet18_imagenet_grad_lowers_with_tpu_policy():
         ops_pkg.default_backend = orig
 
 
+class TestDecodeLowering:
+    """ops/decode.py's flash-decode kernel — the scalar-prefetch grid
+    (dynamic dead-chunk elision) must stay Mosaic-legal at the decode
+    bench shapes, MHA (g=1 q rows) and GQA alike."""
+
+    @pytest.mark.parametrize("shape", [(4, 16, 1, 64, 4096),
+                                       (4, 4, 4, 128, 4096),
+                                       (2, 2, 8, 64, 300)])
+    def test_decode_kernel(self, shape):
+        from lua_mapreduce_tpu.ops.decode import _decode_pallas
+
+        b, hkv, g, d, s_len = shape
+        q = jax.ShapeDtypeStruct((b, hkv, g, d), jnp.bfloat16)
+        kv = jax.ShapeDtypeStruct((b, hkv, s_len, d), jnp.bfloat16)
+        t = jax.ShapeDtypeStruct((), jnp.int32)
+        export_tpu(lambda q_, k_, v_, t_: _decode_pallas(q_, k_, v_, t_),
+                   q, kv, kv, t)
+
+    def test_decode_kernel_rolling(self):
+        from lua_mapreduce_tpu.ops.decode import _decode_pallas
+
+        q = jax.ShapeDtypeStruct((2, 4, 1, 64), jnp.bfloat16)
+        kv = jax.ShapeDtypeStruct((2, 4, 512, 64), jnp.bfloat16)
+        t = jax.ShapeDtypeStruct((), jnp.int32)
+        export_tpu(lambda q_, k_, v_, t_: _decode_pallas(
+            q_, k_, v_, t_, roll=True), q, kv, kv, t)
+
+
 class TestQ8Lowering:
     def test_q8_matmul_decode_shapes(self):
         x = jax.ShapeDtypeStruct((8, 4096), jnp.bfloat16)
