@@ -104,11 +104,12 @@ from lua_mapreduce_tpu.ops.softmax import log_softmax, softmax  # noqa: E402
 from lua_mapreduce_tpu.ops.conv import conv2d  # noqa: E402
 from lua_mapreduce_tpu.ops.pool import avgpool2d, maxpool2d  # noqa: E402
 from lua_mapreduce_tpu.ops.attention import flash_attention  # noqa: E402
+from lua_mapreduce_tpu.ops.decode import decode_attention  # noqa: E402
 from lua_mapreduce_tpu.ops.q8 import q8_matmul, quantize_q8  # noqa: E402
 
 __all__ = [
     "default_backend", "resolve_backend",
     "matmul", "log_softmax", "softmax", "conv2d",
-    "maxpool2d", "avgpool2d", "flash_attention",
+    "maxpool2d", "avgpool2d", "flash_attention", "decode_attention",
     "q8_matmul", "quantize_q8",
 ]
