@@ -522,14 +522,16 @@ def bench_conv_train(model: str, batch: int, steps: int = 10) -> dict:
 
 def bench_decode(d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
                  vocab=32768, max_seq=4096, prompt_len=3968, n_new=128,
-                 batch=4, quantized=False) -> dict:
+                 batch=4, quantized=False, kv_q8=False) -> dict:
     """LM inference bench: long-prompt generation, prefill vs the
     from-scratch position scan. Reports prompt-ingestion speedup and
     decode tokens/sec — the serving-side counterpart of
     bench_transformer_step (training) for the same model family.
     ``quantized=True`` serves through the weight-only int8 copy
     (transformer.quantize_lm → ops/q8.py kernel): same contract, half
-    the weight traffic in the matvec-bound decode tail."""
+    the weight traffic in the matvec-bound decode tail. ``kv_q8``
+    additionally stores the KV cache int8 (ops/decode.quantize_kv) —
+    together they are the full int8 serving configuration."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -550,7 +552,7 @@ def bench_decode(d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
 
     def run(use_prefill):
         out = tfm.greedy_decode(params, prompt, n_new, cfg=cfg,
-                                use_prefill=use_prefill)
+                                use_prefill=use_prefill, kv_q8=kv_q8)
         return np.asarray(out)
 
     def run_prefill_only():
@@ -571,7 +573,9 @@ def bench_decode(d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
     decode_tail = max(dt_pre - dt_ingest, 1e-9)
     return {
         "config": (f"d{d_model} h{n_heads} L{n_layers} v{vocab} "
-                   f"prompt{prompt_len} new{n_new} b{batch} bf16"),
+                   f"prompt{prompt_len} new{n_new} b{batch} bf16"
+                   + (" w-int8" if quantized else "")
+                   + (" kv-int8" if kv_q8 else "")),
         "prefill_total_s": round(dt_pre, 3),
         "scan_total_s": round(dt_scan, 3),
         "prompt_ingest_s": round(dt_ingest, 3),
@@ -749,8 +753,11 @@ def main() -> None:
             # every projection + the tied head): the decode tail is
             # weight-traffic bound, so this is where q8's halved HBM
             # bytes should show up end to end
-            "decode_prompt3968_new128_q8": lambda: bench_decode(
-                quantized=True),
+            # int8 weights AND int8 KV cache — the full int8 serving
+            # config (the earlier decode_..._q8 key measured weights
+            # only; renamed so results stay comparable across runs)
+            "decode_prompt3968_new128_q8wkv": lambda: bench_decode(
+                quantized=True, kv_q8=True),
             # end-to-end conv training (BASELINE configs 3-4)
             "lenet5_cifar_train_b1024": lambda: bench_conv_train(
                 "lenet5_cifar", 1024),

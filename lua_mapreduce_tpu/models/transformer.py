@@ -35,7 +35,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from lua_mapreduce_tpu.ops.attention import _tile_mask, flash_attention
-from lua_mapreduce_tpu.ops.decode import decode_attention
+from lua_mapreduce_tpu.ops.decode import decode_attention, quantize_kv
 from lua_mapreduce_tpu.ops.q8 import q8_matmul, quantize_q8
 from lua_mapreduce_tpu.parallel import moe as _moe
 from lua_mapreduce_tpu.parallel import zero1 as _z1
@@ -524,7 +524,8 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
                   top_k: Optional[int] = None,
                   key=None, use_prefill: bool = False, mesh=None,
                   attn: str = "ring", dp_axis: str = "dp",
-                  sp_axis: str = "sp") -> jnp.ndarray:
+                  sp_axis: str = "sp",
+                  kv_q8: bool = False) -> jnp.ndarray:
     """KV-cached decoding: (B, P) int32 prompt → (B, P+n_new).
 
     The inference half of the LM family (training: make_train_step).
@@ -550,6 +551,15 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     drop ORDER differs (the oracle's cumulative token order runs over
     the whole (B, L) tile, a step's over its B tokens), matching the
     train-time rule that capacity semantics follow the routing group.
+
+    ``kv_q8=True`` stores the KV caches int8 with per-row f32 scales
+    (ops/decode.quantize_kv): the cache is the dominant decode byte
+    stream, so its HBM traffic halves. Rows quantize as they are
+    written (prefill caches quantize once at the boundary); the fused
+    decode kernel folds the scales into its contractions without ever
+    materializing a dequantized cache. A serving knob, orthogonal to
+    ``quantize_lm`` (int8 weights) — the two compose into the full
+    int8 serving story.
 
     ``use_prefill=True`` ingests the prompt with :func:`prefill` — one
     parallel causal forward instead of P sequential steps — then scans
@@ -599,12 +609,20 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
     cache_len = cfg.window if roll else total
     # caches ride the scan carry as (B, H_kv, S, D) — per-(batch, head)
     # rows contiguous, the ops/decode.py layout contract (no per-step
-    # transpose for the fused kernel OR the XLA einsums)
+    # transpose for the fused kernel OR the XLA einsums). ``kv_q8``
+    # stores them int8 with per-row f32 scales (ops/decode.quantize_kv)
+    # — half the dominant decode byte stream; serving accuracy, not
+    # training semantics (the scan quantizes each row as it is written)
+    cache_dtype = jnp.int8 if kv_q8 else params["tok_emb"].dtype
     caches = {
-        f"L{i}_{kv}": jnp.zeros((b, hkv, cache_len, hd),
-                                params["tok_emb"].dtype)
+        f"L{i}_{kv}": jnp.zeros((b, hkv, cache_len, hd), cache_dtype)
         for i in range(cfg.n_layers) for kv in ("k", "v")
     }
+    if kv_q8:
+        caches.update({
+            f"L{i}_{kv}s": jnp.zeros((b, hkv, cache_len), jnp.float32)
+            for i in range(cfg.n_layers) for kv in ("k", "v")
+        })
     # position t reads its input from `prompt` while t < p_len, else the
     # previously generated token riding the carry
     pad = jnp.zeros((b, total - p_len), jnp.int32)
@@ -636,6 +654,16 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
             # the grouping decode_attention's (B, Hkv, G, D) q expects
             q = q.reshape(b, hkv, g, hd)
             slot = t % cache_len if roll else t
+            scales = {}
+            if kv_q8:
+                k, ks_row = quantize_kv(k)
+                v, vs_row = quantize_kv(v)
+                cks = lax.dynamic_update_slice(
+                    caches[f"{pfx}_ks"], ks_row, (0, 0, slot))
+                cvs = lax.dynamic_update_slice(
+                    caches[f"{pfx}_vs"], vs_row, (0, 0, slot))
+                caches = {**caches, f"{pfx}_ks": cks, f"{pfx}_vs": cvs}
+                scales = {"k_scale": cks, "v_scale": cvs}
             ck = lax.dynamic_update_slice(
                 caches[f"{pfx}_k"], k, (0, 0, slot, 0))
             cv = lax.dynamic_update_slice(
@@ -646,7 +674,7 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
             # composition elsewhere. Non-roll windows are total-length
             # (roll covers window < total), so slot<=t IS the mask.
             a = decode_attention(q, ck, cv, t, roll=roll,
-                                 backend="auto")
+                                 backend="auto", **scales)
             a = a.astype(x.dtype).reshape(b, 1, cfg.d_model)
             x = x + _mm(params, f"{pfx}_out_W", a)
             y = _norm(params, f"{pfx}_ln2", x, cfg)
@@ -681,9 +709,15 @@ def greedy_decode(params: Params, prompt, n_new: int, *,
         # one per step
         caches = {n: jnp.transpose(c, (0, 2, 1, 3))
                   for n, c in caches.items()}
+        if kv_q8:
+            quant = {}
+            for n, c in caches.items():
+                quant[n], quant[n + "s"] = quantize_kv(c)
+            caches = quant
         if roll:
             # fold the prompt cache into the rolling layout: slot j
-            # holds the LAST prompt position ≡ j (mod w)
+            # holds the LAST prompt position ≡ j (mod w). Scale entries
+            # (kv_q8) are (B, H_kv, S) — same slot axis, same fold.
             if p_len >= cache_len:
                 j = jnp.arange(cache_len)
                 src = p_len - 1 - ((p_len - 1 - j) % cache_len)

@@ -94,6 +94,54 @@ class TestDecodeParity:
                                        np.asarray(ref),
                                        rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.parametrize("shape", [(2, 4, 1, 64, 256),
+                                       (2, 2, 2, 64, 300)])  # ragged
+    def test_q8_kernel_matches_xla(self, shape):
+        """int8-cache path: the kernel's factored-out scales must
+        reproduce the XLA q8 composition (same rounding points), live
+        range, roll, and ragged tail included."""
+        from lua_mapreduce_tpu.ops.decode import quantize_kv
+
+        b, hkv, g, d, s_len = shape
+        q, k, v = _args(*shape, seed=7)
+        q = q.astype(jnp.bfloat16)
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        for t in [0, s_len // 2, s_len - 1]:
+            for roll in (False, True):
+                ref = decode_attention(q, kq, vq, jnp.int32(t),
+                                       roll=roll, k_scale=ks,
+                                       v_scale=vs, backend="xla")
+                got = decode_attention(q, kq, vq, jnp.int32(t),
+                                       roll=roll, k_scale=ks,
+                                       v_scale=vs,
+                                       backend="pallas_interpret")
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(ref), rtol=5e-3,
+                    atol=5e-3, err_msg=f"t={t} roll={roll}")
+
+    def test_q8_close_to_full_precision(self):
+        """Quantization noise at d=64 stays under ~2% of the full-
+        precision result — the accuracy budget kv_q8 serving spends."""
+        from lua_mapreduce_tpu.ops.decode import quantize_kv
+
+        q, k, v = _args(1, 2, 2, 64, 256, seed=8)
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        full = decode_attention(q, k, v, jnp.int32(255), backend="xla")
+        q8 = decode_attention(q, kq, vq, jnp.int32(255), k_scale=ks,
+                              v_scale=vs, backend="xla")
+        rel = float(jnp.abs(full - q8).max() / jnp.abs(full).max())
+        assert rel < 0.02, rel
+
+    def test_scales_must_come_together(self):
+        from lua_mapreduce_tpu.ops.decode import quantize_kv
+
+        q, k, v = _args(1, 1, 1, 64, 128)
+        kq, ks = quantize_kv(k)
+        with pytest.raises(ValueError, match="together"):
+            decode_attention(q, kq, v, jnp.int32(0), k_scale=ks)
+
     def test_bad_backend_rejected(self):
         q, k, v = _args(1, 1, 1, 64, 128)
         with pytest.raises(ValueError, match="unknown backend"):

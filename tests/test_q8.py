@@ -191,3 +191,13 @@ class TestQuantizedLM:
         quant_p = np.asarray(tfm.greedy_decode(qp, prompt, 8, cfg=cfg,
                                                use_prefill=True))
         np.testing.assert_array_equal(full, quant_p)
+        # the FULL int8 serving config: int8 weights + int8 KV cache
+        # (ops/decode.quantize_kv), scan and prefill ingestion — a
+        # trained model's logit margins dwarf both noise sources
+        both = np.asarray(tfm.greedy_decode(qp, prompt, 8, cfg=cfg,
+                                            kv_q8=True))
+        np.testing.assert_array_equal(full, both)
+        both_p = np.asarray(tfm.greedy_decode(qp, prompt, 8, cfg=cfg,
+                                              kv_q8=True,
+                                              use_prefill=True))
+        np.testing.assert_array_equal(full, both_p)

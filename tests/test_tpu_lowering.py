@@ -200,6 +200,17 @@ class TestDecodeLowering:
         export_tpu(lambda q_, k_, v_, t_: _decode_pallas(q_, k_, v_, t_),
                    q, kv, kv, t)
 
+    def test_decode_kernel_q8(self):
+        from lua_mapreduce_tpu.ops.decode import _decode_pallas
+
+        q = jax.ShapeDtypeStruct((4, 16, 1, 64), jnp.bfloat16)
+        kv = jax.ShapeDtypeStruct((4, 16, 4096, 64), jnp.int8)
+        sc = jax.ShapeDtypeStruct((4, 16, 4096), jnp.float32)
+        t = jax.ShapeDtypeStruct((), jnp.int32)
+        export_tpu(lambda q_, k_, v_, ks_, vs_, t_: _decode_pallas(
+            q_, k_, v_, t_, k_scale=ks_, v_scale=vs_),
+            q, kv, kv, sc, sc, t)
+
     def test_decode_kernel_rolling(self):
         from lua_mapreduce_tpu.ops.decode import _decode_pallas
 
