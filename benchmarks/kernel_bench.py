@@ -522,7 +522,8 @@ def bench_conv_train(model: str, batch: int, steps: int = 10) -> dict:
 
 def bench_decode(d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
                  vocab=32768, max_seq=4096, prompt_len=3968, n_new=128,
-                 batch=4, quantized=False, kv_q8=False) -> dict:
+                 batch=4, quantized=False, kv_q8=False,
+                 kv_heads=0) -> dict:
     """LM inference bench: long-prompt generation, prefill vs the
     from-scratch position scan. Reports prompt-ingestion speedup and
     decode tokens/sec — the serving-side counterpart of
@@ -540,7 +541,8 @@ def bench_decode(d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
 
     cfg = tfm.TransformerConfig(vocab=vocab, d_model=d_model,
                                 n_heads=n_heads, n_layers=n_layers,
-                                d_ff=d_ff, max_seq=max_seq)
+                                d_ff=d_ff, max_seq=max_seq,
+                                n_kv_heads=kv_heads)
     params = jax.tree.map(
         lambda x: x.astype(jnp.bfloat16),
         tfm.init_transformer(jax.random.PRNGKey(0), cfg))
@@ -574,6 +576,7 @@ def bench_decode(d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
     return {
         "config": (f"d{d_model} h{n_heads} L{n_layers} v{vocab} "
                    f"prompt{prompt_len} new{n_new} b{batch} bf16"
+                   + (f" gqa{n_heads//kv_heads}:1" if kv_heads else "")
                    + (" w-int8" if quantized else "")
                    + (" kv-int8" if kv_q8 else "")),
         "prefill_total_s": round(dt_pre, 3),
@@ -758,6 +761,10 @@ def main() -> None:
             # only; renamed so results stay comparable across runs)
             "decode_prompt3968_new128_q8wkv": lambda: bench_decode(
                 quantized=True, kv_q8=True),
+            # GQA serving (DESIGN 13 remedy 1): 4:1 grouping reads a
+            # quarter of the cache per step
+            "decode_prompt3968_new128_gqa4": lambda: bench_decode(
+                kv_heads=4),
             # end-to-end conv training (BASELINE configs 3-4)
             "lenet5_cifar_train_b1024": lambda: bench_conv_train(
                 "lenet5_cifar", 1024),
