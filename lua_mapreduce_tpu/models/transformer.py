@@ -34,7 +34,7 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from lua_mapreduce_tpu.ops.attention import _tile_mask, flash_attention
+from lua_mapreduce_tpu.ops.attention import flash_attention
 from lua_mapreduce_tpu.ops.decode import decode_attention, quantize_kv
 from lua_mapreduce_tpu.ops.q8 import q8_matmul, quantize_q8
 from lua_mapreduce_tpu.parallel import moe as _moe
