@@ -73,6 +73,10 @@ def time_config(seq, bq, bk, grad, target_s=0.35, b=4, heads=8, d=128):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", default="2048,4096")
+    ap.add_argument("--install", action="store_true",
+                    help="write results/flash_tune.json (full rows + "
+                         "provenance) instead of leaving installation "
+                         "to the caller; only a real-TPU run installs")
     args = ap.parse_args()
 
     from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
@@ -110,6 +114,22 @@ def main():
                             {"error": "no runnable config", "all": rows})
     print(json.dumps({k: {kk: vv for kk, vv in v.items() if kk != "all"}
                       for k, v in results.items()}))
+    if args.install:
+        import time
+        results["provenance"] = (
+            "benchmarks/flash_tune.py --install, "
+            + jax.devices()[0].device_kind + ", "
+            + time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+            + "; candidates swept fwd AND fwdbwd per sequence length; "
+            "ops/attention.py's _DEFAULT_BLOCK_Q/K must match the "
+            "winners (tests/test_policy_artifact.py).")
+        dest = os.path.join(REPO, "benchmarks", "results",
+                            "flash_tune.json")
+        with open(dest + ".tmp", "w") as f:
+            json.dump(results, f, indent=1)
+            f.write("\n")
+        os.replace(dest + ".tmp", dest)
+        print(f"installed {dest}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -180,8 +180,16 @@ def _run_inner(args, jax) -> dict:
         args.sp = 2 if nv % 2 == 0 else 1
         ga = max(args.grad_accum, 1)
         args.dp = next(
-            d for d in range(nv // args.sp, 0, -1)
-            if args.batch % d == 0 and (args.batch // d) % ga == 0)
+            (d for d in range(nv // args.sp, 0, -1)
+             if args.batch % d == 0 and (args.batch // d) % ga == 0),
+            None)
+        if args.dp is None:
+            raise SystemExit(
+                f"no feasible dp: batch={args.batch} must split as "
+                f"batch % dp == 0 with (batch // dp) % grad_accum == 0 "
+                f"for some dp <= {nv // args.sp} (grad_accum={ga}) — "
+                f"adjust --batch or --grad-accum, or pass --dp/--sp "
+                f"explicitly")
     elif not args.dp or not args.sp:
         free = len(devices) // max(args.dp, args.sp, 1)
         args.dp = args.dp or free

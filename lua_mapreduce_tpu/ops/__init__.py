@@ -36,11 +36,13 @@ import jax
 # sweep-tuned (512, 512) blocks (flash_tune.json, two sweep rounds):
 #   speed — fwd 3.14× XLA at L=2048 and 9.69× at L=4096, fused
 #   backward 3.99× (flash_*/flash_grad_* entries);
-#   memory — the XLA composition's compiled buffer assignment holds ~4
-#   L²-sized temps across fwd+bwd (attn_memory.json, TPU-keyed): 4.13
-#   GiB at (b=2, h=8, L=4096, d=128) vs the fused pair's 0.178 GiB of
-#   O(L) residents (23×; 57× by L=8192), the gap doubling per context
-#   doubling — while the Pallas pair (forward + FlashAttention-2
+#   memory — the XLA composition's compiled buffer assignment holds
+#   L²-sized temps across fwd+bwd (attn_memory.json, tpu section): 2.00
+#   GiB of grad temps at (b=2, h=8, L=4096, d=128) vs the fused pair's
+#   0.178 GiB of O(L) residents (11.3×; 4.06 GiB / 22.9× by L=8192),
+#   the gap doubling per context doubling (the CPU buffer-assignment
+#   analysis, DESIGN §9, shows the same growth at ~2× the absolute
+#   temps) — while the Pallas pair (forward + FlashAttention-2
 #   backward re-materializing p from the saved logsumexp) never
 #   materializes O(L²).
 # Softmax is a wash; XLA wins on fusion-with-neighbors grounds.

@@ -266,10 +266,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 
 
 # Tuned defaults from the on-chip sweep (benchmarks/flash_tune.py →
-# results/flash_tune.json, v5e 2026-07-31, two rounds): (512, 512) is
-# the decisive winner at every swept shape — fwd 0.501 ms at L=2048
-# (vs 2.05 ms at the original (128, 128), 0.774 at (256, 256)) and
-# 1.80× the (256, 256) schedule on the L=4096 training path. Bigger
+# results/flash_tune.json, second-round sweep, v5e 2026-07-31
+# 11:32-11:38 UTC): (512, 512) is the decisive winner at every swept
+# shape — fwd 0.501 ms at L=2048 (vs 2.077 ms at the original
+# (128, 128), 0.778 at (256, 256)) and 1.80× the (256, 256) schedule
+# on the L=4096 training path (6.545 vs 11.756 ms fwdbwd). Bigger
 # tiles amortize the per-tile online-softmax state updates and halve
 # the number of VMEM-refill boundaries; the f32 score tile at 512² is
 # 1 MB, q/kv tiles 128 KB each at d=128 — comfortably inside VMEM

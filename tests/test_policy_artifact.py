@@ -70,6 +70,27 @@ def test_policy_matches_measurement(op, entries):
     # rationale comment in ops/__init__.py carries the argument
 
 
+def test_flash_block_defaults_match_tuner_artifact():
+    """ADVICE r4 (medium): ops/attention.py's default (block_q, block_k)
+    schedule is a perf claim, so it must equal the committed sweep's
+    winner for every swept shape (benchmarks/results/flash_tune.json) —
+    a re-sweep that crowns different blocks turns the suite red until
+    the defaults (and their rationale comment) follow the artifact."""
+    from lua_mapreduce_tpu.ops import attention
+
+    path = os.path.join(os.path.dirname(ART), "flash_tune.json")
+    with open(path) as f:
+        tune = json.load(f)
+    winners = {tag: tuple(v["best_blocks"]) for tag, v in tune.items()
+               if isinstance(v, dict) and "best_blocks" in v}
+    assert winners, "flash_tune.json carries no sweep winners"
+    default = (attention._DEFAULT_BLOCK_Q, attention._DEFAULT_BLOCK_K)
+    for tag, best in sorted(winners.items()):
+        assert default == best, (
+            f"flash default blocks {default} != flash_tune.json's "
+            f"{tag} winner {best}; re-tune or update the defaults")
+
+
 def test_artifact_is_tpu_measured():
     """The committed artifact must be real-chip evidence — a CPU
     fallback must never silently replace it (kernel_bench refuses at
