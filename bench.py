@@ -1,22 +1,27 @@
-"""Benchmark: digits-MLP data-parallel training throughput.
+"""Flagship benchmark. Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", ...detail fields}.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Headline on a real chip (VERDICT r4 weak-1): the llama-style LM
+training step's MFU (flash attention + RoPE/RMS/SwiGLU/GQA, fused grad
+all-reduce, optimizer — the realest workload the framework trains),
+with ``vs_baseline`` = measured MFU / the BASELINE.md ≥50% north star.
 
-Metric: images/sec/chip on the BASELINE.json flagship workload (the
-reference's APRIL-ANN digits MLP, 256→128 tanh→10 log_softmax, trained with
-synchronous data-parallel SGD).
+Also reported every run: the BASELINE.json digits workload (the
+reference's APRIL-ANN digits MLP, 256→128 tanh→10 log_softmax, trained
+with synchronous data-parallel SGD), as images/sec/chip plus
+``digits_native_vs_mapreduce_path`` — the reference publishes no number
+for its NN-training example (BASELINE.md: "published is empty"), so the
+comparison is architectural: the identical workload through the
+six-function MapReduce engine (map = grad shards, shuffle by parameter
+name, reduce = grad sum, finalfn = optimizer step —
+examples/digits/mr_train.py, the faithful re-expression of
+examples/APRIL-ANN/common.lua) vs the TPU-native zero-coordination hot
+loop.
 
-``vs_baseline``: the reference publishes no number for its NN-training
-example (BASELINE.md: "published is empty"), so the baseline is the
-reference's *architecture* measured on this machine: the identical
-training workload run through the six-function MapReduce engine
-(map = grad shards, shuffle by parameter name, reduce = grad sum,
-finalfn = optimizer step — examples/digits/mr_train.py, the faithful
-re-expression of examples/APRIL-ANN/common.lua). vs_baseline =
-tpu_native_throughput / mapreduce_path_throughput — i.e. how much the
-TPU-native hot loop beats the coordination-driven loop, the ratio the
-BASELINE.json north star targets ("zero coordination round-trips on the
-hot path").
+On a CPU fallback (wedged tunnel) the headline stays the honestly-live
+digits metric and a ``committed_tpu`` tail transports the newest
+committed on-chip artifacts with their provenance (VERDICT r4 item 8) —
+the driver channel carries the silicon evidence either way.
 """
 
 from __future__ import annotations
@@ -147,6 +152,42 @@ def bench_mapreduce_path(iterations: int = 3) -> float:
     return iterations * n_shards * bunch / dt
 
 
+def _committed_tpu_tail() -> dict:
+    """VERDICT r4 item 8: when the live run falls back to CPU (wedged
+    tunnel), the driver-captured JSON must still TRANSPORT the newest
+    committed on-chip evidence — explicitly labeled as committed, with
+    its provenance, never mixed into the live fields."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = {"note": ("live run fell back to CPU (wedged axon tunnel); "
+                    "the fields below are the newest COMMITTED on-chip "
+                    "artifacts from benchmarks/results/, each carrying "
+                    "its own provenance — they are NOT this run's "
+                    "measurements")}
+    try:
+        with open(os.path.join(here, "benchmarks", "results",
+                               "bench_digits.json")) as f:
+            out["bench_digits"] = json.load(f)
+    except Exception as e:
+        out["bench_digits_error"] = f"{type(e).__name__}: {e}"[:120]
+    try:
+        with open(os.path.join(here, "benchmarks", "results",
+                               "kernels.json")) as f:
+            kern = json.load(f)
+        picks = ("device_kind", "transformer_step_llama_style",
+                 "transformer_step_d1024_L8_s2048",
+                 "transformer_step_s4096", "flash_s2048_h8_d128_causal",
+                 "flash_s4096_h8_d128_causal", "flash_s8192_h8_d128_causal",
+                 "flash_grad_s2048_h8_d128_causal",
+                 "decode_prompt3968_new128", "decode_prompt3968_new128_q8wkv",
+                 "decode_prompt3968_new128_gqa4")
+        out["kernels_headline"] = {k: kern[k] for k in picks if k in kern}
+        out["kernels_provenance"] = kern.get("note", "")[:600]
+    except Exception as e:
+        out["kernels_error"] = f"{type(e).__name__}: {e}"[:120]
+    return out
+
+
 def main() -> None:
     # a wedged single-tenant TPU tunnel hangs backend init forever; probe
     # from a killable subprocess and fall back to CPU rather than hang.
@@ -161,47 +202,68 @@ def main() -> None:
     from lua_mapreduce_tpu.models.mlp import DIGITS_SIZES, flops_per_example
     from lua_mapreduce_tpu.utils.roofline import mfu, peak_flops_per_s
 
+    on_tpu = jax.devices()[0].platform == "tpu"
     native_per_chip = bench_tpu_native()
     native_total = native_per_chip * len(jax.devices())
     mr_total = bench_mapreduce_path()
     peak = peak_flops_per_s()
     mfu_digits = mfu(native_per_chip * flops_per_example(DIGITS_SIZES), 1.0)
     mfu_wide, wide_flops, mfu_config = bench_mfu_wide()
-    # a REAL-workload MFU next to the synthetic-MLP one: the LM train
-    # step (flash attention + fused grad all-reduce + optimizer). TPU
-    # only — at this size a CPU fallback run would take hours and the
-    # number would mean nothing.
+    # the REAL-workload number next to the synthetic-MLP one: the
+    # llama-style LM train step (flash attention + RoPE/RMS/SwiGLU/GQA,
+    # fused grad all-reduce, optimizer). TPU only — at this size a CPU
+    # fallback run would take hours and the number would mean nothing.
     lm = {}
-    if jax.devices()[0].platform == "tpu":
+    if on_tpu:
         try:
             from benchmarks.kernel_bench import bench_transformer_step
-            r = bench_transformer_step()
+            r = bench_transformer_step(modern=True)
             lm = {"lm_train_mfu": r["mfu"],
                   "lm_train_ms_per_step": r["ms_per_step"],
                   "lm_train_tokens_per_sec": r["tokens_per_sec"],
                   "lm_train_config": r["config"]}
         except Exception as e:     # never sink the flagship metric
             lm = {"lm_train_error": f"{type(e).__name__}: {e}"[:200]}
-    print(json.dumps({
-        "metric": "digits_mlp_dp_training_images_per_sec_per_chip",
-        "value": round(native_per_chip, 1),
-        "unit": "images/sec/chip",
+
+    digits_fields = {
+        "digits_images_per_sec_per_chip": round(native_per_chip, 1),
         # total/total: same quantity in numerator and denominator, so the
         # ratio is comparable across machine sizes
-        "vs_baseline": round(native_total / mr_total, 2),
+        "digits_native_vs_mapreduce_path": round(native_total / mr_total, 2),
         # roofline (BASELINE.md ≥50% MFU north star): model FLOPs per
         # second over chip peak bf16 FLOP/s (utils/roofline.py table).
         # The digits MLP (256→128→10) cannot fill a 128×128 systolic
-        # array — its honest MFU is tiny; mfu is the same training hot
-        # loop on an MXU-sized model (8192-square bf16 matmuls).
-        "mfu": round(mfu_wide, 4),
-        "mfu_config": mfu_config,
-        "mfu_achieved_flops_per_s_per_chip": round(wide_flops, 1),
+        # array — its honest MFU is tiny; mfu_wide_mlp is the same
+        # training hot loop on an MXU-sized model (8192-square bf16).
+        "mfu_wide_mlp": round(mfu_wide, 4),
+        "mfu_wide_config": mfu_config,
+        "mfu_wide_achieved_flops_per_s_per_chip": round(wide_flops, 1),
         "mfu_digits_mlp": round(mfu_digits, 6),
         "peak_bf16_flops_per_s": peak,
         "device_kind": jax.devices()[0].device_kind,
-        **lm,
-    }))
+    }
+    if on_tpu and "lm_train_mfu" in lm:
+        # VERDICT r4 weak-1: the first number a reader (or the driver
+        # parser) sees must be the most meaningful one — the llama-style
+        # LM training step, scored against the ≥50%-MFU north star.
+        out = {
+            "metric": "llama_style_lm_train_mfu",
+            "value": lm["lm_train_mfu"],
+            "unit": "MFU (bf16 model FLOPs / chip peak)",
+            "vs_baseline": round(lm["lm_train_mfu"] / 0.50, 3),
+            **lm, **digits_fields,
+        }
+    else:
+        out = {
+            "metric": "digits_mlp_dp_training_images_per_sec_per_chip",
+            "value": round(native_per_chip, 1),
+            "unit": "images/sec/chip",
+            "vs_baseline": round(native_total / mr_total, 2),
+            **lm, **digits_fields,
+        }
+        if not on_tpu:
+            out["committed_tpu"] = _committed_tpu_tail()
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

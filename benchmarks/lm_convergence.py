@@ -1,0 +1,145 @@
+"""Sprint phase H: LM convergence one notch up (VERDICT r4 weak-5 /
+next-7 — the committed convergence pins are d64/vocab-64 toys; this is
+a d256, word-vocab run at a scale where the flash path and the ZeRO-1
+machinery actually engage, with a loss curve, tokens/sec, and a sample
+that reads like language).
+
+Corpus: a few MB of real English assembled ON THIS BOX (zero egress)
+from the system's package-license prose (/usr/share/doc/*/copyright,
+deduplicated by content) plus this repo's documentation. Tokenizer:
+examples/lm's word-level mode (top-8191 corpus words + <unk>), so the
+embedding/softmax is a real lane-aligned vocab, not 64 chars.
+
+Convergence criterion: early stopping on held-out validation loss
+(patience 10 evals), the reference's APRIL-ANN discipline — the
+artifact records the full train/val curve, the best val loss and step,
+throughput, platform, and the decoded sample. A CPU run never
+overwrites a committed TPU artifact.
+
+Usage: python benchmarks/lm_convergence.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "benchmarks", "results", "lm_convergence.json")
+CORPUS = "/tmp/lm_corpus_r5.txt"
+
+
+def build_corpus(target_bytes: int = 4 << 20) -> str:
+    """Concatenate deduplicated license prose + repo docs into one text
+    file; deterministic on a given box (sorted traversal)."""
+    seen, parts, total = set(), [], 0
+    for p in [os.path.join(REPO, n)
+              for n in ("README.md", "docs/DESIGN.md", "SURVEY.md")]:
+        try:
+            t = open(p, encoding="utf-8", errors="replace").read()
+            parts.append(t)
+            total += len(t)
+        except OSError:
+            pass
+    for p in sorted(glob.glob("/usr/share/doc/*/copyright")):
+        if total >= target_bytes:
+            break
+        try:
+            t = open(p, encoding="utf-8", errors="replace").read()
+        except OSError:
+            continue
+        h = hashlib.sha256(t.encode()).hexdigest()
+        if h in seen:               # qt/perl ship dozens of identical files
+            continue
+        seen.add(h)
+        parts.append(t)
+        total += len(t)
+    text = "\n\n".join(parts)
+    with open(CORPUS, "w", encoding="utf-8") as f:
+        f.write(text)
+    return CORPUS
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny-budget smoke (CI): prove the pipeline, "
+                         "don't write the committed artifact")
+    args = ap.parse_args()
+
+    from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
+    force_cpu_if_unavailable()
+    import jax
+    platform = jax.default_backend()
+
+    corpus = build_corpus()
+    size = os.path.getsize(corpus)
+    print(f"corpus: {corpus} ({size / 1e6:.1f} MB), platform={platform}",
+          file=sys.stderr)
+
+    tmp_json = "/tmp/lm_convergence_run.json"
+    cmd = [sys.executable, os.path.join(REPO, "examples/lm/train_lm.py"),
+           "--data", corpus, "--tok", "word:8192",
+           "--modern", "--attn", "ring", "--zero1", "--bf16",
+           "--d-model", "256", "--n-layers", "4", "--n-heads", "4",
+           "--d-ff", "1024", "--seq", "512", "--batch", "16",
+           "--grad-accum", "1", "--dp", "1", "--sp", "1",
+           "--val-frac", "0.05", "--eval-every", "50",
+           "--patience", "10", "--steps", "3000",
+           "--out-json", tmp_json]
+    if args.quick:
+        cmd[cmd.index("--steps") + 1] = "8"
+        cmd[cmd.index("--eval-every") + 1] = "4"
+        cmd[cmd.index("--d-model") + 1] = "32"
+        cmd[cmd.index("--d-ff") + 1] = "64"
+        cmd[cmd.index("--n-layers") + 1] = "1"
+        cmd[cmd.index("--seq") + 1] = "64"
+        cmd[cmd.index("--batch") + 1] = "4"
+    elif platform != "tpu":
+        cmd[cmd.index("--steps") + 1] = "500"     # CPU wall-clock bound
+
+    env = dict(os.environ, PYTHONPATH=REPO + ":"
+               + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run(cmd, env=env, cwd=REPO, text=True,
+                       capture_output=True, timeout=5100)
+    sys.stderr.write(r.stdout[-3000:] + r.stderr[-2000:])
+    if r.returncode != 0:
+        print(json.dumps({"error": f"train_lm rc={r.returncode}"}))
+        return 1
+    with open(tmp_json) as f:
+        summary = json.load(f)
+    sample_line = [ln for ln in r.stdout.splitlines()
+                   if ln.startswith("sample:")]
+    artifact = {
+        "corpus_bytes": size,
+        "corpus_source": "dedup'd /usr/share/doc/*/copyright prose + "
+                         "repo docs (built on-box, zero egress)",
+        "sample": sample_line[-1][len("sample: "):] if sample_line else None,
+        **summary,
+    }
+    if args.quick:
+        print(json.dumps(artifact))
+        return 0
+    if os.path.exists(OUT):
+        prior = json.load(open(OUT))
+        if prior.get("platform") == "tpu" and platform != "tpu":
+            print(json.dumps({"skipped": "committed artifact is TPU; "
+                                         "this CPU run won't clobber it"}))
+            return 1
+    with open(OUT + ".tmp", "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    os.replace(OUT + ".tmp", OUT)
+    print(json.dumps(artifact))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+#!/bin/bash
+# Round-5 hardware sprint (VERDICT r4 items 1-7): harvest a TPU window
+# in strict leverage order. Every phase is
+#   - stamped: benchmarks/results/r5_stamps/<phase>.done — a wedge
+#     mid-sprint loses nothing already finished, and the next window
+#     resumes at the first un-stamped phase;
+#   - timeout-guarded: the axon tunnel wedges mid-run (round 4's final
+#     bench.py hung and had to be hand-killed), so each phase gets
+#     SIGTERM then SIGKILL rather than holding the sprint hostage;
+#   - probe-gated: before each phase the tunnel is re-probed from a
+#     killable subprocess; if the window closed, exit 3 so the watcher
+#     goes back to polling instead of burning timeouts serially.
+# The chip is single-tenant: phases run strictly sequentially.
+set -u
+cd "$(dirname "$0")/.."
+STAMPS=benchmarks/results/r5_stamps
+mkdir -p "$STAMPS"
+LOG=benchmarks/results/tpu_probe_log.txt
+
+probe () {
+  timeout -k 30 150 python - <<'PY'
+import sys
+sys.path.insert(0, ".")
+from lua_mapreduce_tpu.utils.jax_env import probe_backend
+sys.exit(0 if probe_backend(timeout_s=120.0, fresh=True) else 1)
+PY
+}
+
+phase () {  # phase <name> <timeout_s> <cmd...>
+  local name="$1" tmo="$2"; shift 2
+  if [ -e "$STAMPS/$name.done" ]; then
+    echo "--- $name: already done, skipping"
+    return 0
+  fi
+  if ! probe; then
+    echo "$(date -u +%FT%TZ) window closed before phase $name" >> "$LOG"
+    exit 3
+  fi
+  echo "=== $name $(date -u +%H:%M:%S) (timeout ${tmo}s) ==="
+  timeout -k 30 "$tmo" "$@" > "/tmp/r5_$name.log" 2>&1
+  local rc=$?
+  echo "$(date -u +%FT%TZ) phase $name rc=$rc" >> "$LOG"
+  if [ "$rc" -eq 0 ]; then
+    touch "$STAMPS/$name.done"
+  else
+    tail -5 "/tmp/r5_$name.log"
+  fi
+  return 0   # a failed phase must not block the ones after it
+}
+
+# -- A: the round-4 serving stack, built + lowering-pinned, never timed
+#    on silicon (VERDICT r4 missing-1 / next-1: the single highest-
+#    leverage measurement of the round; ~30-40x headroom predicted by
+#    DESIGN 13's bandwidth-floor math).
+phase A_serving 2400 python benchmarks/kernel_bench.py \
+    --only decode_prompt3968,transformer_step_s4096,flash_s8192
+
+# -- B: MoE re-measure + profile breakdown (VERDICT r4 missing-5 /
+#    next-4: 472 ms vs 164 ms dense needs a quantified verdict; the
+#    sorted-routing fix needs its step number).
+phase B_moe 2400 bash -c "python benchmarks/moe_profile.py && \
+    python benchmarks/kernel_bench.py --only transformer_step_moe8"
+
+# -- C: bench.py re-baseline (VERDICT r4 weak-2: committed 35.1%
+#    lm_train_mfu predates the (512,512) flash blocks that kernels.json's
+#    45.8%/51.0% used; two artifacts must stop disagreeing).
+phase C_bench 2400 python benchmarks/hw_rebaseline.py
+
+# -- D: flash_tune regeneration (ADVICE r4 medium: the committed tuner
+#    artifact predates the (512,512) defaults it is cited for).
+phase D_flashtune 3600 python benchmarks/flash_tune.py --install
+
+# -- E: k-means/ALS on the chip (VERDICT r4 missing-3 / next-5:
+#    BASELINE config 5 has only a CPU artifact).
+phase E_kmeans 1800 python benchmarks/kmeans_als_artifact.py
+
+# -- F: ResNet-18 ImageNet-shape canaries (VERDICT r4 missing-2 /
+#    next-3: the tunnel's compile helper 500s at 224x224; find the size
+#    cliff and commit the nearest compiling ImageNet-shape number).
+phase F_resnet 3600 python benchmarks/kernel_bench.py \
+    --only resnet18_im112,resnet18_im160,resnet18_im176,resnet18_im192,resnet18_imagenet
+
+# -- G: LeNet per-stage roofline evidence (VERDICT r4 weak-4: 0.06% MFU
+#    has no ceiling statement; measure where the 33.6 ms/step goes).
+phase G_lenet 1800 python benchmarks/lenet_roofline.py
+
+# -- H: LM convergence one notch up (VERDICT r4 weak-5 / next-7:
+#    d256+real-vocab to a fixed val target, where flash+ZeRO-1 engage).
+phase H_lmconv 5400 python benchmarks/lm_convergence.py
+
+PHASES=$(grep -oE '^phase [A-Za-z0-9_]+' "$0" | awk '{print $2}')
+missing=""
+for p in $PHASES; do
+  [ -e "$STAMPS/$p.done" ] || missing="$missing $p"
+done
+if [ -z "$missing" ]; then
+  echo "=== r5 sprint complete $(date -u +%H:%M:%S) ==="
+  echo "$(date -u +%FT%TZ) r5 sprint: all phases stamped" >> "$LOG"
+  touch "$STAMPS/all.done"     # the ONE completion signal the watcher
+                               # consumes (review: no duplicated phase
+                               # bookkeeping outside this script)
+else
+  echo "=== r5 sprint pass done $(date -u +%H:%M:%S); unstamped:$missing ==="
+  echo "$(date -u +%FT%TZ) r5 sprint pass done; unstamped:$missing" >> "$LOG"
+fi

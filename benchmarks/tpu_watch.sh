@@ -1,13 +1,17 @@
 #!/bin/bash
-# Opportunistic TPU-window watcher (VERDICT r3 item 1): probe the axon
-# tunnel from a killable subprocess every ~9 min; on the first open
-# window, regenerate every TPU artifact (kernel bench incl. the fixed
-# flash entries, block-size sweeps, the flagship bench) and exit. Every
-# probe is appended to benchmarks/results/tpu_probe_log.txt — the
-# committed evidence of whether a window ever opened this round.
+# Opportunistic TPU-window watcher (VERDICT r3 item 1, r4 items 1-7):
+# probe the axon tunnel from a killable subprocess every ~9 min; on an
+# open window run the round-5 sprint (benchmarks/r5_sprint.sh — stamped
+# phases in leverage order). Unlike the round-4 watcher this one does
+# NOT exit after the first window: the sprint resumes at the first
+# un-stamped phase, so a wedge mid-sprint just sends us back to
+# probing until the next window. Every probe is appended to
+# benchmarks/results/tpu_probe_log.txt — the committed evidence of
+# whether a window ever opened this round.
 set -u
 cd "$(dirname "$0")/.."
 LOG=benchmarks/results/tpu_probe_log.txt
+STAMPS=benchmarks/results/r5_stamps
 
 probe () {
   python - <<'PY'
@@ -19,15 +23,19 @@ PY
 }
 
 while true; do
-  if probe; then
-    echo "$(date -u +%FT%TZ) OPEN — starting artifact regeneration" >> "$LOG"
-    python benchmarks/kernel_bench.py \
-        > /tmp/kernel_bench_watch.log 2>&1
-    echo "$(date -u +%FT%TZ) kernel_bench rc=$?" >> "$LOG"
-    benchmarks/hw_sprint.sh >> /tmp/hw_sprint_watch.log 2>&1
-    echo "$(date -u +%FT%TZ) sprint chain rc=$?" >> "$LOG"
+  # the sprint owns all phase bookkeeping; it writes all.done exactly
+  # when every phase it defines is stamped (review: the watcher must
+  # not re-derive that with its own copy of the phase list)
+  if [ -e "$STAMPS/all.done" ]; then
+    echo "$(date -u +%FT%TZ) watcher: sprint reports complete, stopping" >> "$LOG"
     exit 0
   fi
-  echo "$(date -u +%FT%TZ) closed" >> "$LOG"
+  if probe; then
+    echo "$(date -u +%FT%TZ) OPEN — starting r5 sprint" >> "$LOG"
+    bash benchmarks/r5_sprint.sh >> /tmp/r5_sprint.log 2>&1
+    echo "$(date -u +%FT%TZ) r5 sprint rc=$?" >> "$LOG"
+  else
+    echo "$(date -u +%FT%TZ) closed" >> "$LOG"
+  fi
   sleep 540
 done

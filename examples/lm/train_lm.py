@@ -44,10 +44,13 @@ _CHAR_TO_ID = {c: i for i, c in enumerate(_CHARSET)}
 REPO_DOCS = "repo-docs"          # sentinel: train on this repo's docs
 
 
-def load_corpus(data: str) -> np.ndarray:
+def load_corpus(data: str, tok: str = "char"):
     """``data`` is a path to a text file, or REPO_DOCS for the repo's
     own documentation (~80 KB of real English, checked in — the 'small
-    corpus' of VERDICT r3 item 4)."""
+    corpus' of VERDICT r3 item 4). ``tok`` picks the tokenizer:
+    ``"char"`` (the 64-way charset) or ``"word:N"`` (word-level over
+    the N most frequent corpus tokens, id 0 = <unk>). Returns
+    (ids int32 array, id_to_str list, joiner string)."""
     import os
     repo = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
@@ -58,7 +61,22 @@ def load_corpus(data: str) -> np.ndarray:
         paths = [data]
     text = "\n".join(open(p, encoding="utf-8", errors="replace").read()
                      for p in paths).lower()
-    return np.array([_CHAR_TO_ID.get(c, 0) for c in text], np.int32)
+    if tok == "char":
+        ids = np.array([_CHAR_TO_ID.get(c, 0) for c in text], np.int32)
+        return ids, list(_CHARSET), ""
+    if tok.startswith("word:"):
+        import collections
+        import re
+        n_vocab = int(tok.split(":", 1)[1])
+        if n_vocab < 2:
+            raise SystemExit(f"--tok {tok}: vocab must be >= 2")
+        words = re.findall(r"[a-z0-9']+|[^\sa-z0-9']", text)
+        common = collections.Counter(words).most_common(n_vocab - 1)
+        id_to_str = ["<unk>"] + [w for w, _ in common]
+        w_to_id = {w: i for i, w in enumerate(id_to_str)}
+        ids = np.array([w_to_id.get(w, 0) for w in words], np.int32)
+        return ids, id_to_str, " "
+    raise SystemExit(f"unknown --tok {tok!r} (use 'char' or 'word:N')")
 
 
 def corpus_batch(rng, data: np.ndarray, batch: int, seq: int):
@@ -106,9 +124,18 @@ def main() -> None:
                          "batches are per-step seeded, so the resumed "
                          "run is exactly the run that never stopped")
     ap.add_argument("--data", default=None,
-                    help="char-level real-text mode: a text file path, "
+                    help="real-text mode: a text file path, "
                          f"or '{REPO_DOCS}' for this repo's docs "
                          "(default: the synthetic stride task)")
+    ap.add_argument("--tok", default="char",
+                    help="corpus tokenizer: 'char' (64-way charset) or "
+                         "'word:N' (word-level vocab of the N most "
+                         "frequent corpus tokens, id 0 = <unk> — the "
+                         "MXU-relevant embedding/softmax width)")
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--d-ff", type=int, default=128)
     ap.add_argument("--val-frac", type=float, default=0.1,
                     help="corpus tail held out for validation "
                          "(corpus mode only; 0 disables)")
@@ -204,10 +231,23 @@ def _run_inner(args, jax) -> dict:
     mesh = Mesh(np.array(devices[:n]).reshape(args.dp, args.sp),
                 ("dp", "sp"))
 
+    tok = getattr(args, "tok", "char") or "char"
+    if tok != "char" and not args.data:
+        raise SystemExit(f"--tok {tok} builds its vocab FROM the corpus;"
+                         " it requires --data (the synthetic task is "
+                         "char-mode only)")
+    data, id_to_str, joiner = (load_corpus(args.data, tok) if args.data
+                               else (None, list(_CHARSET), ""))
+    # embedding/softmax width: the tokenizer's vocab, padded up to a
+    # lane-aligned multiple of 128 in word mode (char mode keeps the
+    # historical 64 — artifacts stay comparable across rounds)
+    vocab = 64 if tok == "char" else -(-len(id_to_str) // 128) * 128
     mk = (tfm.TransformerConfig.llama_style if args.modern
           else tfm.TransformerConfig)
-    cfg = mk(vocab=64, d_model=64, n_heads=4,
-             n_layers=2, d_ff=128, max_seq=args.seq,
+    cfg = mk(vocab=vocab, d_model=getattr(args, "d_model", 64),
+             n_heads=getattr(args, "n_heads", 4),
+             n_layers=getattr(args, "n_layers", 2),
+             d_ff=getattr(args, "d_ff", 128), max_seq=args.seq,
              remat=True, n_kv_heads=args.kv_heads, window=args.window)
     if args.window and args.attn != "ring":
         raise SystemExit("--window runs sequence-parallel as the "
@@ -233,7 +273,6 @@ def _run_inner(args, jax) -> dict:
         opt_state = opt.init(params)
 
     store = get_storage_from(args.ckpt) if args.ckpt else None
-    data = load_corpus(args.data) if args.data else None
     target = getattr(args, "target_loss", None)
     # validation: hold out the corpus TAIL (contiguous, so no train
     # window ever overlaps it) and pin a fixed set of eval windows —
@@ -394,15 +433,15 @@ def _run_inner(args, jax) -> dict:
     else:
         # sample a continuation of a corpus prompt, decoded to text;
         # lengths scale with the model's positional budget, and ids the
-        # charset doesn't cover (vocab is padded to 64) print as '?'
+        # tokenizer doesn't cover (vocab is lane-padded) print as '?'
         p_len = min(32, max(4, cfg.max_seq // 4))
         n_new = min(48, cfg.max_seq - p_len)
         toks, _ = corpus_batch(rng, data, 1, p_len)
         out = np.asarray(tfm.greedy_decode(
             params, jnp.asarray(toks), n_new, cfg=cfg,
             use_prefill=True))[0]
-        sample = "".join(_CHARSET[t] if t < len(_CHARSET) else "?"
-                         for t in out)
+        sample = joiner.join(id_to_str[t] if t < len(id_to_str) else "?"
+                             for t in out)
         print(f"sample: {sample!r}")
 
     return {
@@ -421,7 +460,7 @@ def _run_inner(args, jax) -> dict:
         "config": {
             "dp": args.dp, "sp": args.sp, "seq": args.seq,
             "batch": args.batch, "grad_accum": args.grad_accum,
-            "attn": args.attn, "modern": args.modern,
+            "attn": args.attn, "modern": args.modern, "tok": tok,
             "zero1": args.zero1, "bf16": args.bf16,
             "vocab": cfg.vocab, "d_model": cfg.d_model,
             "n_layers": cfg.n_layers, "d_ff": cfg.d_ff,

@@ -105,6 +105,78 @@ def _route(x, router_w, n_experts: int, capacity: int, top_k: int = 1):
     return dispatch, combine, aux
 
 
+def _route_sorted(x, router_w, n_experts: int, capacity: int,
+                  top_k: int = 1):
+    """Sort-based routing with IDENTICAL semantics to :func:`_route`
+    (same top-k selection, same first-C-in-token-order capacity fill,
+    rounds filling in round-major order, pre-drop renormalization, same
+    aux loss) but without ever materializing the (T, E, C) dispatch/
+    combine tensors or their O(T·E·C·d) contraction FLOPs.
+
+    The one-hot einsum formulation costs 2·T·E·C·d FLOPs per dispatch
+    AND combine and streams two T·E·C f32 tensors through HBM per
+    layer — at the bench shape (T=16384, E=8, C=4096, d=1024) that is
+    2×1.1e12 matmul FLOPs and 2×2.0 GiB of one-hot traffic to move
+    64 MB of activations. Routing is a PERMUTATION, not a contraction:
+    one stable argsort of the (T·k,) expert assignments orders tokens
+    by (expert, round, token), ranks within each expert group come
+    from an exclusive-cumsum of the per-expert counts, and dispatch/
+    combine become row gathers (exact — no arithmetic on the
+    activations at all, vs the einsum's summation of one-hot
+    products). Returns
+
+    - ``token_of_slot`` (E, C) int32 — which token fills each expert
+      slot (arbitrary where invalid),
+    - ``slot_valid``   (E, C) bool  — slot actually filled,
+    - ``slot_of_tok``  (k, T) int32 — each routing round's slot per
+      token, E·C (one past the end) when dropped,
+    - ``gate_of_tok``  (k, T) f32   — combine weight per round
+      (renormalized, zero when dropped),
+    - ``aux`` scalar — the same load-balancing loss as :func:`_route`.
+    """
+    gates = jax.nn.softmax(x.astype(jnp.float32) @ router_w.astype(
+        jnp.float32), axis=-1)                          # (T, E)
+    t = x.shape[0]
+    _, topk_idx = jax.lax.top_k(gates, top_k)           # (T, k)
+    # flat order i = j·T + t ⇒ ascending i is (round, token)-lex — the
+    # exact order _route fills capacity in (round j after rounds < j,
+    # token order within a round)
+    expert_flat = topk_idx.T.reshape(-1)                # (k·T,)
+    order = jnp.argsort(expert_flat, stable=True)       # (k·T,)
+    counts = jnp.bincount(expert_flat, length=n_experts)  # (E,)
+    starts = jnp.cumsum(counts) - counts                # exclusive
+    # rank of each sorted element within its expert's group
+    rank_sorted = jnp.arange(t * top_k) - starts[expert_flat[order]]
+    kept_sorted = rank_sorted < capacity
+    slot_sorted = jnp.where(
+        kept_sorted, expert_flat[order] * capacity + rank_sorted,
+        n_experts * capacity)                           # E·C = dropped
+    # scatter the slot ids back to (round, token) order — int32 only,
+    # k·T elements; the activation rows themselves are never scattered
+    slot_of_tok = jnp.zeros((t * top_k,), jnp.int32).at[order].set(
+        slot_sorted.astype(jnp.int32)).reshape(top_k, t)
+    # slot → token: group e occupies sorted positions
+    # [starts[e], starts[e] + counts[e]); its first C fill the slots
+    pos = starts[:, None] + jnp.arange(capacity)[None, :]    # (E, C)
+    slot_valid = jnp.arange(capacity)[None, :] < counts[:, None]
+    tok_sorted = order % t                              # token of sorted elt
+    token_of_slot = tok_sorted[jnp.clip(pos, 0, t * top_k - 1)
+                               ].astype(jnp.int32)      # (E, C)
+
+    sel_gates = jnp.take_along_axis(gates, topk_idx, axis=1)  # (T, k)
+    kept_tok = (slot_of_tok < n_experts * capacity)     # (k, T)
+    gate_of_tok = jnp.where(kept_tok, sel_gates.T, 0.0)
+    if top_k > 1:
+        # pre-drop renormalization over the selected k (matches _route:
+        # a dropped expert's share is lost through the residual)
+        gate_of_tok = gate_of_tok / jnp.maximum(
+            jnp.sum(sel_gates, axis=1), 1e-9)[None, :]
+    prob = jnp.mean(gates, axis=0)
+    frac = counts.astype(jnp.float32) / t
+    aux = n_experts * jnp.sum((frac / top_k) * prob)
+    return token_of_slot, slot_valid, slot_of_tok, gate_of_tok, aux
+
+
 def _expert_ffn(w1, b1, w2, b2, x):
     """Batched expert FFN: x (E, C, d) → (E, C, d), one einsum pair on
     the MXU per layer."""
@@ -113,17 +185,36 @@ def _expert_ffn(w1, b1, w2, b2, x):
 
 
 def _moe_ffn(params: Params, x, capacity: int, prefix: str,
-             ep_axis, top_k: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray]:
+             ep_axis, top_k: int = 1, impl: str = "sorted"
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One body for both forms — ``ep_axis=None`` keeps everything local
     (the oracle); a mesh axis inserts the two all_to_all shuffles. The
     two forms are contractually golden-diffed, so they MUST share this
-    routing/compute path."""
+    routing/compute path.
+
+    ``impl`` picks the dispatch/combine machinery around the (identical)
+    expert FFN and all_to_all shuffles: ``"sorted"`` (default) routes by
+    argsort + row gathers; ``"einsum"`` is the one-hot contraction
+    oracle. DESIGN §14: at the bench shape the einsum form's dispatch/
+    combine contractions alone cost 2×1.1e12 FLOPs per layer — 8× the
+    expert FFN's useful work — which is measurably the entire
+    472 ms - 164 ms step gap vs dense; the sorted form removes those
+    FLOPs and the 2×2 GiB one-hot HBM streams entirely."""
     w = {k[len(prefix) + 1:]: v for k, v in params.items()
          if k.startswith(prefix + "_")}
     n_experts = w["router_W"].shape[1]          # GLOBAL expert count
-    dispatch, combine, aux = _route(x, w["router_W"], n_experts, capacity,
-                                    top_k=top_k)
-    xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    xf = x.astype(jnp.float32)
+    if impl == "sorted":
+        tok_of_slot, slot_valid, slot_of_tok, gate_of_tok, aux = (
+            _route_sorted(x, w["router_W"], n_experts, capacity,
+                          top_k=top_k))
+        xe = jnp.where(slot_valid[..., None], xf[tok_of_slot], 0.0)
+    elif impl == "einsum":
+        dispatch, combine, aux = _route(x, w["router_W"], n_experts,
+                                        capacity, top_k=top_k)
+        xe = jnp.einsum("tec,td->ecd", dispatch, xf)
+    else:
+        raise ValueError(f"unknown moe impl {impl!r}")
     if ep_axis is not None:
         # (E, C, d) → (E/ep, ep·C, d): device p receives every peer's
         # bucket for its local experts — the shuffle
@@ -137,7 +228,16 @@ def _moe_ffn(params: Params, x, capacity: int, prefix: str,
         # inverse shuffle: outputs return to their source devices
         ye = lax.all_to_all(ye, ep_axis, split_axis=1, concat_axis=0,
                             tiled=True)
-    out = jnp.einsum("tec,ecd->td", combine, ye)
+    if impl == "sorted":
+        # combine = per-round row gather from the flat (E·C)+1 slot
+        # table (zero sentinel row = dropped), gate-weighted
+        ye_flat = jnp.concatenate(
+            [ye.reshape(n_experts * capacity, -1),
+             jnp.zeros((1, ye.shape[-1]), ye.dtype)], axis=0)
+        out = jnp.sum(gate_of_tok[..., None] * ye_flat[slot_of_tok],
+                      axis=0)                           # (T, d)
+    else:
+        out = jnp.einsum("tec,ecd->td", combine, ye)
     if ep_axis is not None:
         # aux is per-tile; average across the ep group so every device
         # carries the same scalar (replicated, ready for the loss)
@@ -146,14 +246,17 @@ def _moe_ffn(params: Params, x, capacity: int, prefix: str,
 
 
 def moe_ffn_reference(params: Params, x, *, capacity: int,
-                      prefix: str = "moe", top_k: int = 1
+                      prefix: str = "moe", top_k: int = 1,
+                      impl: str = "sorted"
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Single-device oracle: (T, d) tokens → ((T, d) out, aux loss)."""
-    return _moe_ffn(params, x, capacity, prefix, None, top_k=top_k)
+    return _moe_ffn(params, x, capacity, prefix, None, top_k=top_k,
+                    impl=impl)
 
 
 def moe_ffn_shard(params: Params, x, *, capacity: int, ep_axis: str,
-                  prefix: str = "moe", top_k: int = 1
+                  prefix: str = "moe", top_k: int = 1,
+                  impl: str = "sorted"
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Expert-parallel form (inside shard_map): router weights are
     replicated, expert weights are LOCAL slices (E/ep experts per
@@ -164,4 +267,5 @@ def moe_ffn_shard(params: Params, x, *, capacity: int, ep_axis: str,
     reference run over the concatenated tiles with per-tile routing
     produces identical outputs (the golden-diff in tests).
     """
-    return _moe_ffn(params, x, capacity, prefix, ep_axis, top_k=top_k)
+    return _moe_ffn(params, x, capacity, prefix, ep_axis, top_k=top_k,
+                    impl=impl)

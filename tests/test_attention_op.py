@@ -138,6 +138,7 @@ class TestFusedBackward:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.heavy
     def test_bf16_grads(self):
         """bf16 operands: backward dots run in bf16 (MXU-native) with
         f32 accumulation — grads close to the f32 XLA VJP."""

@@ -70,6 +70,7 @@ class TestDigitsImageLoader:
         with pytest.raises(ValueError, match="160px wide"):
             load_digits_image(bad)
 
+    @pytest.mark.heavy
     def test_mr_train_consumes_image(self, tmp_path):
         """The digits MapReduce example trains on the REAL image when
         given one (image arg -> loader path), through the engine."""

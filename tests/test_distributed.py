@@ -196,7 +196,8 @@ def test_loop_strict_kwarg_overrides_constructor():
     assert server.strict is True
 
 
-@pytest.mark.parametrize("engine", ["python", "auto"])
+@pytest.mark.parametrize("engine", [
+    pytest.param("python", marks=pytest.mark.heavy), "auto"])
 def test_multiprocess_pool(tmp_path, engine):
     """True multi-process elastic pool over a FileJobStore + shared-dir
     storage — the .travis.yml single-box multi-node analog."""

@@ -116,6 +116,7 @@ def test_oracle_gqa_equals_tiled_mha(gqa_cfg):
 
 
 @pytest.mark.parametrize("attn", ["ring", "zigzag", "ulysses"])
+@pytest.mark.heavy
 def test_sharded_forward_gqa_matches_oracle(mesh, gqa_cfg, attn):
     params = tfm.init_transformer(jax.random.PRNGKey(4), gqa_cfg)
     toks = jnp.asarray(np.random.RandomState(5).randint(0, 64, (4, 64)),

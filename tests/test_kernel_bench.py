@@ -12,7 +12,7 @@ def test_bench_conv_train_lenet_smoke():
     scale (same code path the TPU run takes)."""
     from benchmarks.kernel_bench import bench_conv_train
 
-    out = bench_conv_train("lenet5_cifar", batch=8, steps=2)
+    out = bench_conv_train("lenet5_cifar", batch=4, steps=1)
     assert out["ms_per_step"] > 0
     assert out["images_per_sec"] > 0
     assert np.isfinite(out["mfu"]) and out["mfu"] >= 0
@@ -47,6 +47,7 @@ def test_bench_transformer_step_moe_smoke():
     assert "switch-moe2x" in out["config"]
 
 
+@pytest.mark.heavy
 def test_bench_transformer_step_long_seq_smoke():
     """The seq-doubling entry's path (modern recipe at seq > d_ff)."""
     from benchmarks.kernel_bench import bench_transformer_step
@@ -58,6 +59,7 @@ def test_bench_transformer_step_long_seq_smoke():
     assert "seq128" in out["config"]
 
 
+@pytest.mark.heavy
 def test_bench_decode_quantized_smoke():
     """The int8 serving copy drives the same bench (q8 path resolves
     to the XLA dequant composition off-TPU)."""
@@ -123,3 +125,58 @@ def test_attn_memory_utest():
     import benchmarks.attn_memory as am
 
     am.utest()
+
+
+@pytest.mark.heavy
+def test_moe_profile_smoke():
+    """benchmarks/moe_profile.py's component breakdown at toy scale on
+    CPU: every timed component and both cost analyses must produce a
+    number, not an error row (a crash here would burn sprint phase B's
+    slice of a hardware window)."""
+    from benchmarks.moe_profile import profile
+
+    res = profile(T=64, E=4, D=16, FF=32, cap=32, target_s=0.03)
+    for name in ("dense_ffn_fwd", "dense_ffn_fwdbwd", "moe_einsum_fwd",
+                 "moe_einsum_fwdbwd", "moe_sorted_fwd",
+                 "moe_sorted_fwdbwd", "sorted_route_and_gather_fwd",
+                 "expert_ffn_only_fwd"):
+        assert "ms" in res[name], (name, res[name])
+        assert res[name]["ms"] >= 0
+    for impl in ("einsum", "sorted"):
+        assert "flops" in res[f"cost_analysis_{impl}_fwdbwd"], (
+            res[f"cost_analysis_{impl}_fwdbwd"])
+
+
+@pytest.mark.heavy
+def test_lenet_roofline_smoke():
+    """benchmarks/lenet_roofline.py at toy batch on CPU: every stage
+    row must carry a time, not an error (sprint phase G)."""
+    from benchmarks.lenet_roofline import profile
+
+    res = profile(batch=8, target_s=0.03)
+    for name in ("fwd_loss", "fwdbwd", "conv1_5x5_3to6", "tanh_28x28x6",
+                 "pool1_pallas", "pool1_xla", "conv2_5x5_6to16",
+                 "pool2_pallas", "fc_stack_400_120_84_10",
+                 "control_conv_5x5_128to128_b128"):
+        assert "ms" in res[name], (name, res[name])
+
+
+@pytest.mark.heavy
+def test_lm_convergence_quick_smoke():
+    """benchmarks/lm_convergence.py --quick end to end on CPU (sprint
+    phase H, the longest phase): corpus build, the word tokenizer, the
+    train_lm flags, and the artifact assembly must all survive — a
+    crash here would burn the biggest slice of a hardware window."""
+    import json
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "benchmarks/lm_convergence.py", "--quick"],
+        capture_output=True, text=True, timeout=540,
+        cwd=__file__.rsplit("/tests/", 1)[0])
+    assert r.returncode == 0, r.stderr[-800:]
+    out = json.loads(r.stdout.strip().rsplit("\n", 1)[-1])
+    assert out["losses"], out
+    assert out["sample"] is not None
+    assert out["config"]["tok"] == "word:8192"

@@ -33,6 +33,7 @@ class TestLeNet:
         np.testing.assert_allclose(
             np.exp(np.asarray(logp)).sum(axis=1), 1.0, atol=1e-5)
 
+    @pytest.mark.heavy
     def test_gradients_flow_to_every_param(self, images):
         params = lenet.init_lenet(jax.random.PRNGKey(1))
         x = jnp.asarray(images[0][:16])
@@ -43,6 +44,7 @@ class TestLeNet:
             assert np.isfinite(np.asarray(g)).all(), name
             assert float(jnp.abs(g).max()) > 0.0, f"dead gradient: {name}"
 
+    @pytest.mark.heavy
     def test_dp_training_learns(self, mesh, images):
         """A few DP epochs on the synthetic image classes must beat
         chance by a wide margin (the golden 'it trains' check)."""

@@ -145,6 +145,7 @@ def test_train_step_learns_and_remat_parity(mesh, cfg):
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
 
 
+@pytest.mark.heavy
 def test_3d_tp_modern_matches_oracle(cfg):
     """rope + rms + swiglu on the 3-D tp mesh (MHA heads — GQA stays
     rejected there): one step's loss equals the 2-D step's."""
@@ -172,6 +173,7 @@ def test_3d_tp_modern_matches_oracle(cfg):
     assert abs(float(loss2) - float(loss3)) < 2e-5
 
 
+@pytest.mark.heavy
 def test_pp_modern_runs(cfg):
     """Pipeline stacking handles the swiglu/rms key set (no fixed
     name list): one pp step on the llama-style MHA config."""

@@ -203,6 +203,7 @@ class TestMoEDecode:
             toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
         assert np.array_equal(np.asarray(got), np.asarray(toks))
 
+    @pytest.mark.heavy
     def test_prefill_decode_matches_scan_when_no_overflow(self):
         """MoE + use_prefill: token-exact vs the scan decode when no
         routing bucket overflows (capacity >= every group's worst
@@ -235,6 +236,7 @@ class TestMoEDecode:
 
 
 class TestTopK:
+    @pytest.mark.heavy
     def test_top2_matches_dense_composition_with_big_capacity(self,
                                                               params):
         """With capacity >= T nothing drops, so top-2 routing must equal
@@ -316,6 +318,7 @@ class TestTopK:
         with pytest.raises(ValueError, match="moe_top_k"):
             init_transformer(jax.random.PRNGKey(0), cfg)
 
+    @pytest.mark.heavy
     def test_top2_transformer_trains(self):
         """A top-2 MoE transformer learns the stride task through the
         full sharded train step — moe_top_k threads end to end."""
@@ -346,3 +349,84 @@ class TestTopK:
             if first is None:
                 first = float(loss)
         assert float(loss) < first / 3, (first, float(loss))
+
+
+class TestSortedRouting:
+    """The sort+gather routing (the default impl) must be EXACTLY the
+    one-hot einsum oracle's semantics — same top-k choices, same
+    first-C-in-token-order capacity fill (round-major for k>1), same
+    pre-drop renormalization, same aux — on outputs AND gradients
+    (DESIGN §14: the einsum form's dispatch/combine contractions are
+    8× the expert FFN's FLOPs; the sorted form removes them, so it
+    must be a pure reformulation, not an approximation)."""
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    @pytest.mark.parametrize("cap", [CAP, 64])
+    def test_outputs_match_einsum_oracle(self, params, top_k, cap):
+        x = _tokens(7, t=48)
+        want, aux_w = moe.moe_ffn_reference(params, x, capacity=cap,
+                                            top_k=top_k, impl="einsum")
+        got, aux_g = moe.moe_ffn_reference(params, x, capacity=cap,
+                                           top_k=top_k, impl="sorted")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(aux_g), float(aux_w), rtol=1e-5)
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_outputs_match_under_heavy_overflow(self, params, top_k):
+        """Collapse the router onto one expert so most tokens drop —
+        the fill order (round-major, then token order) must agree."""
+        p = dict(params)
+        p["moe_router_W"] = jnp.zeros((D, E)).at[:, 3].set(100.0)
+        x = jnp.abs(_tokens(8, t=24))
+        want, _ = moe.moe_ffn_reference(p, x, capacity=CAP,
+                                        top_k=top_k, impl="einsum")
+        got, _ = moe.moe_ffn_reference(p, x, capacity=CAP,
+                                       top_k=top_k, impl="sorted")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_grads_match_einsum_oracle(self, params, top_k):
+        x = _tokens(9, t=32)
+
+        def loss(params, x, impl):
+            out, aux = moe.moe_ffn_reference(params, x, capacity=CAP,
+                                             top_k=top_k, impl=impl)
+            return jnp.sum(out ** 2) + 0.01 * aux
+
+        gw_p, gw_x = jax.grad(loss, argnums=(0, 1))(params, x, "einsum")
+        gs_p, gs_x = jax.grad(loss, argnums=(0, 1))(params, x, "sorted")
+        np.testing.assert_allclose(np.asarray(gs_x), np.asarray(gw_x),
+                                   rtol=2e-4, atol=1e-5)
+        for k in gw_p:
+            np.testing.assert_allclose(
+                np.asarray(gs_p[k]), np.asarray(gw_p[k]),
+                rtol=2e-4, atol=1e-5, err_msg=k)
+
+    def test_shard_sorted_matches_einsum_shard(self, mesh, params):
+        """Both impls inside shard_map over the ep axis: identical
+        outputs — the all_to_all operates on identical (E, C, d)
+        buckets regardless of how they were built."""
+        n_ep, t_local = 8, 16
+        x = _tokens(10, t=n_ep * t_local)
+        specs = {k: (P("ep") if k.startswith("moe_w") or
+                     k.startswith("moe_b") else P())
+                 for k in params}
+        sharded = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                   for k, v in params.items()}
+        xs = jax.device_put(x, NamedSharding(mesh, P("ep")))
+
+        def run(impl):
+            def body(params, x):
+                return moe.moe_ffn_shard(params, x, capacity=CAP,
+                                         ep_axis="ep", impl=impl)
+            fn = jax.jit(jax.shard_map(
+                body, mesh=mesh, in_specs=(specs, P("ep")),
+                out_specs=(P("ep"), P())), static_argnums=())
+            return fn(sharded, xs)
+
+        want, _ = run("einsum")
+        got, _ = run("sorted")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)

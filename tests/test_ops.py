@@ -97,6 +97,7 @@ def test_conv2d_matches_xla(cfg):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.heavy
 def test_conv2d_same_preserves_shape_even_kernel():
     """TF-style SAME: output spatial dims == input dims at stride 1, even
     for even kernel sizes (needs asymmetric padding)."""

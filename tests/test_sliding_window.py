@@ -211,6 +211,7 @@ class TestBandedRing:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=3e-5, atol=3e-5)
 
+    @pytest.mark.heavy
     def test_gradients_match_windowed_oracle(self, mesh):
         from lua_mapreduce_tpu.parallel import ring_attention as ra
         rng = np.random.RandomState(1)
@@ -309,6 +310,7 @@ class TestBandedRing:
 
 
 class TestEmptyRows:
+    @pytest.mark.heavy
     def test_rows_past_window_emit_zero_both_backends(self):
         """Banded-ring far-block geometry: q rows pushed more than
         `window` past every kv column have an EMPTY visible set. The

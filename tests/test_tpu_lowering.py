@@ -267,3 +267,27 @@ def test_every_tuner_candidate_lowers():
     for bm, bn, bkk in matmul_cands():
         export_tpu(lambda x, y, bm=bm, bn=bn, bkk=bkk: _matmul_pallas(
             x, y, block_m=bm, block_n=bn, block_k=bkk), a, a)
+
+
+class TestSortedMoeLowering:
+    """The sorted MoE routing (argsort + bincount + row gathers, the
+    default impl since DESIGN §14) is pure XLA, but sort/scatter
+    lowering on TPU is exactly the kind of thing a green CPU suite
+    can't attest — export the fwd AND grad paths for the TPU pipeline
+    the same way the Pallas kernels are."""
+
+    def test_moe_sorted_fwd_and_grad(self):
+        from lua_mapreduce_tpu.parallel import moe
+
+        d, ff, e, cap, t = 64, 128, 8, 32, 128
+        params = moe.init_moe(jax.random.PRNGKey(0), d, ff, e,
+                              jnp.bfloat16)
+        x = jax.ShapeDtypeStruct((t, d), jnp.bfloat16)
+
+        def loss(params, x):
+            out, aux = moe.moe_ffn_reference(params, x, capacity=cap,
+                                             top_k=2, impl="sorted")
+            return jnp.sum(out.astype(jnp.float32) ** 2) + aux
+
+        export_tpu(loss, params, x)
+        export_tpu(jax.grad(loss), params, x)

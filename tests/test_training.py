@@ -134,6 +134,7 @@ SMALL = {"sizes": (64, 32, 10), "n_shards": 4, "bunch": 64,
          "max_steps": 30, "patience": 30}
 
 
+@pytest.mark.heavy
 def test_mapreduce_digits_example_learns():
     """The six-function DP-SGD loop (APRIL-ANN analog) on the host engine:
     loops until convergence/max and the validation loss drops."""

@@ -330,6 +330,7 @@ class TestMoE:
             vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
             max_seq=128, moe_experts=8, moe_capacity=256)
 
+    @pytest.mark.heavy
     def test_sharded_forward_matches_oracle(self, moe_cfg):
         """Generous capacity (no drops) → routing is per-token, so the
         ep-sharded forward equals the single-device oracle exactly."""
@@ -473,6 +474,7 @@ class TestPipeline:
                                    n_micro=2)
 
 
+@pytest.mark.heavy
 def test_remat_matches_non_remat_grads():
     """cfg.remat recomputes blocks in backward — loss and grads must be
     IDENTICAL to the saved-activation path (same math, less memory)."""
@@ -547,6 +549,7 @@ def test_flops_per_token_accounting():
 class TestGreedyDecode:
     """KV-cached decode vs the no-cache oracle: identical tokens."""
 
+    @pytest.mark.heavy
     def test_matches_full_forward_rerun(self, cfg):
         rng = np.random.RandomState(13)
         params = tfm.init_transformer(jax.random.PRNGKey(13), cfg)
@@ -591,6 +594,7 @@ class TestGreedyDecode:
         acc = float(np.mean(out[8:] == want[8:]))
         assert acc >= 0.5, (out.tolist(), want.tolist())
 
+    @pytest.mark.heavy
     def test_sampling(self, cfg):
         params = tfm.init_transformer(jax.random.PRNGKey(20), cfg)
         prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
@@ -664,6 +668,7 @@ class TestGreedyDecode:
         ref = tfm.greedy_decode(params, prompt, 4, cfg=cfg)
         assert np.array_equal(np.asarray(out), np.asarray(ref))
 
+    @pytest.mark.heavy
     def test_prefill_moe_sharded_rejected(self, mesh):
         moe_cfg = tfm.TransformerConfig(vocab=16, d_model=16, n_heads=2,
                                         n_layers=1, d_ff=32, max_seq=32,
