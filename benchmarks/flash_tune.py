@@ -84,8 +84,9 @@ def main():
     import jax
 
     if jax.default_backend() != "tpu":
+        # nonzero so a sprint phase racing a tunnel flake isn't stamped
         print(json.dumps({"skipped": "not on TPU"}))
-        return
+        sys.exit(1)
 
     cands = CANDIDATES
     results = {}

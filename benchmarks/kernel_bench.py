@@ -672,6 +672,12 @@ def main() -> None:
                          "cases and MERGE into the existing kernels.json "
                          "(for re-running entries after a kernel fix "
                          "without repeating the whole bench)")
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="fail (no artifact, nonzero exit) unless the "
+                         "backend is TPU — sprint mode, so a tunnel "
+                         "flake between the window probe and this run "
+                         "can't stamp a phase with CPU numbers even "
+                         "when no prior TPU artifact exists")
     args = ap.parse_args()
     only = [s for s in args.only.split(",") if s]
 
@@ -686,13 +692,16 @@ def main() -> None:
     if os.path.exists(RESULTS):
         with open(RESULTS) as f:
             prior = json.load(f)
-    if not on_tpu and prior.get("on_tpu"):
+    if not on_tpu and (args.require_tpu or prior.get("on_tpu")):
         # a CPU run (fallback or --only on the wrong host) must never
-        # overwrite or mislabel real-chip numbers
-        print(json.dumps({"skipped": "no TPU and kernels.json holds "
-                                     "TPU-measured entries; artifact "
-                                     "left untouched"}))
-        return
+        # overwrite or mislabel real-chip numbers; exit nonzero so a
+        # sprint phase that raced a tunnel flake is NOT stamped done
+        print(json.dumps({"skipped": "no TPU"
+                          + (" and kernels.json holds TPU-measured "
+                             "entries" if prior.get("on_tpu") else
+                             " (--require-tpu)")
+                          + "; artifact left untouched"}))
+        sys.exit(1)
     results = {}
     if only:
         results = prior

@@ -116,11 +116,24 @@ def run_als() -> dict:
 
 
 def main() -> None:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="fail (no artifact) unless the backend is TPU "
+                         "— sprint mode, so a tunnel flake between the "
+                         "window probe and this run can't stamp the "
+                         "phase with a CPU artifact")
+    args = ap.parse_args()
+
     from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
     force_cpu_if_unavailable()
     import jax
 
     platform = jax.default_backend()
+    if args.require_tpu and platform != "tpu":
+        print(json.dumps({"skipped": "require-tpu: backend is "
+                                     + platform}))
+        sys.exit(1)
     if os.path.exists(OUT):
         try:
             prior = json.load(open(OUT))

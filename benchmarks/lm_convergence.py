@@ -72,12 +72,21 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true",
                     help="tiny-budget smoke (CI): prove the pipeline, "
                          "don't write the committed artifact")
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="fail (no artifact) unless the backend is TPU "
+                         "— sprint mode, so a tunnel flake between the "
+                         "window probe and this run can't stamp the "
+                         "phase with a CPU artifact")
     args = ap.parse_args()
 
     from lua_mapreduce_tpu.utils.jax_env import force_cpu_if_unavailable
     force_cpu_if_unavailable()
     import jax
     platform = jax.default_backend()
+    if args.require_tpu and platform != "tpu":
+        print(json.dumps({"skipped": f"require-tpu: backend is "
+                                     f"{platform}"}))
+        return 1
 
     corpus = build_corpus()
     size = os.path.getsize(corpus)

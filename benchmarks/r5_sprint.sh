@@ -52,14 +52,14 @@ phase () {  # phase <name> <timeout_s> <cmd...>
 #    on silicon (VERDICT r4 missing-1 / next-1: the single highest-
 #    leverage measurement of the round; ~30-40x headroom predicted by
 #    DESIGN 13's bandwidth-floor math).
-phase A_serving 2400 python benchmarks/kernel_bench.py \
+phase A_serving 2400 python benchmarks/kernel_bench.py --require-tpu \
     --only decode_prompt3968,transformer_step_s4096,flash_s8192
 
 # -- B: MoE re-measure + profile breakdown (VERDICT r4 missing-5 /
 #    next-4: 472 ms vs 164 ms dense needs a quantified verdict; the
 #    sorted-routing fix needs its step number).
 phase B_moe 2400 bash -c "python benchmarks/moe_profile.py && \
-    python benchmarks/kernel_bench.py --only transformer_step_moe8"
+    python benchmarks/kernel_bench.py --require-tpu --only transformer_step_moe8"
 
 # -- C: bench.py re-baseline (VERDICT r4 weak-2: committed 35.1%
 #    lm_train_mfu predates the (512,512) flash blocks that kernels.json's
@@ -72,12 +72,12 @@ phase D_flashtune 3600 python benchmarks/flash_tune.py --install
 
 # -- E: k-means/ALS on the chip (VERDICT r4 missing-3 / next-5:
 #    BASELINE config 5 has only a CPU artifact).
-phase E_kmeans 1800 python benchmarks/kmeans_als_artifact.py
+phase E_kmeans 1800 python benchmarks/kmeans_als_artifact.py --require-tpu
 
 # -- F: ResNet-18 ImageNet-shape canaries (VERDICT r4 missing-2 /
 #    next-3: the tunnel's compile helper 500s at 224x224; find the size
 #    cliff and commit the nearest compiling ImageNet-shape number).
-phase F_resnet 3600 python benchmarks/kernel_bench.py \
+phase F_resnet 3600 python benchmarks/kernel_bench.py --require-tpu \
     --only resnet18_im112,resnet18_im160,resnet18_im176,resnet18_im192,resnet18_imagenet
 
 # -- G: LeNet per-stage roofline evidence (VERDICT r4 weak-4: 0.06% MFU
@@ -86,7 +86,7 @@ phase G_lenet 1800 python benchmarks/lenet_roofline.py
 
 # -- H: LM convergence one notch up (VERDICT r4 weak-5 / next-7:
 #    d256+real-vocab to a fixed val target, where flash+ZeRO-1 engage).
-phase H_lmconv 5400 python benchmarks/lm_convergence.py
+phase H_lmconv 5400 python benchmarks/lm_convergence.py --require-tpu
 
 PHASES=$(grep -oE '^phase [A-Za-z0-9_]+' "$0" | awk '{print $2}')
 missing=""
