@@ -108,10 +108,11 @@ def profile(T=T, E=E, D=D, FF=FF, cap=None, target_s=0.35) -> dict:
 
     # routing machinery alone (no expert FFN): sorted route + gathers
     def route_only(p, x):
-        tok_of_slot, slot_valid, slot_of_tok, gate_of_tok, aux = (
-            moe._route_sorted(x, p["moe_router_W"], E, CAP))
-        xe = jnp.where(slot_valid[..., None],
-                       x.astype(jnp.float32)[tok_of_slot], 0.0)
+        (tok_of_slot, round_of_slot, slot_valid, slot_of_tok,
+         gate_of_tok, aux) = moe._route_sorted(x, p["moe_router_W"],
+                                               E, CAP)
+        xe = moe._dispatch_gather(x.astype(jnp.float32), tok_of_slot,
+                                  slot_valid, slot_of_tok)
         return xe.sum() + aux
     timed("sorted_route_and_gather_fwd", route_only, (params, x))
 

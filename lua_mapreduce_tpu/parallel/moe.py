@@ -127,12 +127,21 @@ def _route_sorted(x, router_w, n_experts: int, capacity: int,
 
     - ``token_of_slot`` (E, C) int32 — which token fills each expert
       slot (arbitrary where invalid),
+    - ``round_of_slot`` (E, C) int32 — which top-k round owns each
+      slot (arbitrary where invalid),
     - ``slot_valid``   (E, C) bool  — slot actually filled,
     - ``slot_of_tok``  (k, T) int32 — each routing round's slot per
       token, E·C (one past the end) when dropped,
     - ``gate_of_tok``  (k, T) f32   — combine weight per round
       (renormalized, zero when dropped),
     - ``aux`` scalar — the same load-balancing loss as :func:`_route`.
+
+    Kept slots ↔ kept (round, token) pairs are a BIJECTION, so both
+    directions of the dispatch/combine data movement — including their
+    TRANSPOSES — are gathers; the custom VJPs below use that to keep
+    the backward pass scatter-free (XLA's transpose of a gather is a
+    serialized scatter-add on TPU, which would hand back a chunk of
+    the einsum formulation's cost in the training step).
     """
     gates = jax.nn.softmax(x.astype(jnp.float32) @ router_w.astype(
         jnp.float32), axis=-1)                          # (T, E)
@@ -157,11 +166,12 @@ def _route_sorted(x, router_w, n_experts: int, capacity: int,
         slot_sorted.astype(jnp.int32)).reshape(top_k, t)
     # slot → token: group e occupies sorted positions
     # [starts[e], starts[e] + counts[e]); its first C fill the slots
-    pos = starts[:, None] + jnp.arange(capacity)[None, :]    # (E, C)
+    pos = jnp.clip(starts[:, None] + jnp.arange(capacity)[None, :],
+                   0, t * top_k - 1)                    # (E, C)
     slot_valid = jnp.arange(capacity)[None, :] < counts[:, None]
     tok_sorted = order % t                              # token of sorted elt
-    token_of_slot = tok_sorted[jnp.clip(pos, 0, t * top_k - 1)
-                               ].astype(jnp.int32)      # (E, C)
+    token_of_slot = tok_sorted[pos].astype(jnp.int32)   # (E, C)
+    round_of_slot = (order // t)[pos].astype(jnp.int32)  # (E, C)
 
     sel_gates = jnp.take_along_axis(gates, topk_idx, axis=1)  # (T, k)
     kept_tok = (slot_of_tok < n_experts * capacity)     # (k, T)
@@ -174,7 +184,75 @@ def _route_sorted(x, router_w, n_experts: int, capacity: int,
     prob = jnp.mean(gates, axis=0)
     frac = counts.astype(jnp.float32) / t
     aux = n_experts * jnp.sum((frac / top_k) * prob)
-    return token_of_slot, slot_valid, slot_of_tok, gate_of_tok, aux
+    return (token_of_slot, round_of_slot, slot_valid, slot_of_tok,
+            gate_of_tok, aux)
+
+
+def _flat_with_sentinel(a):
+    """(E, C, d) → (E·C + 1, d) with a ZERO row at index E·C — the
+    sentinel every ``slot_of_tok`` dropped-token entry points at. The
+    zero row is load-bearing for gradient correctness in both VJPs:
+    dropped (round, token) pairs must read exactly 0."""
+    e, c, d = a.shape
+    return jnp.concatenate(
+        [a.reshape(e * c, d), jnp.zeros((1, d), a.dtype)], axis=0)
+
+
+@jax.custom_vjp
+def _dispatch_gather(xf, token_of_slot, slot_valid, slot_of_tok):
+    """(T, d) tokens → (E, C, d) expert buckets by row gather; the VJP
+    is the INVERSE gather (via ``slot_of_tok``), not a scatter-add."""
+    return jnp.where(slot_valid[..., None], xf[token_of_slot], 0.0)
+
+
+def _dispatch_gather_fwd(xf, token_of_slot, slot_valid, slot_of_tok):
+    return (_dispatch_gather(xf, token_of_slot, slot_valid, slot_of_tok),
+            slot_of_tok)
+
+
+def _dispatch_gather_bwd(slot_of_tok, dxe):
+    # sentinel row E·C reads zero: dropped (round, token) pairs get no
+    # cotangent, exactly like the scatter-add transpose would produce
+    dx = jnp.sum(_flat_with_sentinel(dxe)[slot_of_tok], axis=0)  # (T, d)
+    return dx, None, None, None
+
+
+_dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
+
+
+@jax.custom_vjp
+def _combine_gather(ye, gate_of_tok, token_of_slot, round_of_slot,
+                    slot_valid, slot_of_tok):
+    """(E, C, d) expert outputs → (T, d) tokens, gate-weighted; both
+    VJP operand paths (``ye`` and the differentiable ``gate_of_tok``,
+    through which the router trains) are gathers via the slot↔token
+    bijection."""
+    return jnp.sum(gate_of_tok[..., None]
+                   * _flat_with_sentinel(ye)[slot_of_tok], axis=0)
+
+
+def _combine_gather_fwd(ye, gate_of_tok, token_of_slot, round_of_slot,
+                        slot_valid, slot_of_tok):
+    out = _combine_gather(ye, gate_of_tok, token_of_slot, round_of_slot,
+                          slot_valid, slot_of_tok)
+    return out, (ye, gate_of_tok, token_of_slot, round_of_slot,
+                 slot_valid, slot_of_tok)
+
+
+def _combine_gather_bwd(res, dout):
+    ye, gate_of_tok, token_of_slot, round_of_slot, slot_valid, \
+        slot_of_tok = res
+    # d ye[s] = gate(s) · dout[token(s)] — pure gathers over (E, C)
+    gate_of_slot = gate_of_tok[round_of_slot, token_of_slot]  # (E, C)
+    dye = jnp.where(slot_valid[..., None],
+                    gate_of_slot[..., None] * dout[token_of_slot], 0.0)
+    # d gate[j, t] = dout[t] · ye_flat[slot_of_tok[j, t]]
+    dgate = jnp.sum(_flat_with_sentinel(ye)[slot_of_tok]
+                    * dout[None, :, :], axis=-1)
+    return dye, dgate, None, None, None, None
+
+
+_combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
 
 
 def _expert_ffn(w1, b1, w2, b2, x):
@@ -205,10 +283,10 @@ def _moe_ffn(params: Params, x, capacity: int, prefix: str,
     n_experts = w["router_W"].shape[1]          # GLOBAL expert count
     xf = x.astype(jnp.float32)
     if impl == "sorted":
-        tok_of_slot, slot_valid, slot_of_tok, gate_of_tok, aux = (
-            _route_sorted(x, w["router_W"], n_experts, capacity,
-                          top_k=top_k))
-        xe = jnp.where(slot_valid[..., None], xf[tok_of_slot], 0.0)
+        (tok_of_slot, round_of_slot, slot_valid, slot_of_tok,
+         gate_of_tok, aux) = _route_sorted(x, w["router_W"], n_experts,
+                                           capacity, top_k=top_k)
+        xe = _dispatch_gather(xf, tok_of_slot, slot_valid, slot_of_tok)
     elif impl == "einsum":
         dispatch, combine, aux = _route(x, w["router_W"], n_experts,
                                         capacity, top_k=top_k)
@@ -230,12 +308,11 @@ def _moe_ffn(params: Params, x, capacity: int, prefix: str,
                             tiled=True)
     if impl == "sorted":
         # combine = per-round row gather from the flat (E·C)+1 slot
-        # table (zero sentinel row = dropped), gate-weighted
-        ye_flat = jnp.concatenate(
-            [ye.reshape(n_experts * capacity, -1),
-             jnp.zeros((1, ye.shape[-1]), ye.dtype)], axis=0)
-        out = jnp.sum(gate_of_tok[..., None] * ye_flat[slot_of_tok],
-                      axis=0)                           # (T, d)
+        # table (zero sentinel row = dropped), gate-weighted; under
+        # ep the all_to_all above restored the LOCAL tile's (E, C, d)
+        # bucket geometry, so the slot bijection still holds
+        out = _combine_gather(ye, gate_of_tok, tok_of_slot,
+                              round_of_slot, slot_valid, slot_of_tok)
     else:
         out = jnp.einsum("tec,ecd->td", combine, ye)
     if ep_axis is not None:
