@@ -152,6 +152,39 @@ def bench_mapreduce_path(iterations: int = 3) -> float:
     return iterations * n_shards * bunch / dt
 
 
+def _shuffle_pipeline_fields() -> dict:
+    """Detail fields for the pipelined shuffle (host-side data plane):
+    a small live two-leg run of benchmarks/shuffle_bench (multi-process
+    pool, pipelining off vs on, byte-compared outputs). Falls back to
+    the committed artifact — labeled as such — if the live run cannot
+    complete; never sinks the flagship metric."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from benchmarks.shuffle_bench import run as shuffle_run
+        # the artifact shape at roughly half scale, one round
+        r = shuffle_run(n_splits=44, n_stragglers=1, straggler_x=32,
+                        premerge_min_runs=12, premerge_max_runs=32,
+                        corpus_dir="/tmp/bench_shuffle_corpus", rounds=1)
+        return {
+            "shuffle_pipeline_speedup": r["pipeline_speedup_wall"],
+            "shuffle_pipeline_identical_output": r["identical_output"],
+            "shuffle_pipeline_overlap_fraction":
+                r["pipelined"]["overlap_fraction"],
+        }
+    except Exception as e:
+        out = {"shuffle_pipeline_error": f"{type(e).__name__}: {e}"[:200]}
+        try:
+            with open(os.path.join(here, "benchmarks", "results",
+                                   "shuffle.json")) as f:
+                art = json.load(f)
+            out["shuffle_pipeline_speedup_committed"] = \
+                art["pipeline_speedup_wall"]
+        except Exception:
+            pass
+        return out
+
+
 def _committed_tpu_tail() -> dict:
     """VERDICT r4 item 8: when the live run falls back to CPU (wedged
     tunnel), the driver-captured JSON must still TRANSPORT the newest
@@ -241,6 +274,9 @@ def main() -> None:
         "mfu_digits_mlp": round(mfu_digits, 6),
         "peak_bf16_flops_per_s": peak,
         "device_kind": jax.devices()[0].device_kind,
+        # host-side data plane: barrier vs pipelined shuffle wall ratio
+        # (benchmarks/shuffle_bench.py; >1.0 = pipelining wins)
+        **_shuffle_pipeline_fields(),
     }
     if on_tpu and "lm_train_mfu" in lm:
         # VERDICT r4 weak-1: the first number a reader (or the driver

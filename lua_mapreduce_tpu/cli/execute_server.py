@@ -64,6 +64,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--strict", action="store_true",
                    help="abort with PhaseFailed when any job goes FAILED "
                         "instead of running finalfn on partial results")
+    p.add_argument("--pipeline", action="store_true",
+                   help="pipelined shuffle: publish eager pre_merge jobs "
+                        "while mappers run (byte-identical results, less "
+                        "reduce fan-in; see docs/DESIGN.md §15)")
+    p.add_argument("--premerge-min-runs", type=int, default=4,
+                   help="min committed runs one pre_merge consolidates")
+    p.add_argument("--premerge-max-runs", type=int, default=8,
+                   help="max runs per pre_merge job")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -103,7 +111,10 @@ def main(argv=None) -> int:
     server = Server(store, poll_interval=args.poll,
                     stale_timeout_s=args.stale_timeout or None,
                     verbose=not args.quiet,
-                    strict=args.strict).configure(spec)
+                    strict=args.strict,
+                    pipeline=args.pipeline,
+                    premerge_min_runs=args.premerge_min_runs,
+                    premerge_max_runs=args.premerge_max_runs).configure(spec)
 
     for _ in range(args.inline_workers):
         w = Worker(store).configure(max_iter=10_000)

@@ -124,6 +124,39 @@ def native_merge_reduce_sum(store, filenames: Sequence[str],
     return True
 
 
+def native_premerge(store, filenames: Sequence[str], out_name: str) -> bool:
+    """Whole pre-merge job in one native pass: merge sorted runs at local
+    paths and publish the consolidated spill run atomically under
+    ``out_name`` — no Python parse/re-dump round trip. Returns False when
+    the native path can't serve it (non-local store, no toolchain, parser
+    rejects a record); the caller falls back to the streaming Python
+    merge, which is the semantic truth."""
+    dst_path = getattr(store, "local_path", None)
+    dst_dir = getattr(store, "path", None)
+    paths = _local_run_paths(store, filenames)
+    if paths is None or dst_path is None or dst_dir is None:
+        return False
+    fd, tmp = tempfile.mkstemp(prefix=".tmp.spill.", suffix=".jsonl",
+                               dir=dst_dir)
+    os.close(fd)
+    try:
+        merge_paths(paths, tmp)
+    except (OSError, ValueError, RuntimeError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    # builder durability discipline: fsync before the atomic publish
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, dst_path(out_name))
+    return True
+
+
 def native_merge_records(store, filenames: Sequence[str]
                          ) -> Optional[Iterator[Tuple[object, List[object]]]]:
     """merge_iterator-compatible stream via the native pass, or ``None``

@@ -1,8 +1,10 @@
 """Execution engines.
 
 - contract:  the six-user-function task specification (L6/L5 analog)
-- job:       map/reduce job execution shared by all engines (L3 analog,
-             reference mapreduce/job.lua)
+- job:       map/pre-merge/reduce job execution shared by all engines
+             (L3 analog, reference mapreduce/job.lua)
+- premerge:  pipelined-shuffle scheduling — the committed-run watermark,
+             spill contiguity, and the disk-rebuildable reduce order
 - local:     single-process executor (golden-diff testable)
 - server:    single-controller orchestrator (reference mapreduce/server.lua)
 - worker:    elastic worker runtime (reference mapreduce/worker.lua)

@@ -18,7 +18,8 @@ from lua_mapreduce_tpu.core import tuples
 from lua_mapreduce_tpu.core.constants import MAX_MAP_RESULT
 from lua_mapreduce_tpu.core.merge import merge_iterator
 from lua_mapreduce_tpu.core.native_merge import (native_merge_records,
-                                                 native_merge_reduce_sum)
+                                                 native_merge_reduce_sum,
+                                                 native_premerge)
 from lua_mapreduce_tpu.core.serialize import (assert_serializable, dump_record,
                                               sorted_keys)
 from lua_mapreduce_tpu.engine.contract import TaskSpec
@@ -62,10 +63,30 @@ def make_map_emit(result: Dict[Any, List[Any]], combiner):
     return emit
 
 
-def map_output_name(result_ns: str, part: int, map_key: str) -> str:
+def map_key_str(job_id: Any) -> str:
+    """Canonical run-name form of a map job id: numeric ids are
+    zero-padded so lexicographic run-name order — the order both the
+    barrier merge and the pipelined pre-merge concatenate equal-key
+    values in — equals numeric job order. Without the pad, ``M10`` sorts
+    between ``M1`` and ``M2`` and committed runs almost never form the
+    contiguous stretches eager pre-merge needs (engine/premerge.py).
+
+    Only CANONICAL decimals (no leading zeros — i.e. everything the
+    engines generate: ints and enumerate indices) are padded, so no two
+    distinct inputs can collide on one run name. Beyond 10^8 jobs the
+    padded order degrades to plain lexicographic — still deterministic
+    and identical in both executors (byte-identity holds), just with
+    fewer contiguous pre-merge stretches."""
+    s = str(job_id)
+    if s.isdigit() and str(int(s)) == s:
+        return f"{int(s):08d}"
+    return s
+
+
+def map_output_name(result_ns: str, part: int, map_key: Any) -> str:
     """Intermediate run-file name ``<ns>.P<part>.M<mapkey>``
     (reference job.lua:208-214)."""
-    return f"{result_ns}.P{part}.M{map_key}"
+    return f"{result_ns}.P{part}.M{map_key_str(map_key)}"
 
 
 def run_map_job(spec: TaskSpec, store: Store, job_id: str,
@@ -124,11 +145,59 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
     return times
 
 
+def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
+                     spill_file: str) -> JobTimes:
+    """Eagerly consolidate committed sorted runs into one spill run —
+    the pipelined-shuffle work unit (engine/premerge.py).
+
+    Pure reorganization: equal-key value lists are concatenated in the
+    given (canonical) file order and NEVER folded — no combiner, no
+    reducefn — so the final reduce sees byte-identical inputs whether or
+    not its runs were pre-merged. Consumed inputs are deleted only after
+    the spill publishes atomically; idempotent under duplicate execution
+    (claim lost to a stale requeue): an existing spill short-circuits to
+    a sweep of any leftover inputs.
+    """
+    times = JobTimes(started=time.time())
+    cpu0 = time.process_time()
+    if store.exists(spill_file):
+        # duplicate/restarted execution: the spill is already published
+        # (atomic build, deterministic content) — sweep leftovers only
+        for name in run_files:
+            store.remove(name)
+        times.cpu = time.process_time() - cpu0
+        times.finished = times.written = time.time()
+        return times
+    missing = [f for f in run_files if not store.exists(f)]
+    if missing:
+        raise RuntimeError(
+            f"pre_merge {spill_file}: {len(missing)} input run(s) missing "
+            f"with no spill published: {missing[:3]}")
+    if not native_premerge(store, run_files, spill_file):
+        builder = store.builder()
+        merged = native_merge_records(store, run_files)
+        if merged is None:
+            merged = merge_iterator(store, run_files)
+        for key, values in merged:
+            builder.write(dump_record(key, values) + "\n")
+        store.remove(spill_file)
+        builder.build(spill_file)
+    times.finished = time.time()
+    for name in run_files:
+        store.remove(name)
+    times.cpu = time.process_time() - cpu0
+    times.written = time.time()
+    return times
+
+
 def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
                    part_key: str, run_files: List[str],
                    result_file: str) -> JobTimes:
-    """Execute one reduce job: k-way merge all mappers' runs for a
-    partition, fold with reducefn, publish the partition result.
+    """Execute one reduce job: k-way merge a partition's runs — raw
+    mapper runs and/or pre-merged spills, in the caller-given canonical
+    order (the merge concatenates equal-key values in file-list order,
+    so spill-aware callers control byte-level determinism) — fold with
+    reducefn, publish the partition result.
 
     Mirrors job.lua:230-296: the fast path for flagged reducers skips
     reducefn on singleton groups (264-275); results always land in the

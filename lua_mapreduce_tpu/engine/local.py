@@ -11,6 +11,8 @@ reference with one worker).
 from __future__ import annotations
 
 import re
+import sys
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Iterator, List, Optional, Tuple
@@ -18,10 +20,14 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 from lua_mapreduce_tpu.core.constants import MAX_TASKFN_VALUE_SIZE
 from lua_mapreduce_tpu.core.serialize import load_record, serialized_size
 from lua_mapreduce_tpu.engine.contract import TaskSpec
-from lua_mapreduce_tpu.engine.job import (JobTimes, run_map_job,
-                                          run_reduce_job)
+from lua_mapreduce_tpu.engine.job import (JobTimes, map_key_str, run_map_job,
+                                          run_premerge_job, run_reduce_job)
+from lua_mapreduce_tpu.engine.premerge import (PremergeTracker,
+                                               discover_pipelined,
+                                               run_name_re)
 from lua_mapreduce_tpu.store.router import get_storage_from
-from lua_mapreduce_tpu.utils.stats import IterationStats, TaskStats
+from lua_mapreduce_tpu.utils.stats import (IterationStats, TaskStats,
+                                           overlap_fraction)
 
 
 def collect_task_jobs(spec: TaskSpec) -> List[Tuple[Any, Any]]:
@@ -97,13 +103,24 @@ class LocalExecutor:
     ``map_parallelism`` > 1 runs map/reduce jobs on a thread pool — the
     in-process analog of N workers (useful for IO-bound user functions; the
     distributed engine is the real scale path).
+
+    ``pipeline`` enables the pipelined shuffle: map and pre-merge share
+    the thread pool with no phase barrier — the moment enough contiguous
+    runs commit for a partition, a pre-merge task consolidates them into
+    a spill while other mappers still run (engine/premerge.py); the
+    reduce then merges {spills + tail runs}. Output is byte-identical to
+    the barrier path on every storage backend.
     """
 
     def __init__(self, spec: TaskSpec, map_parallelism: int = 1,
-                 max_iterations: int = 1000):
+                 max_iterations: int = 1000, pipeline: bool = False,
+                 premerge_min_runs: int = 4, premerge_max_runs: int = 8):
         self.spec = spec
         self.map_parallelism = max(1, map_parallelism)
         self.max_iterations = max_iterations
+        self.pipeline = pipeline
+        self.premerge_min_runs = premerge_min_runs
+        self.premerge_max_runs = premerge_max_runs
         self.store = get_storage_from(spec.storage)
         self.result_store = (get_storage_from(spec.result_storage)
                              if spec.result_storage else self.store)
@@ -128,18 +145,27 @@ class LocalExecutor:
         delete_results(self.result_store, spec.result_ns)
 
         jobs = collect_task_jobs(spec)
-        map_times = self._run_jobs([
-            (lambda k=k, v=v, i=i: run_map_job(spec, self.store, str(i), k, v))
-            for i, (k, v) in enumerate(jobs)])
-        it_stats.map.fold(map_times)
+        if self.pipeline:
+            (map_times, pre_times, pre_failed,
+             reduce_times) = self._run_pipelined(jobs)
+            it_stats.map.fold(map_times)
+            it_stats.premerge.fold(pre_times, failed=pre_failed)
+            it_stats.overlap_fraction = overlap_fraction(map_times, pre_times)
+            it_stats.reduce.fold(reduce_times)
+        else:
+            map_times = self._run_jobs([
+                (lambda k=k, v=v, i=i: run_map_job(spec, self.store, str(i),
+                                                   k, v))
+                for i, (k, v) in enumerate(jobs)])
+            it_stats.map.fold(map_times)
 
-        parts = discover_partitions(self.store, spec.result_ns)
-        reduce_times = self._run_jobs([
-            (lambda p=p, files=files: run_reduce_job(
-                spec, self.store, self.result_store, str(p), files,
-                result_file_name(spec.result_ns, p)))
-            for p, files in sorted(parts.items())])
-        it_stats.reduce.fold(reduce_times)
+            parts = discover_partitions(self.store, spec.result_ns)
+            reduce_times = self._run_jobs([
+                (lambda p=p, files=files: run_reduce_job(
+                    spec, self.store, self.result_store, str(p), files,
+                    result_file_name(spec.result_ns, p)))
+                for p, files in sorted(parts.items())])
+            it_stats.reduce.fold(reduce_times)
 
         # no finalfn → finish and keep results (True would gc them)
         verdict: Any = None
@@ -149,6 +175,87 @@ class LocalExecutor:
         it_stats.wall_time = time.time() - t0
         self.stats.iterations.append(it_stats)
         return verdict
+
+    def _run_pipelined(self, jobs) -> Tuple[List[JobTimes], List[JobTimes],
+                                            int, List[JobTimes]]:
+        """Map + eager pre-merge on ONE shared thread pool, no phase
+        barrier between them; reduce tasks join the same pool once every
+        map (and every launched pre-merge) finished.
+
+        Each map completion feeds the tracker under a lock and submits
+        any newly eligible consolidation batches immediately — a
+        pre-merge can run while later mappers are still mid-flight,
+        which is where the overlap (stats.overlap_fraction) comes from.
+        A failed pre-merge poisons its range and the reduce falls back
+        to the raw runs; map/reduce exceptions propagate exactly as in
+        the barrier path.
+        """
+        spec = self.spec
+        map_keys = [map_key_str(i) for i in range(len(jobs))]
+        tracker = PremergeTracker(spec.result_ns, map_keys,
+                                  min_runs=self.premerge_min_runs,
+                                  max_runs=self.premerge_max_runs)
+        run_re = run_name_re(spec.result_ns)
+        lock = threading.Lock()
+        map_times: List[JobTimes] = []
+        pre_times: List[JobTimes] = []
+        pre_futs: List = []
+        pre_failed = [0]
+        committed = [0]
+        pool = ThreadPoolExecutor(max_workers=self.map_parallelism)
+
+        def premerge_one(sp):
+            try:
+                t = run_premerge_job(spec, self.store, sp.files, sp.name)
+            except Exception as e:
+                with lock:
+                    pre_failed[0] += 1
+                    tracker.spill_failed(
+                        sp.part, sp.seq,
+                        spill_exists=self.store.exists(sp.name))
+                print(f"[local] pre_merge {sp.name} failed; reduce falls "
+                      f"back to raw runs: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                return
+            with lock:
+                pre_times.append(t)
+                tracker.spill_done(sp.part, sp.seq)
+
+        def map_one(i, k, v):
+            t = run_map_job(spec, self.store, str(i), k, v)
+            produced = {}
+            for name in self.store.list(
+                    f"{spec.result_ns}.P*.M{map_keys[i]}"):
+                m = run_re.match(name)
+                if m and m.group(2) == map_keys[i]:
+                    produced[int(m.group(1))] = name
+            with lock:
+                map_times.append(t)
+                tracker.note_map_committed(map_keys[i], produced)
+                committed[0] += 1
+                if committed[0] < len(jobs):
+                    # the LAST commit publishes nothing: a post-map
+                    # spill would serialize in front of the reduce
+                    for sp in tracker.take_eligible():
+                        pre_futs.append(pool.submit(premerge_one, sp))
+            return t
+
+        try:
+            map_futs = [pool.submit(map_one, i, k, v)
+                        for i, (k, v) in enumerate(jobs)]
+            for f in map_futs:
+                f.result()
+            for f in list(pre_futs):
+                f.result()
+            parts = discover_pipelined(self.store, spec.result_ns, map_keys)
+            red_futs = [pool.submit(
+                run_reduce_job, spec, self.store, self.result_store, str(p),
+                files, result_file_name(spec.result_ns, p))
+                for p, files in sorted(parts.items())]
+            reduce_times = [f.result() for f in red_futs]
+        finally:
+            pool.shutdown(wait=True, cancel_futures=True)
+        return map_times, pre_times, pre_failed[0], reduce_times
 
     def clean_namespace(self) -> None:
         """Drop every file under this task's result namespace in both

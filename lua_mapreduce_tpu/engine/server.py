@@ -23,13 +23,17 @@ from lua_mapreduce_tpu.core.constants import (DEFAULT_SLEEP, MAX_JOB_RETRIES,
                                               Status, TaskStatus)
 from lua_mapreduce_tpu.coord.jobstore import JobStore, make_job
 from lua_mapreduce_tpu.engine.contract import TaskSpec
-from lua_mapreduce_tpu.engine.job import JobTimes
+from lua_mapreduce_tpu.engine.job import JobTimes, map_key_str
 from lua_mapreduce_tpu.engine.local import (collect_task_jobs, delete_results,
                                             discover_partitions, iter_results,
                                             result_file_name)
-from lua_mapreduce_tpu.engine.worker import MAP_NS, RED_NS
+from lua_mapreduce_tpu.engine.premerge import (SPILL_TAG, PremergeTracker,
+                                               discover_pipelined,
+                                               parse_spill_name, run_name_re)
+from lua_mapreduce_tpu.engine.worker import MAP_NS, PRE_NS, RED_NS
 from lua_mapreduce_tpu.store.router import get_storage_from
-from lua_mapreduce_tpu.utils.stats import IterationStats, TaskStats
+from lua_mapreduce_tpu.utils.stats import (IterationStats, TaskStats,
+                                           overlap_fraction)
 
 
 class PhaseFailed(RuntimeError):
@@ -65,16 +69,30 @@ class Server:
     ``strict`` raises :class:`PhaseFailed` the moment a phase ends with
     FAILED jobs instead of feeding finalfn partial results (the default
     stays reference-compatible: warn on stderr and proceed).
+
+    ``pipeline`` enables the pipelined shuffle (engine/premerge.py):
+    while mappers still run, the server publishes eager ``pre_merge``
+    jobs that consolidate committed per-partition runs into spill runs,
+    and the reduce phase merges {spills + tail runs} in canonical order
+    — byte-identical output, less merge fan-in, and most of the merge
+    IO hidden behind the map phase (IterationStats.overlap_fraction).
+    ``premerge_min_runs``/``premerge_max_runs`` bound how many committed
+    runs one pre-merge job consolidates.
     """
 
     def __init__(self, store: JobStore, poll_interval: float = DEFAULT_SLEEP,
                  stale_timeout_s: Optional[float] = 600.0,
-                 verbose: bool = False, strict: bool = False):
+                 verbose: bool = False, strict: bool = False,
+                 pipeline: bool = False, premerge_min_runs: int = 4,
+                 premerge_max_runs: int = 8):
         self.store = store
         self.poll_interval = poll_interval
         self.stale_timeout_s = stale_timeout_s
         self.verbose = verbose
         self.strict = strict
+        self.pipeline = pipeline
+        self.premerge_min_runs = premerge_min_runs
+        self.premerge_max_runs = premerge_max_runs
         self.spec: Optional[TaskSpec] = None
         self.stats = TaskStats()
         self.finished_value: Any = None
@@ -147,6 +165,16 @@ class Server:
                 iteration = int(task.get("iteration", 1))
                 if self.spec is None:
                     self.spec = TaskSpec.from_description(task["spec"])
+                # a resumed task keeps ITS OWN shuffle mode: a crashed
+                # pipelined run left spills whose input runs are already
+                # deleted — a barrier resume's discovery would silently
+                # drop that data from the reduce (and vice versa is
+                # merely suboptimal, so one rule covers both). Write the
+                # resolved mode back: workers gate their pre_jobs probe
+                # on the doc marker, so a doc that predates it must not
+                # leave published pre_merge jobs unclaimable
+                self.pipeline = bool(task.get("pipeline", self.pipeline))
+                self.store.update_task({"pipeline": self.pipeline})
                 if status == TaskStatus.REDUCE.value:
                     skip_map = True
         if self.spec is None:
@@ -157,6 +185,9 @@ class Server:
                 "status": TaskStatus.WAIT.value,
                 "iteration": iteration,
                 "spec": self.spec.describe(),
+                # workers gate their pre_jobs probe on this marker, so
+                # barrier deployments pay zero extra claim round-trips
+                "pipeline": self.pipeline,
                 "started": time.time(),
             })
 
@@ -171,9 +202,20 @@ class Server:
             if not skip_map:
                 delete_results(result_store, self.spec.result_ns)
                 n_map = self._prepare_map(store)
-                self._wait_phase(MAP_NS, n_map, "map", progress)
-                it_stats.map.fold(self._phase_times(MAP_NS),
+                if self.pipeline:
+                    self._pipelined_map_phase(store, n_map, progress)
+                else:
+                    self._wait_phase(MAP_NS, n_map, "map", progress)
+                map_times = self._phase_times(MAP_NS)
+                it_stats.map.fold(map_times,
                                   failed=self.store.counts(MAP_NS)[Status.FAILED])
+                if self.pipeline:
+                    pre_times = self._phase_times(PRE_NS)
+                    it_stats.premerge.fold(
+                        pre_times,
+                        failed=self.store.counts(PRE_NS)[Status.FAILED])
+                    it_stats.overlap_fraction = overlap_fraction(map_times,
+                                                                 pre_times)
             skip_map = False
 
             n_red = self._prepare_reduce(store)
@@ -196,6 +238,7 @@ class Server:
             if verdict == "loop":
                 iteration += 1
                 self.store.drop_ns(MAP_NS)
+                self.store.drop_ns(PRE_NS)
                 self.store.drop_ns(RED_NS)
                 self.store.update_task({"iteration": iteration,
                                         "status": TaskStatus.WAIT.value})
@@ -233,10 +276,13 @@ class Server:
         return len(jobs)
 
     def _clean_runs(self, store) -> None:
-        """Drop every intermediate run file of this namespace
-        (``ns.P*.M*``) — the map-side analog of delete_results."""
-        for name in store.list(f"{self.spec.result_ns}.P*.M*"):
-            store.remove(name)
+        """Drop every intermediate run file of this namespace — raw
+        mapper runs (``ns.P*.M*``) AND pipelined spill runs
+        (``ns.P*.SPILL-*``) — the map-side analog of delete_results."""
+        for pattern in (f"{self.spec.result_ns}.P*.M*",
+                        f"{self.spec.result_ns}.P*.{SPILL_TAG}-*"):
+            for name in store.list(pattern):
+                store.remove(name)
 
     def _prepare_reduce(self, store) -> int:
         """Discover map-output partitions and insert one reduce job per
@@ -249,14 +295,24 @@ class Server:
         list drives diagnostics: a reduce that can't see a run can name
         the host that produced it."""
         self.store.drop_ns(RED_NS)
-        parts = discover_partitions(store, self.spec.result_ns)
-        producer_by_id = {str(jid): w
+        if self.pipeline:
+            # file lists rebuilt from storage: spills in place of the
+            # contiguous run ranges they consumed, tail runs raw, all in
+            # canonical (byte-identical) merge order — works equally on
+            # a crash/resume, where the tracker state is gone
+            map_keys = [map_key_str(d["_id"])
+                        for d in self.store.jobs(MAP_NS)]
+            parts = discover_pipelined(store, self.spec.result_ns, map_keys)
+        else:
+            parts = discover_partitions(store, self.spec.result_ns)
+        producer_by_id = {map_key_str(jid): w
                          for jid, w in self.store.job_workers(MAP_NS).items()}
         docs = []
         for part, files in sorted(parts.items()):
             mappers = set()
             for f in files:
-                # run-file name is "<ns>.P<part>.M<map_job_id>"
+                # run-file name is "<ns>.P<part>.M<map_job_id>" (spill
+                # files carry no ".M" infix and resolve to no producer)
                 producer = producer_by_id.get(f.rsplit(".M", 1)[-1])
                 if producer is not None:
                     mappers.add(producer)
@@ -271,6 +327,148 @@ class Server:
         self.store.update_task({"status": TaskStatus.REDUCE.value})
         return len(docs)
 
+    def _housekeep(self, *namespaces: str) -> None:
+        """One poll's shared upkeep (make_task_coroutine_wrap,
+        server.lua:186-234): scavenge BROKEN≥retries→FAILED and requeue
+        stale RUNNING in every given namespace, then drain + retain
+        worker errors. Both the barrier wait and the pipelined wait call
+        this so the recovery semantics cannot drift apart."""
+        for ns in namespaces:
+            self.store.scavenge(ns, MAX_JOB_RETRIES)
+            if self.stale_timeout_s is not None:
+                self.store.requeue_stale(ns, self.stale_timeout_s)
+        for err in self.store.drain_errors():
+            # the drain is destructive — always retain for diagnosis,
+            # not only when verbose (server.lua:218-228 echoes live)
+            self.errors.append(err)
+            self._log(f"worker error [{err['worker']}]: "
+                      f"{err['msg'].splitlines()[-1] if err['msg'] else ''}")
+
+    def _finish_phase(self, phase: str, counts: Dict[Status, int],
+                      total: int) -> None:
+        """End-of-phase FAILED handling, shared by both waits: strict
+        mode aborts with :class:`PhaseFailed`; the default warns on
+        stderr (with the last retained worker error) and proceeds on
+        partial results, reference-style."""
+        if not counts[Status.FAILED]:
+            return
+        if self.strict:
+            raise PhaseFailed(phase, counts[Status.FAILED], total,
+                              self.errors)
+        import sys
+        print(f"[server] {phase}: {counts[Status.FAILED]} job(s) "
+              f"FAILED after {MAX_JOB_RETRIES} retries; "
+              f"{len(self.errors)} worker error(s) retained in "
+              f"Server.errors"
+              + (f"; last:\n{self.errors[-1]['msg']}"
+                 if self.errors else ""),
+              file=sys.stderr)
+
+    def _pipelined_map_phase(self, store, n_map: int,
+                             progress: Optional[Callable[[str, float],
+                                                         None]]) -> None:
+        """Overlapped map + eager pre-merge barrier (the pipelined
+        replacement for ``_wait_phase(MAP_NS, ...)``).
+
+        Every poll: scavenge/requeue/drain both namespaces; feed newly
+        committed map jobs' runs to the :class:`PremergeTracker`; publish
+        the tracker's eligible consolidation batches as ``pre_merge``
+        jobs (workers claim them while mappers still run); settle
+        finished/failed pre-merge jobs — a FAILED one whose spill file
+        exists anyway counts as done (the worker died after the atomic
+        build), otherwise its range is poisoned and the reduce falls back
+        to the raw runs. Returns once every map job AND every published
+        pre-merge job reached a terminal state; no new pre-merge is
+        published after the last map commits (a post-map spill would
+        serialize in front of the reduce instead of hiding under the
+        map).
+        """
+        ns = self.spec.result_ns
+        self.store.drop_ns(PRE_NS)
+        tracker = PremergeTracker(
+            ns, [map_key_str(d["_id"]) for d in self.store.jobs(MAP_NS)],
+            min_runs=self.premerge_min_runs, max_runs=self.premerge_max_runs)
+        for name in store.list(f"{ns}.P*.{SPILL_TAG}-*"):
+            parsed = parse_spill_name(ns, name)    # crash/resume leftovers
+            if parsed is not None:
+                tracker.note_existing_spill(*parsed, name=name)
+        run_re = run_name_re(ns)
+        seen_committed: set = set()
+        pre_ids: Dict[int, tuple] = {}    # pre job id -> (part, seq)
+        settled_pre: set = set()
+        while True:
+            self._housekeep(MAP_NS, PRE_NS)
+
+            # gate the per-job snapshot (payload deep-copies) on the
+            # cheap index counts — at reference fan-in (~2,000 map jobs)
+            # an unconditional jobs() per poll would dominate the poll
+            mcounts = self.store.counts(MAP_NS)
+            n_terminal = mcounts[Status.WRITTEN] + mcounts[Status.FAILED]
+            newly = []
+            if n_terminal > len(seen_committed):
+                newly = [d for d in self.store.jobs(MAP_NS)
+                         if d["status"] in (Status.WRITTEN, Status.FAILED)
+                         and d["_id"] not in seen_committed]
+            if newly:
+                runs_by_key: Dict[str, Dict[int, str]] = {}
+                for name in store.list(f"{ns}.P*.M*"):
+                    m = run_re.match(name)
+                    if m:
+                        runs_by_key.setdefault(m.group(2), {})[
+                            int(m.group(1))] = name
+                for d in newly:
+                    seen_committed.add(d["_id"])
+                    key = map_key_str(d["_id"])
+                    # FAILED jobs contribute whatever partial runs they
+                    # managed to publish — the barrier path's documented
+                    # partial-results behavior (discover_partitions
+                    # includes them); treating them as absent would let
+                    # a spill range span the orphan runs and the reduce
+                    # discovery sweep them as consumed leftovers
+                    tracker.note_map_committed(key, runs_by_key.get(key, {}))
+            map_done = len(seen_committed) >= n_map
+
+            if not map_done:
+                spills = tracker.take_eligible()
+                if spills:
+                    ids = self.store.insert_jobs(PRE_NS, [
+                        make_job(f"{sp.part}.{sp.seq}",
+                                 {"part": sp.part, "seq": sp.seq,
+                                  "files": sp.files, "spill": sp.name})
+                        for sp in spills])
+                    for jid, sp in zip(ids, spills):
+                        pre_ids[jid] = (sp.part, sp.seq)
+                    self._log(f"published {len(spills)} pre_merge job(s) "
+                              f"({len(seen_committed)}/{n_map} maps done)")
+
+            pcounts = self.store.counts(PRE_NS)
+            pre_terminal = pcounts[Status.WRITTEN] + pcounts[Status.FAILED]
+            pre_docs = (self.store.jobs(PRE_NS)
+                        if pre_terminal > len(settled_pre) else ())
+            for d in pre_docs:
+                jid = d["_id"]
+                if jid in settled_pre or jid not in pre_ids:
+                    continue
+                if d["status"] == Status.WRITTEN:
+                    settled_pre.add(jid)
+                    tracker.spill_done(*pre_ids[jid])
+                elif d["status"] == Status.FAILED:
+                    settled_pre.add(jid)
+                    part, seq = pre_ids[jid]
+                    sp = tracker.spills.get((part, seq))
+                    exists = sp is not None and store.exists(sp.name)
+                    tracker.spill_failed(part, seq, spill_exists=exists)
+                    self._log(f"pre_merge job {jid} FAILED; "
+                              + ("spill present, kept" if exists else
+                                 "range poisoned, reduce uses raw runs"))
+
+            if progress is not None:
+                progress("map", len(seen_committed) / max(n_map, 1))
+            if map_done and len(settled_pre) >= len(pre_ids):
+                self._finish_phase("map", self.store.counts(MAP_NS), n_map)
+                return
+            time.sleep(self.poll_interval)
+
     def _wait_phase(self, ns: str, total: int, phase: str,
                     progress: Optional[Callable[[str, float], None]]) -> None:
         """Barrier poll (make_task_coroutine_wrap, server.lua:186-234):
@@ -278,32 +476,13 @@ class Server:
         drain + surface worker errors, report progress — until every job is
         WRITTEN or FAILED."""
         while True:
-            self.store.scavenge(ns, MAX_JOB_RETRIES)
-            if self.stale_timeout_s is not None:
-                self.store.requeue_stale(ns, self.stale_timeout_s)
-            for err in self.store.drain_errors():
-                # the drain is destructive — always retain for diagnosis,
-                # not only when verbose (server.lua:218-228 echoes live)
-                self.errors.append(err)
-                self._log(f"worker error [{err['worker']}]: "
-                          f"{err['msg'].splitlines()[-1] if err['msg'] else ''}")
+            self._housekeep(ns)
             counts = self.store.counts(ns)
             done = counts[Status.WRITTEN] + counts[Status.FAILED]
             if progress is not None:
                 progress(phase, done / max(total, 1))
             if done >= total:
-                if counts[Status.FAILED] and self.strict:
-                    raise PhaseFailed(phase, counts[Status.FAILED], total,
-                                      self.errors)
-                if counts[Status.FAILED]:
-                    import sys
-                    print(f"[server] {phase}: {counts[Status.FAILED]} job(s) "
-                          f"FAILED after {MAX_JOB_RETRIES} retries; "
-                          f"{len(self.errors)} worker error(s) retained in "
-                          f"Server.errors"
-                          + (f"; last:\n{self.errors[-1]['msg']}"
-                             if self.errors else ""),
-                          file=sys.stderr)
+                self._finish_phase(phase, counts, total)
                 return
             time.sleep(self.poll_interval)
 
@@ -321,6 +500,7 @@ class Server:
     def _drop_everything(self) -> None:
         """server_drop_collections (server.lua:331-345)."""
         self.store.drop_ns(MAP_NS)
+        self.store.drop_ns(PRE_NS)
         self.store.drop_ns(RED_NS)
         self.store.delete_task()
 
@@ -381,5 +561,28 @@ def utest() -> None:
         it = stats.iterations[-1]
         assert it.map.count == 3 and it.map.failed == 0
         assert it.reduce.count == 1 and it.reduce.failed == 0
+
+        # pipelined-shuffle leg: same task, eager pre-merge enabled —
+        # result must be identical (premerge count depends on worker
+        # timing, so only the invariants are asserted)
+        mod.result = None
+        store2 = MemJobStore()
+        spec2 = TaskSpec(taskfn="_server_utest_mod",
+                         mapfn="_server_utest_mod",
+                         partitionfn="_server_utest_mod",
+                         reducefn="_server_utest_mod",
+                         finalfn="_server_utest_mod",
+                         storage="mem:_server_utest_pipe")
+        server2 = Server(store2, poll_interval=0.01, pipeline=True,
+                         premerge_min_runs=2).configure(spec2)
+        w2 = Worker(store2).configure(max_iter=400, max_sleep=0.02)
+        t2 = threading.Thread(target=w2.execute, daemon=True)
+        t2.start()
+        stats2 = server2.loop()
+        t2.join(timeout=30)
+        assert mod.result == {"n": 4}, mod.result
+        it2 = stats2.iterations[-1]
+        assert it2.map.count == 3 and it2.reduce.failed == 0
+        assert it2.premerge.failed == 0
     finally:
         del sys.modules["_server_utest_mod"]

@@ -25,11 +25,14 @@ from lua_mapreduce_tpu.core.constants import (DEFAULT_SLEEP, MAX_IDLE_COUNT,
                                               TaskStatus)
 from lua_mapreduce_tpu.coord.jobstore import JobStore
 from lua_mapreduce_tpu.engine.contract import TaskSpec
-from lua_mapreduce_tpu.engine.job import run_map_job, run_reduce_job
+from lua_mapreduce_tpu.engine.job import (run_map_job, run_premerge_job,
+                                          run_reduce_job)
 from lua_mapreduce_tpu.store.router import get_storage_from
 
 MAP_NS = "map_jobs"
 RED_NS = "red_jobs"
+PRE_NS = "pre_jobs"     # eager pre-merge jobs, published DURING the map
+                        # phase by a pipelined server (engine/premerge.py)
 
 _CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases",
                 "heartbeat_s")
@@ -93,17 +96,32 @@ class Worker:
         iteration = int(task.get("iteration", 1))
 
         if task["status"] == TaskStatus.MAP.value:
+            if "map" in self.phases:
+                preferred = self._affinity if iteration > 1 else None
+                steal = not preferred or self._idle_count >= MAX_IDLE_COUNT
+                job = self.store.claim(MAP_NS, self.name, preferred,
+                                       steal=steal)
+                if job is not None:
+                    self._idle_count = 0
+                    self._execute_map(spec, job)
+                    return "executed"
+            # eager pre-merge rides INSIDE the map phase (pipelined
+            # shuffle): reduce-side consolidation of committed runs, so
+            # it sits behind the same phase filter as reduce jobs —
+            # map-capable workers pick it up only when no map job is
+            # claimable (map progress stays the priority). The task-doc
+            # marker gates the probe: barrier-mode tasks never pay the
+            # extra pre_jobs claim round-trip per idle poll
+            if "reduce" in self.phases and task.get("pipeline"):
+                job = self.store.claim(PRE_NS, self.name)
+                if job is not None:
+                    self._idle_count = 0
+                    self._execute_premerge(spec, job)
+                    return "executed"
             if "map" not in self.phases:
                 return "out-of-phase"
-            preferred = self._affinity if iteration > 1 else None
-            steal = not preferred or self._idle_count >= MAX_IDLE_COUNT
-            job = self.store.claim(MAP_NS, self.name, preferred, steal=steal)
-            if job is None:
-                self._idle_count += 1
-                return "idle"
-            self._idle_count = 0
-            self._execute_map(spec, job)
-            return "executed"
+            self._idle_count += 1
+            return "idle"
 
         if task["status"] == TaskStatus.REDUCE.value:
             if "reduce" not in self.phases:
@@ -162,6 +180,24 @@ class Worker:
             self._mark_broken(ns, jid)
             raise
 
+    def _execute_premerge(self, spec: TaskSpec, job: dict) -> None:
+        """Consolidate committed runs into a spill (pipelined shuffle).
+        Input visibility/idempotence checks live in run_premerge_job —
+        a lost-then-reclaimed job whose first claimant already published
+        the spill short-circuits there instead of failing."""
+        ns, jid = PRE_NS, job["_id"]
+        try:
+            store = get_storage_from(spec.storage)
+            v = job["value"]
+            with self._beating(ns, jid):
+                times = run_premerge_job(spec, store, v["files"], v["spill"])
+            if self._finish(ns, jid, times):
+                self.jobs_executed += 1
+                self._log(f"pre_merge job {jid} done ({times.real:.3f}s)")
+        except Exception:
+            self._mark_broken(ns, jid)
+            raise
+
     def _execute_reduce(self, spec: TaskSpec, job: dict) -> None:
         ns, jid = RED_NS, job["_id"]
         try:
@@ -175,9 +211,12 @@ class Worker:
             # scp-from-mapper failure mode, fs.lua:148-157) instead of
             # silently reducing fewer runs. One LIST round trip — a
             # per-file exists() would serialize object-store latency
-            # across the whole fan-in.
+            # across the whole fan-in. The ``.*`` glob covers raw runs
+            # AND pre-merged ``.SPILL-*`` inputs (the pipelined server's
+            # reduce jobs mix both) without matching the partition's own
+            # ``<ns>.P<part>`` result file.
             visible = set(store.list(
-                f"{spec.result_ns}.P{v['part']}.M*"))
+                f"{spec.result_ns}.P{v['part']}.*"))
             missing = [f for f in v["files"] if f not in visible]
             if missing:
                 raise RuntimeError(

@@ -39,18 +39,44 @@ class PhaseStats:
         return dataclasses.asdict(self)
 
 
+def overlap_fraction(map_times: List[JobTimes],
+                     premerge_times: List[JobTimes]) -> float:
+    """Fraction of pre-merge wall time hidden behind the map phase.
+
+    The pipelined shuffle's effectiveness metric: per pre-merge job, the
+    part of its started→written window that falls before the last map
+    job's written time is overlapped (free); the rest extended the
+    iteration. 1.0 = every pre-merge second was hidden under still-running
+    mappers; 0.0 = no overlap (or no pre-merge ran).
+    """
+    if not map_times or not premerge_times:
+        return 0.0
+    map_end = max(t.written for t in map_times)
+    total = sum(t.real for t in premerge_times)
+    if total <= 0.0:
+        return 0.0
+    hidden = sum(max(0.0, min(t.written, map_end) - t.started)
+                 for t in premerge_times)
+    return min(1.0, hidden / total)
+
+
 @dataclasses.dataclass
 class IterationStats:
-    """Stats for one map→reduce iteration (server.lua:536-601)."""
+    """Stats for one map→reduce iteration (server.lua:536-601), plus the
+    pipelined-shuffle pre-merge phase when it ran."""
     iteration: int
     map: PhaseStats = dataclasses.field(default_factory=PhaseStats)
     reduce: PhaseStats = dataclasses.field(default_factory=PhaseStats)
+    premerge: PhaseStats = dataclasses.field(default_factory=PhaseStats)
     wall_time: float = 0.0
+    overlap_fraction: float = 0.0   # see overlap_fraction() above
 
     @property
     def cluster_time(self) -> float:
         """map+reduce cluster time — the reference's headline metric
-        (README.md:68-70)."""
+        (README.md:68-70). Pre-merge time is deliberately NOT added:
+        overlapped work is already inside the map window, and counting
+        the spill-over would double-charge what wall_time captures."""
         return self.map.cluster_time + self.reduce.cluster_time
 
     def as_dict(self) -> dict:
@@ -58,6 +84,8 @@ class IterationStats:
             "iteration": self.iteration,
             "map": self.map.as_dict(),
             "reduce": self.reduce.as_dict(),
+            "premerge": self.premerge.as_dict(),
+            "overlap_fraction": self.overlap_fraction,
             "cluster_time": self.cluster_time,
             "wall_time": self.wall_time,
         }
@@ -97,3 +125,10 @@ def utest() -> None:
     assert abs(it.cluster_time - (5.0 + 2.0)) < 1e-9
     d = TaskStats(iterations=[it]).as_dict()
     assert d["iterations"][0]["map"]["count"] == 2
+    assert d["iterations"][0]["premerge"]["count"] == 0
+    # overlap: map ends at 6.0; one pre-merge fully inside (2→4), one
+    # half outside (5→7): hidden = 2 + 1 of real = 2 + 2 → 3/4
+    pre = [JobTimes(started=2.0, finished=3.0, written=4.0, cpu=0.1),
+           JobTimes(started=5.0, finished=6.0, written=7.0, cpu=0.1)]
+    assert abs(overlap_fraction(times, pre) - 0.75) < 1e-9
+    assert overlap_fraction([], pre) == 0.0 and overlap_fraction(times, []) == 0.0

@@ -8,4 +8,9 @@
 set -e
 cd "$(dirname "$0")"
 python -c "import lua_mapreduce_tpu; lua_mapreduce_tpu.utest(); print('utest: all module self-tests passed')"
+# collection gate: API-drift import/collection errors (e.g. a changed JAX
+# signature at module scope) must fail loudly here, not hide behind a
+# --continue-on-collection-errors run that still reports green dots
+python -m pytest tests/ --collect-only -q > /dev/null
+echo "collect gate: tests/ collects cleanly"
 python -m pytest tests/ -q --full

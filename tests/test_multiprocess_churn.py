@@ -74,7 +74,14 @@ _STALL_REDUCE = (
 
 
 @pytest.mark.heavy
-def test_nine_process_pool_survives_map_and_reduce_sigkill(tmp_path):
+@pytest.mark.parametrize("pipeline", [False, True],
+                         ids=["barrier", "pipelined"])
+def test_nine_process_pool_survives_map_and_reduce_sigkill(tmp_path,
+                                                           pipeline):
+    """The ``pipelined`` leg runs the same chaos with eager pre-merge
+    jobs enabled: the map victim's SIGKILL lands while pre_merge jobs
+    are live in the pool, their claims ride the same ownership CAS +
+    stale-requeue recovery, and the golden result must still hold."""
     from examples.wordcount_big import corpus
 
     corpus_dir = str(tmp_path / "corpus")
@@ -145,8 +152,9 @@ def test_nine_process_pool_survives_map_and_reduce_sigkill(tmp_path):
     watchdog.start()
 
     try:
-        server = Server(store, poll_interval=0.05,
-                        stale_timeout_s=1.5).configure(spec)
+        server = Server(store, poll_interval=0.05, stale_timeout_s=1.5,
+                        pipeline=pipeline,
+                        premerge_min_runs=2).configure(spec)
         stats = server.loop()
     finally:
         watchdog.cancel()

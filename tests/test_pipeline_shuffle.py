@@ -1,0 +1,211 @@
+"""Pipelined-shuffle golden-diff harness.
+
+The tentpole's non-negotiable contract: with ``pipeline=True`` the
+engine overlaps eager pre-merge with the map phase, and the task output
+must be BYTE-identical to the barrier executor on every storage backend
+— same partitions, same files, same bytes — including the ``"loop"``
+iteration protocol. The matrix mirrors test_wordcount_golden's configs
+(combiner / no-combiner / general reducer) over all three backends, on a
+corpus small enough to run often but wide enough (many mappers, low
+``premerge_min_runs``) that pre-merge genuinely fires.
+"""
+
+import glob
+import os
+import re
+
+import pytest
+
+from examples.wordcount.naive import naive_wordcount
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.local import LocalExecutor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# ~25 mapper-sized corpus: engine + store + coord sources
+CORPUS = sorted(
+    glob.glob(os.path.join(REPO, "lua_mapreduce_tpu", "engine", "*.py"))
+    + glob.glob(os.path.join(REPO, "lua_mapreduce_tpu", "store", "*.py"))
+    + glob.glob(os.path.join(REPO, "lua_mapreduce_tpu", "coord", "*.py")))
+
+CONFIGS = {
+    "combiner": dict(
+        taskfn="examples.wordcount.taskfn",
+        mapfn="examples.wordcount.mapfn",
+        partitionfn="examples.wordcount.partitionfn",
+        reducefn="examples.wordcount.reducefn",
+        combinerfn="examples.wordcount.reducefn",
+        finalfn="examples.wordcount.finalfn",
+    ),
+    "no_combiner": dict(
+        taskfn="examples.wordcount.taskfn",
+        mapfn="examples.wordcount.mapfn",
+        partitionfn="examples.wordcount.partitionfn",
+        reducefn="examples.wordcount.reducefn",
+        finalfn="examples.wordcount.finalfn",
+    ),
+    "general_reducer": dict(
+        taskfn="examples.wordcount.taskfn",
+        mapfn="examples.wordcount.mapfn",
+        partitionfn="examples.wordcount.partitionfn",
+        reducefn="examples.wordcount.reducefn2",
+        finalfn="examples.wordcount.finalfn",
+    ),
+}
+
+_RESULT_RE = re.compile(r"^result\.P(\d+)$")
+
+
+def _result_bytes(ex):
+    """partition → full result-file content, read through the backend."""
+    out = {}
+    for name in ex.result_store.list("result.P*"):
+        m = _RESULT_RE.match(name)
+        if m:
+            out[int(m.group(1))] = "".join(ex.result_store.lines(name))
+    return out
+
+
+def _run(config, storage, pipeline):
+    spec = TaskSpec(init_args={"files": CORPUS}, storage=storage,
+                    **CONFIGS[config])
+    ex = LocalExecutor(spec, map_parallelism=4, pipeline=pipeline,
+                       premerge_min_runs=2)
+    stats = ex.run()
+    import examples.wordcount.finalfn as fmod
+    return dict(fmod.counts), _result_bytes(ex), stats
+
+
+@pytest.mark.parametrize("config", list(CONFIGS))
+@pytest.mark.parametrize("backend", ["mem", "shared", "object"])
+def test_pipelined_byte_identical_to_barrier(tmp_path, config, backend):
+    storages = {
+        "mem": (f"mem:pipe-{config}-b", f"mem:pipe-{config}-p"),
+        "shared": (f"shared:{tmp_path}/b", f"shared:{tmp_path}/p"),
+        "object": (f"object:{tmp_path}/b", f"object:{tmp_path}/p"),
+    }[backend]
+    golden = naive_wordcount(CORPUS)
+
+    got_b, bytes_b, _ = _run(config, storages[0], pipeline=False)
+    got_p, bytes_p, stats_p = _run(config, storages[1], pipeline=True)
+
+    assert got_b == golden
+    assert got_p == golden
+    assert set(bytes_b) == set(bytes_p)
+    for part in bytes_b:
+        assert bytes_b[part] == bytes_p[part], \
+            f"partition {part} result differs between barrier and pipelined"
+
+    it = stats_p.iterations[-1]
+    assert it.premerge.count > 0, "pre-merge never fired"
+    assert it.premerge.failed == 0
+    assert 0.0 <= it.overlap_fraction <= 1.0
+    # spills and consumed runs must not leak past the reduce
+    leftovers = [n for n in ex_list(storages[1])
+                 if ".SPILL-" in n or ".M" in n]
+    assert leftovers == [], leftovers
+
+
+def ex_list(storage):
+    from lua_mapreduce_tpu.store.router import get_storage_from
+    return get_storage_from(storage).list("result.P*")
+
+
+def test_pipelined_loop_protocol():
+    """The iterative protocol under pipelining: per-iteration results are
+    correct, stale partitions don't leak across iterations, and the
+    premerge namespace resets every loop."""
+    state = {"it": 0, "seen": []}
+
+    def taskfn(emit):
+        words = (["alpha", "beta"] * 8) if state["it"] == 0 else ["alpha"] * 8
+        for i, w in enumerate(words):
+            emit(i, [w])
+
+    def mapfn(key, words, emit):
+        for w in words:
+            emit(w, 1)
+
+    def partitionfn(key):
+        return 0 if key == "alpha" else 1
+
+    def reducefn(key, values):
+        return sum(values)
+
+    def finalfn(pairs):
+        state["seen"] = sorted((k, v[0]) for k, v in pairs)
+        state["it"] += 1
+        return "loop" if state["it"] < 3 else None
+
+    spec = TaskSpec(taskfn=taskfn, mapfn=mapfn, partitionfn=partitionfn,
+                    reducefn=reducefn, finalfn=finalfn,
+                    storage="mem:pipe-loop")
+    stats = LocalExecutor(spec, map_parallelism=4, pipeline=True,
+                          premerge_min_runs=2).run()
+    assert state["it"] == 3
+    assert len(stats.iterations) == 3
+    # iteration 1 had both keys; later iterations must not leak "beta"
+    assert state["seen"] == [("alpha", 8)]
+    assert sum(it.premerge.count for it in stats.iterations) > 0
+
+
+def test_pipelined_server_inprocess():
+    """Server + elastic worker threads with pipeline=True over the
+    in-memory job store: pre_merge jobs are claimed under the worker's
+    CAS protocol and the result equals the barrier server's, byte for
+    byte."""
+    import sys
+    import threading
+    import types
+
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+    from lua_mapreduce_tpu.engine.server import Server
+    from lua_mapreduce_tpu.engine.worker import Worker
+    from lua_mapreduce_tpu.store.router import get_storage_from
+
+    mod = types.ModuleType("_pipe_srv_mod")
+
+    def taskfn(emit):
+        for i in range(12):
+            emit(i, i)
+
+    def mapfn(key, value, emit):
+        for j in range(30):
+            emit(f"k{(value * 31 + j) % 17:02d}", 1)
+
+    def reducefn(key, values):
+        return sum(values)
+
+    mod.taskfn, mod.mapfn, mod.reducefn = taskfn, mapfn, reducefn
+    mod.partitionfn = lambda key: int(key[1:]) % 3
+    sys.modules["_pipe_srv_mod"] = mod
+    try:
+        def leg(pipeline, tag):
+            store = MemJobStore()
+            spec = TaskSpec(taskfn="_pipe_srv_mod", mapfn="_pipe_srv_mod",
+                            partitionfn="_pipe_srv_mod",
+                            reducefn="_pipe_srv_mod",
+                            storage=f"mem:{tag}")
+            server = Server(store, poll_interval=0.01, pipeline=pipeline,
+                            premerge_min_runs=2).configure(spec)
+            workers = [Worker(store).configure(max_iter=600, max_sleep=0.02)
+                       for _ in range(3)]
+            threads = [threading.Thread(target=w.execute, daemon=True)
+                       for w in workers]
+            for t in threads:
+                t.start()
+            stats = server.loop()
+            for t in threads:
+                t.join(timeout=30)
+            st = get_storage_from(f"mem:{tag}")
+            return {n: "".join(st.lines(n))
+                    for n in st.list("result.P*")
+                    if _RESULT_RE.match(n)}, stats
+
+        bytes_b, _ = leg(False, "pipe-srv-b")
+        bytes_p, stats_p = leg(True, "pipe-srv-p")
+        assert bytes_b and bytes_b == bytes_p
+        it = stats_p.iterations[-1]
+        assert it.map.failed == 0 and it.reduce.failed == 0
+        assert it.premerge.failed == 0
+    finally:
+        del sys.modules["_pipe_srv_mod"]

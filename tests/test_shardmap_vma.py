@@ -30,6 +30,7 @@ Two kinds of regression here:
 import functools
 
 import jax
+import jax.export   # noqa: F401  (not an autoloaded submodule on older JAX)
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -37,14 +38,32 @@ from jax.sharding import AbstractMesh, Mesh, PartitionSpec as P
 
 from lua_mapreduce_tpu import ops
 
-AMESH = AbstractMesh((4,), ("dp",))
+# jax.shard_map went public in newer JAX; older installs carry it in
+# experimental with identical semantics
+try:
+    shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map
+
+
+def _abstract_mesh():
+    """AbstractMesh across the signature change: newer JAX takes
+    (axis_sizes, axis_names); older JAX takes one shape_tuple of
+    (name, size) pairs."""
+    try:
+        return AbstractMesh((4,), ("dp",))
+    except TypeError:
+        return AbstractMesh((("dp", 4),))
+
+
+AMESH = _abstract_mesh()
 
 
 def export_shardmap_tpu(f, in_specs, out_specs, *shapes):
     """Lower ``f`` inside shard_map for the TPU target from the CPU
     host; raises on any vma-typing or Mosaic legality violation."""
-    g = jax.shard_map(f, mesh=AMESH, in_specs=in_specs,
-                      out_specs=out_specs)
+    g = shard_map(f, mesh=AMESH, in_specs=in_specs,
+                  out_specs=out_specs)
     return jax.export.export(jax.jit(g), platforms=["tpu"])(*shapes)
 
 
@@ -129,7 +148,7 @@ class TestShardMapNumerics:
         k0 = jax.random.PRNGKey(0)
         q, k, v = (jax.random.normal(kk, (4, 256, 2, 64), jnp.float32)
                    for kk in jax.random.split(k0, 3))
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             lambda q_, k_, v_: ops.flash_attention(
                 q_, k_, v_, causal=True, backend="pallas_interpret"),
             mesh=mesh, in_specs=(P("dp"), P("dp"), P("dp")),
@@ -152,7 +171,7 @@ class TestShardMapNumerics:
                                          backend=backend)
             return o.sum() + 0.1 * lse.sum()
 
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             jax.grad(functools.partial(loss,
                                        backend="pallas_interpret"),
                      argnums=(0, 1, 2)),
