@@ -1,0 +1,17 @@
+"""Shared scaffolding for the host-side benchmarks (shuffle_bench,
+coord_bench): helpers whose behavior is load-bearing for the headline
+ratios and must not drift between scripts."""
+
+from __future__ import annotations
+
+import re
+
+
+def result_bytes(spill_dir: str, result_ns: str = "result") -> dict:
+    """Final partition files → their full text, for byte-comparing two
+    legs' outputs (a speedup only counts on identical results)."""
+    from lua_mapreduce_tpu.store.sharedfs import SharedStore
+    st = SharedStore(spill_dir)
+    pat = re.compile(rf"^{re.escape(result_ns)}\.P(\d+)$")
+    return {n: "".join(st.lines(n)) for n in st.list(f"{result_ns}.P*")
+            if pat.match(n)}

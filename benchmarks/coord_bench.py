@@ -1,0 +1,344 @@
+"""Batch-claim lease benchmark: control-plane jobs/sec, the tentpole's
+wall-clock proof for PR 2.
+
+Jobs/sec through the full map→shuffle→reduce cycle on a true
+multi-process worker pool coordinated by a ``FileJobStore``, on a
+MANY-TINY-JOBS wordcount (hundreds of sub-millisecond splits, two
+partitions): the regime where a per-job control plane dominates wall
+time (the reference flips one Mongo status per job, task.lua:258-343;
+its README targets a ~2,000-map-job fan-in).
+
+Three legs, same corpus/machine/pool, result partitions byte-compared
+across ALL legs (a speedup only counts on identical output):
+
+- ``v1_single``  — the SEED's per-job protocol, faithfully emulated: one
+  index claim per round trip, then FINISHED CAS + times-sidecar
+  tempfile/rename + WRITTEN CAS per job (4-5 flock/IO round trips/job).
+  This is "the single-claim path" the PR replaces.
+- ``lease_k1``   — the new engine at batch_k=1: single claims, but the
+  one-flock commit with index-embedded times (idx format JSIX0002).
+  Isolates how much of the win is the commit/times collapse alone.
+- ``lease``      — batch_k>1: workers lease up to k jobs per claim flock
+  and retire each lease in ONE commit flock; k adapts to job duration.
+
+Jobs/sec is computed over PHASE CLUSTER TIME (max written − min started,
+the stats system's execution window) so worker-process boot and
+teardown, identical across legs, don't dilute the ratio; wall time is
+recorded alongside. Each worker also reports its JobStore round-trip
+counters, so the artifact shows claim/commit traffic collapsing with
+the wall-clock win. Both shuffle modes run (PR 1's pipelined pre-merge
+publishes exactly the small-job flood that batching amortizes).
+
+Usage: python benchmarks/coord_bench.py [n_workers] [n_jobs] [batch_k]
+Artifact: benchmarks/results/coord.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RESULTS = os.path.join(REPO, "benchmarks", "results", "coord.json")
+
+LINES_PER_SPLIT = 12
+WORDS_PER_LINE = 6
+
+# The seed's single-claim protocol, reconstructed on the current store
+# for the baseline leg: claim one job per index round trip with the
+# seed's one-pread-per-record scan under the flock; commit = FINISHED
+# CAS + times-sidecar tempfile/rename + WRITTEN CAS. The times are ALSO
+# written into the index (one extra uncontended flock, a few percent of
+# the protocol under test, disclosed here) because the v2 stats fold
+# reads them from there — the sidecar is the measured cost, the index
+# write keeps the shared reporting path working.
+_V1_STORE = """
+import os, fcntl, time as _time
+from lua_mapreduce_tpu.coord import filestore, idx_py
+from lua_mapreduce_tpu.core.constants import Status
+
+def _v1_claim(path, worker, now):
+    # the seed scan: flock, then ONE pread per record until a claimable
+    # one is found (idx bulk reads arrived with the batch-lease PR)
+    if not os.path.exists(path):
+        return None
+    fd = os.open(path, os.O_RDWR)
+    fcntl.flock(fd, fcntl.LOCK_EX)
+    try:
+        os.lseek(fd, 0, os.SEEK_SET)
+        head = os.read(fd, idx_py.HEADER_SIZE)
+        count = idx_py._HEADER.unpack(head)[1] if len(head) >= 16 else 0
+        for jid in range(count):
+            os.lseek(fd, idx_py.HEADER_SIZE + jid * idx_py.RECORD_SIZE, 0)
+            rec = idx_py._REC.unpack(os.read(fd, idx_py.RECORD_SIZE))
+            if rec[0] in (Status.WAITING, Status.BROKEN):
+                os.lseek(fd, idx_py.HEADER_SIZE
+                         + jid * idx_py.RECORD_SIZE, 0)
+                os.write(fd, idx_py._REC.pack(
+                    Status.RUNNING, rec[1], worker, now,
+                    *((0.0,) * (idx_py.N_TIMES + 1))))
+                return jid, rec[1]
+        return None
+    finally:
+        os.close(fd)
+
+class V1Store(filestore.FileJobStore):
+    def claim_batch(self, ns, worker, k=1, preferred_ids=None, steal=True):
+        self._bump("claim")
+        got = _v1_claim(os.path.join(self.root, ns + ".idx"),
+                        filestore.worker_hash(worker), _time.time())
+        if got is None:
+            return []
+        jid, reps = got
+        try:
+            # the v1 per-job worker-name sidecar (one file CREATE per
+            # claim — the metadata round trip the claim log replaced)
+            with open(os.path.join(self._ns_dir(ns),
+                                   "w%d.txt" % jid), "w") as f:
+                f.write(worker)
+        except OSError:
+            pass
+        batches = self._resolve_batches(ns)
+        import copy
+        doc = copy.deepcopy(self._lookup_payload(batches, jid)) or {}
+        doc.update(_id=jid, status=Status.RUNNING, repetitions=reps,
+                   worker=worker, started_time=_time.time(), times=None)
+        return [doc]
+
+    def commit_batch(self, ns, worker, entries):
+        done = []
+        for jid, times in entries:
+            if not self.set_job_status(ns, jid, Status.FINISHED,
+                                       expect=(Status.RUNNING,),
+                                       expect_worker=worker):
+                continue
+            if times is not None:
+                filestore._atomic_write_json(
+                    os.path.join(self._ns_dir(ns), "t%d.json" % jid),
+                    dict(times))            # the v1 sidecar rename
+                self._idx(ns).set_times(    # v2 stats-fold compatibility
+                    jid, filestore._times5(dict(times)))
+            if self.set_job_status(ns, jid, Status.WRITTEN,
+                                   expect=(Status.FINISHED,),
+                                   expect_worker=worker):
+                done.append(jid)
+        return done
+"""
+
+
+def build_tiny_corpus(corpus_dir: str, n_jobs: int, seed: int = 0) -> list:
+    """n_jobs deterministic tiny splits (~500B each): enough words that
+    the reduce is a real merge, small enough that per-job data-plane
+    work is a few milliseconds and the control plane is what's timed."""
+    import numpy as np
+    os.makedirs(corpus_dir, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    vocab = np.array([f"w{i}" for i in range(512)])
+    p = 1.0 / np.arange(1, 513) ** 1.1
+    p /= p.sum()
+    files = []
+    for i in range(n_jobs):
+        path = os.path.join(corpus_dir, f"tiny{i:04d}.txt")
+        words = vocab[rng.choice(512, LINES_PER_SPLIT * WORDS_PER_LINE, p=p)]
+        if not os.path.exists(path):
+            with open(path + ".tmp", "w") as f:
+                for row in words.reshape(LINES_PER_SPLIT, WORDS_PER_LINE):
+                    f.write(" ".join(row) + "\n")
+            os.replace(path + ".tmp", path)
+        files.append(path)
+    return files
+
+
+def _spawn_workers(coord: str, n: int, v1: bool = False):
+    """Worker processes. Lease mode follows the TASK DOCUMENT's batch_k
+    (the server-deployed fleet default — the bench exercises the
+    deployment story, not a per-worker override); v1 mode pins batch_k=1
+    and swaps in the seed-protocol store. Each prints its store's
+    claim/commit round-trip counters as JSON on exit."""
+    store_setup = (_V1_STORE + f"st = V1Store({coord!r})\n" if v1 else
+                   f"st = FileJobStore({coord!r})\n")
+    code = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from lua_mapreduce_tpu import FileJobStore, Worker\n"
+        + store_setup +
+        "w = Worker(st).configure(max_iter=60, max_sleep=0.05,\n"
+        "                         max_tasks=1)\n"     # exit on FINISHED
+        + ("w.configure(batch_k=1)\n" if v1 else "") +
+        "w.execute()\n"
+        "print(json.dumps({'rounds': st.round_counts(),\n"
+        "                  'jobs': w.jobs_executed}), flush=True)\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return [subprocess.Popen([sys.executable, "-c", code], env=env,
+                             stdout=subprocess.PIPE, text=True)
+            for _ in range(n)]
+
+
+def _leg(mode: str, batch_k: int, pipeline: bool, n_workers: int, files,
+         scratch: str) -> dict:
+    from lua_mapreduce_tpu.coord.filestore import FileJobStore
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.server import Server
+
+    coord = tempfile.mkdtemp(prefix="cb-coord", dir=scratch)
+    spill = tempfile.mkdtemp(prefix="cb-spill", dir=scratch)
+    mod = "benchmarks.coord_task"
+    spec = TaskSpec(taskfn=mod, mapfn=mod, partitionfn=mod, reducefn=mod,
+                    init_args={"files": files},
+                    storage=f"shared:{spill}")
+    procs = _spawn_workers(coord, n_workers, v1=(mode == "v1"))
+    t0 = time.perf_counter()
+    try:
+        server = Server(FileJobStore(coord), poll_interval=0.02,
+                        pipeline=pipeline, premerge_min_runs=8,
+                        premerge_max_runs=32,
+                        batch_k=(batch_k if mode == "lease" else 1)
+                        ).configure(spec)
+        stats = server.loop()
+        wall = time.perf_counter() - t0
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    rounds = {"claim": 0, "commit": 0}
+    for p in procs:
+        try:
+            # workers exit on their own once they see the FINISHED task
+            # doc (max_tasks=1) and print their round-trip counters
+            out, _ = p.communicate(timeout=30)
+            tail = out.strip().rsplit("\n", 1)[-1] if out.strip() else ""
+            r = json.loads(tail)["rounds"]
+            rounds["claim"] += r["claim"]
+            rounds["commit"] += r["commit"]
+        except Exception:
+            p.kill()   # wedged straggler: counters undercount, never wrong
+    it = stats.iterations[-1]
+    # map+reduce only, matching the cluster-time denominator: the job
+    # count is then IDENTICAL across legs (premerge job counts are
+    # mode-dependent scheduling artifacts — they run overlapped inside
+    # the map window and would skew the ratio, not measure throughput)
+    n_jobs = it.map.count + it.reduce.count
+    cluster = it.map.cluster_time + it.reduce.cluster_time
+    return {
+        "wall_s": round(wall, 2),
+        "cluster_s": round(cluster, 2),
+        "jobs": n_jobs,
+        "jobs_per_s": round(n_jobs / max(cluster, 1e-9), 1),
+        "jobs_per_s_wall": round(n_jobs / wall, 1),
+        "map_jobs": it.map.count,
+        "reduce_jobs": it.reduce.count,
+        "premerge_jobs": it.premerge.count,
+        "failed": it.map.failed + it.reduce.failed,
+        "worker_claim_rounds": rounds["claim"],
+        "worker_commit_rounds": rounds["commit"],
+        "_spill_dir": spill,
+    }
+
+
+from benchmarks.bench_common import result_bytes as _result_bytes  # noqa: E402
+
+
+def _warmup(files) -> None:
+    """Pay one-time costs outside the timed legs: the native index
+    engine's compile-and-cache and the page cache of the splits."""
+    from lua_mapreduce_tpu.coord.idx import native_available
+    native_available()
+    for path in files:
+        with open(path, "rb") as f:
+            f.read()
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def run(n_workers: int = 0, n_jobs: int = 300, batch_k: int = 16,
+        corpus_dir: str = "/tmp/coord_bench_corpus",
+        rounds: int = 5) -> dict:
+    """Legs per round — {v1_single, lease_k1, lease} × {barrier,
+    pipelined} — in PAIRED order (each round's legs run back-to-back in
+    the same host-contention window, order alternated between rounds).
+
+    The headline ratio is the MEDIAN paired round. This workload's
+    variance is not symmetric noise: the v1 protocol takes ~5 locked
+    index cycles per job, so a contended window degrades it into flock
+    convoys (observed: identical legs spreading 5s→22s) while the
+    batched lease, holding the lock ~20x less often, sails through.
+    Those storms are the pathology being fixed — but cherry-picking one
+    would overstate, so the median over rounds carries the headline and
+    every round's ratio is recorded. ``n_workers=0`` sizes the pool to
+    2×cores: tiny jobs are IO-shaped (run publishes), so modest
+    oversubscription keeps workers busy while others hold the index
+    flock — the contention batching removes."""
+    n_workers = n_workers or max(4, 2 * (os.cpu_count() or 2))
+    files = build_tiny_corpus(corpus_dir, n_jobs)
+    _warmup(files)
+    scratch = tempfile.mkdtemp(prefix="coord-bench")
+    modes = ("v1", "lease_k1", "lease")
+    legs = {}          # (mode, pipeline) -> [round dicts]
+    identical = True
+    golden = None
+    try:
+        for i in range(max(1, rounds)):
+            for pipeline in (False, True):
+                order = modes if i % 2 == 0 else modes[::-1]
+                for mode in order:
+                    r = _leg(mode, batch_k, pipeline, n_workers, files,
+                             scratch)
+                    got = _result_bytes(r.pop("_spill_dir"))
+                    if golden is None:
+                        golden = got
+                    identical = identical and (got == golden)
+                    legs.setdefault((mode, pipeline), []).append(r)
+        out = {"identical_output": identical,
+               "n_workers": n_workers, "n_jobs": n_jobs,
+               "batch_k": batch_k, "rounds": rounds,
+               "n_cores": os.cpu_count(),
+               "split_words": LINES_PER_SPLIT * WORDS_PER_LINE}
+        for pipeline in (False, True):
+            pmode = "pipelined" if pipeline else "barrier"
+            v1 = legs[("v1", pipeline)]
+            k1 = legs[("lease_k1", pipeline)]
+            batched = legs[("lease", pipeline)]
+            ratios = [b["jobs_per_s"] / max(s["jobs_per_s"], 1e-9)
+                      for s, b in zip(v1, batched)]
+            med = sorted(range(len(ratios)),
+                         key=lambda j: ratios[j])[len(ratios) // 2]
+            out[f"{pmode}_v1_single"] = v1[med]
+            out[f"{pmode}_lease_k1"] = k1[med]
+            out[f"{pmode}_batched"] = batched[med]
+            out[f"coord_batch_speedup_{pmode}"] = round(_median(ratios), 3)
+            out[f"coord_batch_speedup_{pmode}_per_round"] = [
+                round(r, 3) for r in ratios]
+            out[f"coord_batch_speedup_{pmode}_best"] = round(max(ratios), 3)
+            out[f"coord_lease_k1_speedup_{pmode}"] = round(_median(
+                [k["jobs_per_s"] / max(s["jobs_per_s"], 1e-9)
+                 for s, k in zip(v1, k1)]), 3)
+        # headline: batched lease vs the seed's single-claim protocol
+        # under barrier semantics (the reference's own shape); the
+        # pipelined ratio shows composition with PR 1
+        out["coord_batch_speedup"] = out["coord_batch_speedup_barrier"]
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+    return out
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    jobs = int(sys.argv[2]) if len(sys.argv) > 2 else 300
+    k = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+    result = run(n, jobs, k)
+    print(json.dumps(result))
+    os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+    with open(RESULTS, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")

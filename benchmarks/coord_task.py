@@ -1,0 +1,48 @@
+"""Many-tiny-jobs wordcount task module for the coordination bench.
+
+Single-module six-function packaging (like examples/wordcount_big's
+bigtask) shaped so the CONTROL PLANE dominates: each map job word-counts
+one tiny split (a few hundred bytes — milliseconds of data-plane work),
+so per-job claim/commit round trips are the cost being measured. The
+partition count stays small (one run-file publish per map job keeps the
+data plane honest but minimal).
+"""
+
+import os
+import zlib
+from collections import Counter
+
+N_PARTS = 2
+
+_files = None
+
+
+def init(args):
+    global _files
+    _files = args["files"]
+    missing = [p for p in _files if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"{len(missing)} bench split(s) not found, first: {missing[0]}")
+
+
+def taskfn(emit):
+    for i, path in enumerate(_files):
+        emit(f"{i:04d}:{os.path.basename(path)}", path)
+
+
+def mapfn(key, value, emit):
+    with open(value) as f:
+        counts = Counter(f.read().split())
+    for word, n in counts.items():
+        emit(word, n)
+
+
+def partitionfn(key):
+    # crc32, NOT hash(): builtin str hashing is salted per process, and a
+    # partitionfn must agree across every worker in the pool
+    return zlib.crc32(key.encode()) % N_PARTS
+
+
+def reducefn(key, values):
+    return sum(values)

@@ -137,7 +137,12 @@ def main() -> int:
         print(json.dumps(artifact))
         return 0
     if os.path.exists(OUT):
-        prior = json.load(open(OUT))
+        try:
+            prior = json.load(open(OUT))
+        except (OSError, ValueError):
+            prior = {}   # corrupt/truncated committed artifact: the
+            #              TPU-protection check below just can't vouch
+            #              for it (kmeans_als_artifact.py's discipline)
         if prior.get("platform") == "tpu" and platform != "tpu":
             print(json.dumps({"skipped": "committed artifact is TPU; "
                                          "this CPU run won't clobber it"}))

@@ -120,13 +120,7 @@ def _leg(pipeline: bool, n_workers: int, files, scratch: str,
     }
 
 
-def _result_bytes(spill_dir: str) -> dict:
-    from lua_mapreduce_tpu.store.sharedfs import SharedStore
-    import re
-    st = SharedStore(spill_dir)
-    pat = re.compile(r"^result\.P(\d+)$")
-    return {n: "".join(st.lines(n)) for n in st.list("result.P*")
-            if pat.match(n)}
+from benchmarks.bench_common import result_bytes as _result_bytes  # noqa: E402
 
 
 def _effective_parallelism(spin_s: float = 0.4) -> float:

@@ -72,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="min committed runs one pre_merge consolidates")
     p.add_argument("--premerge-max-runs", type=int, default=8,
                    help="max runs per pre_merge job")
+    p.add_argument("--batch-k", type=int, default=1,
+                   help="fleet default claim-lease size, written to the "
+                        "task doc: workers claim up to K jobs per "
+                        "control-plane round trip and commit them in one "
+                        "batch (many-small-jobs amortization; workers "
+                        "still shrink long-job leases to 1 adaptively)")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -114,7 +120,8 @@ def main(argv=None) -> int:
                     strict=args.strict,
                     pipeline=args.pipeline,
                     premerge_min_runs=args.premerge_min_runs,
-                    premerge_max_runs=args.premerge_max_runs).configure(spec)
+                    premerge_max_runs=args.premerge_max_runs,
+                    batch_k=args.batch_k).configure(spec)
 
     for _ in range(args.inline_workers):
         w = Worker(store).configure(max_iter=10_000)

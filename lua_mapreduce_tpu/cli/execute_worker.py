@@ -20,6 +20,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-sleep", type=float, default=20.0)
     p.add_argument("--max-tasks", type=int, default=1)
     p.add_argument("--name")
+    p.add_argument("--max-jobs", type=int, default=None,
+                   help="leave after executing N jobs (bounded lifetime "
+                        "for churned elastic pools)")
+    p.add_argument("--batch-k", type=int, default=None,
+                   help="claim up to K jobs per control-plane round trip "
+                        "(batch lease); default follows the task "
+                        "document's server-deployed batch_k")
     p.add_argument("--phases", default="map,reduce",
                    help="comma list of phases this worker claims "
                         "(heterogeneous pools: dedicated mapper hosts "
@@ -46,7 +53,9 @@ def main(argv=None) -> int:
     store = FileJobStore(args.coord)
     worker = Worker(store, name=args.name, verbose=args.verbose).configure(
         max_iter=args.max_iter, max_sleep=args.max_sleep,
-        max_tasks=args.max_tasks, phases=phases)
+        max_tasks=args.max_tasks, phases=phases, max_jobs=args.max_jobs)
+    if args.batch_k is not None:
+        worker.configure(batch_k=args.batch_k)
     worker.execute()
     return 0
 

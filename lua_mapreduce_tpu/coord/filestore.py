@@ -36,6 +36,22 @@ def worker_hash(worker: str) -> int:
     return int.from_bytes(h, "little", signed=True)
 
 
+# job-times field order in the index record (idx format JSIX0002 embeds
+# the 5 times per record; the v1 scheme was one t<jid>.json rename per
+# job — at many-tiny-jobs scale those renames dominated the commit)
+TIMES_KEYS = ("started", "finished", "written", "cpu", "real")
+
+
+def _times5(times: Optional[dict]):
+    if not times:
+        return None
+    return tuple(float(times.get(k) or 0.0) for k in TIMES_KEYS)
+
+
+def _times_doc(t5) -> Optional[dict]:
+    return dict(zip(TIMES_KEYS, t5)) if t5 is not None else None
+
+
 def _atomic_write_json(path: str, doc) -> None:
     d = os.path.dirname(path)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp.")
@@ -77,6 +93,9 @@ class FileJobStore(JobStore):
         # stale except when the ns is dropped (invalidated there) or a
         # new batch lands (rescan on miss).
         self._batches: Dict[str, List] = {}
+        # parsed claim-log cache: ns -> ((size, mtime_ns), {jid: name});
+        # the log is append-only, so size strictly grows on change
+        self._wlogs: Dict[str, tuple] = {}
 
     # -- paths -------------------------------------------------------------
 
@@ -88,11 +107,54 @@ class FileJobStore(JobStore):
         os.makedirs(d, exist_ok=True)
         return d
 
-    def _times(self, ns: str, job_id: int) -> str:
-        return os.path.join(self._ns_dir(ns), f"t{job_id}.json")
+    def _wlog(self, ns: str) -> str:
+        """Append-only claim log: one ``jid\\tworker`` line per claim,
+        last entry per jid wins. Replaces the v1 per-job ``w<jid>.txt``
+        sidecars — a file CREATE per claim was a metadata round trip
+        that survived batching; one O_APPEND write per LEASE (small
+        writes append atomically) is free, and readers get the whole
+        map in one read instead of one open per job."""
+        return os.path.join(self._ns_dir(ns), "workers.log")
 
-    def _wname(self, ns: str, job_id: int) -> str:
-        return os.path.join(self._ns_dir(ns), f"w{job_id}.txt")
+    def _read_wlog(self, ns: str) -> Dict[int, str]:
+        """Parsed claim log, cached on (size, mtime): per-job lookups
+        (get_job in a loop) must not re-parse a many-thousand-line log
+        per call. Callers treat the returned dict as read-only."""
+        path = self._wlog(ns)
+        try:
+            st = os.stat(path)
+            sig = (st.st_size, st.st_mtime_ns)
+        except OSError:
+            return {}
+        cached = self._wlogs.get(ns)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        out: Dict[int, str] = {}
+        try:
+            with open(path) as f:
+                for line in f:
+                    jid, sep, name = line.rstrip("\n").partition("\t")
+                    if sep and name:
+                        try:
+                            out[int(jid)] = name
+                        except ValueError:
+                            continue
+        except OSError:
+            return out
+        self._wlogs[ns] = (sig, out)
+        return out
+
+    def _append_wlog(self, ns: str, jids, worker: str) -> None:
+        try:
+            payload = "".join(f"{jid}\t{worker}\n" for jid in jids)
+            fd = os.open(self._wlog(ns),
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o666)
+            try:
+                os.write(fd, payload.encode())
+            finally:
+                os.close(fd)
+        except OSError:
+            pass  # observability only
 
     def _lockfile(self, name: str) -> str:
         return os.path.join(self.root, "locks", f"{name}.lock")
@@ -237,19 +299,63 @@ class FileJobStore(JobStore):
         return copy.deepcopy(doc) if doc is not None else {}
 
     def claim(self, ns, worker, preferred_ids=None, steal=True):
-        idx = self._idx(ns)
-        jid = idx.claim(worker_hash(worker), time.time(), preferred_ids, steal)
-        if jid < 0:
-            return None
-        try:
-            with open(self._wname(ns, jid), "w") as f:
-                f.write(worker)
-        except OSError:
-            pass  # observability only
-        return self._job_doc(ns, jid, idx)
+        got = self.claim_batch(ns, worker, 1, preferred_ids, steal)
+        return got[0] if got else None
+
+    def claim_batch(self, ns, worker, k=1, preferred_ids=None, steal=True):
+        """Lease up to k jobs in ONE locked index pass plus ONE claim-log
+        append. The claimed docs are built from the claim's own return
+        (id, repetitions) plus the payload cache — no per-job index
+        re-read, no per-job sidecar IO, no times read (a fresh claim's
+        times are a previous attempt's, which no caller of claim uses)."""
+        self._bump("claim")
+        now = time.time()
+        claimed = self._idx(ns).claim_batch(worker_hash(worker), now, k,
+                                            preferred_ids, steal)
+        if not claimed:
+            return []
+        self._append_wlog(ns, [jid for jid, _ in claimed], worker)
+        batches = self._resolve_batches(ns)
+        docs = []
+        for jid, reps in claimed:
+            doc = copy.deepcopy(self._lookup_payload(batches, jid)) or {}
+            doc.update(_id=jid, status=Status.RUNNING, repetitions=reps,
+                       worker=worker, started_time=now, times=None)
+            docs.append(doc)
+        return docs
+
+    def commit_batch(self, ns, worker, entries):
+        """Retire a batch in ONE flock cycle: status transition AND job
+        times land together in each index record (idx format JSIX0002),
+        CASed on this worker's ownership per entry. The v1 protocol paid
+        two status CAS flocks plus one times-sidecar rename per job."""
+        entries = [(jid, _times5(times)) for jid, times in entries]
+        if not entries:
+            return []
+        self._bump("commit")
+        ok = self._idx(ns).commit_batch(entries, worker_hash(worker))
+        return [jid for (jid, _), o in zip(entries, ok) if o]
+
+    def release_batch(self, ns, worker, job_ids):
+        """RUNNING→WAITING for leased-but-unstarted jobs, one flock."""
+        if not job_ids:
+            return 0
+        self._bump("commit")
+        ok = self._idx(ns).cas_status_batch(list(job_ids), Status.WAITING,
+                                            1 << int(Status.RUNNING),
+                                            worker_hash(worker))
+        return sum(ok)
+
+    def heartbeat_batch(self, ns, job_ids, worker):
+        if not job_ids:
+            return 0
+        return self._idx(ns).heartbeat_batch(list(job_ids),
+                                             worker_hash(worker),
+                                             time.time())
 
     def set_job_status(self, ns, job_id, status, expect=None,
                        expect_worker=None):
+        self._bump("commit")
         mask = 0
         if expect is not None:
             for s in expect:
@@ -266,47 +372,46 @@ class FileJobStore(JobStore):
     def jobs(self, ns):
         idx = self._idx(ns)
         docs = []
-        # one locked pass over the index, ONE batch resolution for the
-        # whole snapshot (per-jid resolution would re-read the gen file
-        # n times); times/worker sidecars are single-writer, no lock
+        # one locked pass over the index (times included — the index
+        # record embeds them), ONE batch resolution and ONE claim-log
+        # read for the whole snapshot (per-jid resolution would re-read
+        # the gen file / one sidecar per job)
         batches = self._resolve_batches(ns)
-        for jid, (status, reps, whash, started) in enumerate(idx.snapshot()):
+        wnames = self._read_wlog(ns)
+        for jid, (status, reps, whash, started, t5) in \
+                enumerate(idx.snapshot()):
             doc = copy.deepcopy(self._lookup_payload(batches, jid)) or {}
             doc.update(_id=jid, status=Status(status), repetitions=reps,
-                       worker=whash or None, started_time=started or None,
-                       times=_read_json(self._times(ns, jid)))
-            wname = _read_json_text(self._wname(ns, jid))
-            if wname:
-                doc["worker"] = wname
+                       worker=wnames.get(jid, whash or None),
+                       started_time=started or None,
+                       times=_times_doc(t5))
             docs.append(doc)
         return docs
 
     def _job_doc(self, ns, jid, idx) -> dict:
         state = idx.get(jid)
-        status, reps, whash, started = state
+        status, reps, whash, started, t5 = state
         doc = dict(self._payload_doc(ns, jid))
         doc.update(_id=jid, status=Status(status), repetitions=reps,
-                   worker=whash or None,
+                   worker=self._read_wlog(ns).get(jid, whash or None),
                    started_time=started or None,
-                   times=_read_json(self._times(ns, jid)))
-        wname = _read_json_text(self._wname(ns, jid))
-        if wname:
-            doc["worker"] = wname
+                   times=_times_doc(t5))
         return doc
 
     def job_workers(self, ns):
-        """id → worker from the w-sidecars alone — no payload reads, no
-        deep copies (the server calls this once per reduce prepare)."""
-        out = {}
-        idx = self._idx(ns)
-        for jid in range(idx.count()):
-            wname = _read_json_text(self._wname(ns, jid))
-            if wname:
-                out[jid] = wname
-        return out
+        """id → worker from the claim log alone — ONE file read, no
+        payload reads, no deep copies, no index lock (the server calls
+        this once per reduce prepare; the v1 scheme opened one sidecar
+        per job). Copied so callers cannot mutate the cache."""
+        return dict(self._read_wlog(ns))
 
     def set_job_times(self, ns, job_id, times):
-        _atomic_write_json(self._times(ns, job_id), dict(times))
+        self._bump("commit")
+        t5 = _times5(dict(times))
+        if t5 is not None:
+            # a dropped namespace (straggler finishing late) is a no-op,
+            # matching the v1 sidecar-write behavior
+            self._idx(ns).set_times(job_id, t5)
 
     def counts(self, ns):
         return self._idx(ns).counts()
@@ -323,6 +428,7 @@ class FileJobStore(JobStore):
 
     def drop_ns(self, ns):
         self._batches.pop(ns, None)
+        self._wlogs.pop(ns, None)
         for stale in (f"{ns}.idx", f"{ns}.gen"):
             try:
                 os.remove(os.path.join(self.root, stale))

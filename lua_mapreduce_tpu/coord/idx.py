@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import ctypes
 import os
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES, Status
 from lua_mapreduce_tpu.core.native_build import load_native
@@ -34,6 +34,33 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.jsx_claim.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                               ctypes.POINTER(ctypes.c_int64),
                               ctypes.c_int64, ctypes.c_int32]
+    lib.jsx_claim_batch.restype = ctypes.c_int64
+    lib.jsx_claim_batch.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.c_int64, ctypes.c_int32,
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.POINTER(ctypes.c_int32),
+                                    ctypes.c_int64]
+    lib.jsx_cas_status_batch.restype = ctypes.c_int64
+    lib.jsx_cas_status_batch.argtypes = [ctypes.c_char_p,
+                                         ctypes.POINTER(ctypes.c_int64),
+                                         ctypes.c_int64, ctypes.c_int32,
+                                         ctypes.c_uint32, ctypes.c_int64,
+                                         ctypes.POINTER(ctypes.c_int32)]
+    lib.jsx_commit_batch.restype = ctypes.c_int64
+    lib.jsx_commit_batch.argtypes = [ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_int64),
+                                     ctypes.c_int64, ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_double),
+                                     ctypes.POINTER(ctypes.c_int32)]
+    lib.jsx_set_times.restype = ctypes.c_int
+    lib.jsx_set_times.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.POINTER(ctypes.c_double)]
+    lib.jsx_heartbeat_batch.restype = ctypes.c_int64
+    lib.jsx_heartbeat_batch.argtypes = [ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_int64),
+                                        ctypes.c_int64, ctypes.c_int64,
+                                        ctypes.c_double]
     lib.jsx_cas_status.restype = ctypes.c_int
     lib.jsx_cas_status.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                    ctypes.c_int32, ctypes.c_uint32,
@@ -43,6 +70,7 @@ def _load() -> Optional[ctypes.CDLL]:
                             ctypes.POINTER(ctypes.c_int32),
                             ctypes.POINTER(ctypes.c_int32),
                             ctypes.POINTER(ctypes.c_int64),
+                            ctypes.POINTER(ctypes.c_double),
                             ctypes.POINTER(ctypes.c_double)]
     lib.jsx_counts.restype = ctypes.c_int64
     lib.jsx_counts.argtypes = [ctypes.c_char_p,
@@ -59,6 +87,7 @@ def _load() -> Optional[ctypes.CDLL]:
                                  ctypes.POINTER(ctypes.c_int32),
                                  ctypes.POINTER(ctypes.c_int32),
                                  ctypes.POINTER(ctypes.c_int64),
+                                 ctypes.POINTER(ctypes.c_double),
                                  ctypes.POINTER(ctypes.c_double),
                                  ctypes.c_int64]
     return lib
@@ -94,6 +123,68 @@ class NativeJobIndex:
         return self._lib.jsx_claim(self._p, worker, arr, len(pref),
                                    1 if steal else 0)
 
+    def claim_batch(self, worker: int, now: float, k: int,
+                    preferred: Optional[Sequence[int]] = None,
+                    steal: bool = True) -> List[Tuple[int, int]]:
+        if k <= 0:
+            return []
+        pref = preferred or ()
+        arr = (ctypes.c_int64 * len(pref))(*pref)
+        out_ids = (ctypes.c_int64 * k)()
+        out_reps = (ctypes.c_int32 * k)()
+        n = self._lib.jsx_claim_batch(self._p, worker, arr, len(pref),
+                                      1 if steal else 0, out_ids, out_reps, k)
+        if n < 0:
+            raise OSError(f"jsx_claim_batch failed on {self.path}")
+        return [(out_ids[i], out_reps[i]) for i in range(n)]
+
+    def cas_status_batch(self, ids: Sequence[int], to: Status,
+                         expect_mask: int = 0,
+                         expect_worker: int = 0) -> List[bool]:
+        if not ids:
+            return []
+        arr = (ctypes.c_int64 * len(ids))(*ids)
+        ok = (ctypes.c_int32 * len(ids))()
+        n = self._lib.jsx_cas_status_batch(self._p, arr, len(ids), int(to),
+                                           expect_mask, expect_worker, ok)
+        if n < 0:
+            raise OSError(f"jsx_cas_status_batch failed on {self.path}")
+        return [bool(ok[i]) for i in range(len(ids))]
+
+    def commit_batch(self, entries: Sequence[tuple],
+                     worker: int) -> List[bool]:
+        if not entries:
+            return []
+        n = len(entries)
+        ids = (ctypes.c_int64 * n)(*[jid for jid, _ in entries])
+        flat = []
+        for _, times in entries:
+            flat.extend(times if times is not None else (0.0,) * 5)
+        times_arr = (ctypes.c_double * (n * 5))(*flat)
+        ok = (ctypes.c_int32 * n)()
+        r = self._lib.jsx_commit_batch(self._p, ids, n, worker, times_arr,
+                                       ok)
+        if r < 0:
+            raise OSError(f"jsx_commit_batch failed on {self.path}")
+        return [bool(ok[i]) for i in range(n)]
+
+    def set_times(self, job_id: int, times: Sequence[float]) -> bool:
+        arr = (ctypes.c_double * 5)(*times)
+        r = self._lib.jsx_set_times(self._p, job_id, arr)
+        if r < 0:
+            raise OSError(f"jsx_set_times failed on {self.path}")
+        return bool(r)
+
+    def heartbeat_batch(self, ids: Sequence[int], worker: int,
+                        now: float) -> int:
+        if not ids:
+            return 0
+        arr = (ctypes.c_int64 * len(ids))(*ids)
+        n = self._lib.jsx_heartbeat_batch(self._p, arr, len(ids), worker, now)
+        if n < 0:
+            raise OSError(f"jsx_heartbeat_batch failed on {self.path}")
+        return n
+
     def cas_status(self, job_id: int, to: Status, expect_mask: int = 0,
                    expect_worker: int = 0) -> bool:
         r = self._lib.jsx_cas_status(self._p, job_id, int(to), expect_mask,
@@ -102,19 +193,22 @@ class NativeJobIndex:
             raise OSError(f"jsx_cas_status failed on {self.path}")
         return bool(r)
 
-    def get(self, job_id: int) -> Optional[Tuple[int, int, int, float]]:
+    def get(self, job_id: int) -> Optional[tuple]:
         status = ctypes.c_int32()
         reps = ctypes.c_int32()
         worker = ctypes.c_int64()
         started = ctypes.c_double()
+        times = (ctypes.c_double * 5)()
         r = self._lib.jsx_get(self._p, job_id, ctypes.byref(status),
                               ctypes.byref(reps), ctypes.byref(worker),
-                              ctypes.byref(started))
+                              ctypes.byref(started), times)
         if r < 0:
             raise OSError(f"jsx_get failed on {self.path}")
         if r == 0:
             return None
-        return status.value, reps.value, worker.value, started.value
+        t = tuple(times)
+        return (status.value, reps.value, worker.value, started.value,
+                None if t == (0.0,) * 5 else t)
 
     def counts(self) -> Dict[Status, int]:
         out = (ctypes.c_int64 * 6)()
@@ -149,12 +243,18 @@ class NativeJobIndex:
         reps = (ctypes.c_int32 * cap)()
         workers = (ctypes.c_int64 * cap)()
         started = (ctypes.c_double * cap)()
+        times = (ctypes.c_double * (cap * 5))()
         n = self._lib.jsx_snapshot(self._p, statuses, reps, workers,
-                                   started, cap)
+                                   started, times, cap)
         if n < 0:
             raise OSError(f"jsx_snapshot failed on {self.path}")
-        return [(statuses[i], reps[i], workers[i], started[i])
-                for i in range(n)]
+        out = []
+        zero = (0.0,) * 5
+        for i in range(n):
+            t = tuple(times[i * 5:(i + 1) * 5])
+            out.append((statuses[i], reps[i], workers[i], started[i],
+                        None if t == zero else t))
+        return out
 
 
 def open_index(path: str, engine: str = "auto"):

@@ -11,10 +11,18 @@ The on-disk format is shared byte-for-byte with the native C++ engine
 (native/jobstore.cpp); processes may mix the two freely on the same files.
 
 Layout (little-endian):
-    header:  8s magic "JSIX0001" | q record count
-    record:  i status | i repetitions | q worker-hash | d started_time | d heartbeat
-(``heartbeat`` was the reserved field; 0.0 = never beaten — old files
-read compatibly.)
+    header:  8s magic "JSIX0002" | q record count
+    record:  i status | i repetitions | q worker-hash | d started_time
+             | d heartbeat | 5d job times (started, finished, written,
+             cpu, real; all-zero = not recorded)
+
+Format note: JSIX0002 embeds the per-job TIMES in the record (the v1
+times sidecar was one tempfile+rename per job — at many-tiny-jobs scale
+those renames dominated the commit path, and the server's stats fold
+re-opened one JSON file per job). Index files are per-run coordination
+state, not durable data, so v1 files are not migrated — a v1 file left
+by an older process fails the magic check loudly rather than being
+misread.
 """
 
 from __future__ import annotations
@@ -26,11 +34,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES, Status
 
-MAGIC = b"JSIX0001"
+MAGIC = b"JSIX0002"
 _HEADER = struct.Struct("<8sq")
-_REC = struct.Struct("<iiqdd")
+_REC = struct.Struct("<iiqddddddd")
 HEADER_SIZE = _HEADER.size       # 16
-RECORD_SIZE = _REC.size          # 32
+RECORD_SIZE = _REC.size          # 72
+N_TIMES = 5                      # started, finished, written, cpu, real
+_ZERO_TIMES = (0.0,) * N_TIMES
 
 _CLAIM_MASK = (1 << Status.WAITING) | (1 << Status.BROKEN)
 
@@ -67,15 +77,44 @@ class PyJobIndex:
         os.write(fd, _HEADER.pack(MAGIC, count))
 
     @staticmethod
-    def _read_rec(fd, job_id: int) -> Tuple[int, int, int, float, float]:
+    def _read_rec(fd, job_id: int) -> tuple:
+        """(status, reps, worker, started, heartbeat, t0..t4)."""
         os.lseek(fd, HEADER_SIZE + job_id * RECORD_SIZE, os.SEEK_SET)
         return _REC.unpack(os.read(fd, RECORD_SIZE))
 
     @staticmethod
     def _write_rec(fd, job_id: int, status: int, reps: int, worker: int,
-                   started: float, reserved: float = 0.0) -> None:
+                   started: float, heartbeat: float = 0.0,
+                   times: Sequence[float] = _ZERO_TIMES) -> None:
         os.lseek(fd, HEADER_SIZE + job_id * RECORD_SIZE, os.SEEK_SET)
-        os.write(fd, _REC.pack(status, reps, worker, started, reserved))
+        os.write(fd, _REC.pack(status, reps, worker, started, heartbeat,
+                               *times))
+
+    @staticmethod
+    def _times_of(rec: tuple) -> Optional[Tuple[float, ...]]:
+        times = rec[5:5 + N_TIMES]
+        return None if times == _ZERO_TIMES else times
+
+    @classmethod
+    def _read_all(cls, fd) -> List[Tuple[int, int, int, float, float]]:
+        """Every record in ONE read syscall — scan-shaped operations
+        (claim, counts, snapshot, scavenge, requeue) pay one IO round
+        trip under the flock instead of one pread per record (on network
+        filesystems the per-record scan dominated claim latency at the
+        ~2,000-job reference fan-in)."""
+        count = cls._read_count(fd)
+        if count <= 0:
+            return []
+        os.lseek(fd, HEADER_SIZE, os.SEEK_SET)
+        want = count * RECORD_SIZE
+        buf = b""
+        while len(buf) < want:
+            chunk = os.read(fd, want - len(buf))
+            if not chunk:
+                break
+            buf += chunk
+        full = len(buf) - (len(buf) % RECORD_SIZE)
+        return list(_REC.iter_unpack(buf[:full]))
 
     # -- operations (mirror native/jobstore.cpp exports) -------------------
 
@@ -105,27 +144,51 @@ class PyJobIndex:
               steal: bool = True) -> int:
         """First WAITING|BROKEN → RUNNING. Returns claimed id or -1.
         ``steal=False`` restricts the scan to ``preferred``."""
-        if not os.path.exists(self.path):
-            return -1
+        got = self.claim_batch(worker, now, 1, preferred, steal)
+        return got[0][0] if got else -1
+
+    def claim_batch(self, worker: int, now: float, k: int,
+                    preferred: Optional[Sequence[int]] = None,
+                    steal: bool = True) -> List[Tuple[int, int]]:
+        """Claim up to ``k`` WAITING|BROKEN records → RUNNING in ONE
+        locked pass over ONE bulk read (the batch-lease amortization of
+        the claim round trip). Returns [(job_id, repetitions), ...] in
+        claim order — enough to build the claimed docs without re-reading
+        each record under a fresh flock. Preferred ids are tried first;
+        ``steal=False`` restricts the scan to them, exactly like the
+        single claim (which is this with k=1)."""
+        if k <= 0 or not os.path.exists(self.path):
+            return []
         fd = self._open_locked()
         try:
-            count = self._read_count(fd)
+            recs = self._read_all(fd)
+            count = len(recs)
+            out: List[Tuple[int, int]] = []
+            taken = set()
 
-            def try_id(jid: int) -> bool:
-                status, reps, w, st, rv = self._read_rec(fd, jid)
+            def try_id(jid: int) -> None:
+                status, reps = recs[jid][0], recs[jid][1]
                 if (1 << status) & _CLAIM_MASK:
-                    self._write_rec(fd, jid, Status.RUNNING, reps, worker, now)
-                    return True
-                return False
+                    # fresh claim: fresh silence clock AND fresh times
+                    # (a retry's record must not carry the dead
+                    # attempt's timing into the stats fold)
+                    self._write_rec(fd, jid, Status.RUNNING, reps, worker,
+                                    now)
+                    out.append((jid, reps))
+                    taken.add(jid)
 
             for jid in (preferred or ()):
-                if 0 <= jid < count and try_id(jid):
-                    return jid
+                if len(out) >= k:
+                    break
+                if 0 <= jid < count and jid not in taken:
+                    try_id(jid)
             if steal:
                 for jid in range(count):
-                    if try_id(jid):
-                        return jid
-            return -1
+                    if len(out) >= k:
+                        break
+                    if jid not in taken:
+                        try_id(jid)
+            return out
         finally:
             os.close(fd)
 
@@ -142,27 +205,111 @@ class PyJobIndex:
         try:
             if not (0 <= job_id < self._read_count(fd)):
                 return False
-            status, reps, w, st, rv = self._read_rec(fd, job_id)
+            rec = self._read_rec(fd, job_id)
+            status, reps, w = rec[0], rec[1], rec[2]
             if expect_mask and not ((1 << status) & expect_mask):
                 return False
             if expect_worker and w != expect_worker:
                 return False
             if to == Status.BROKEN:
                 reps += 1
-            self._write_rec(fd, job_id, int(to), reps, w, st, rv)
+            self._write_rec(fd, job_id, int(to), reps, w, rec[3], rec[4],
+                            rec[5:])
             return True
         finally:
             os.close(fd)
 
-    def get(self, job_id: int) -> Optional[Tuple[int, int, int, float]]:
+    def cas_status_batch(self, ids: Sequence[int], to: Status,
+                         expect_mask: int = 0,
+                         expect_worker: int = 0) -> List[bool]:
+        """:meth:`cas_status` over many records under ONE flock — the
+        batch-commit amortization (a k-job batch retires in one locked
+        pass instead of k lock/IO round trips). Per-id success flags in
+        input order; each id's CAS is judged independently, so one lost
+        claim never blocks the rest of the batch."""
+        out = [False] * len(ids)
+        if not ids or not os.path.exists(self.path):
+            return out
+        fd = self._open_locked()
+        try:
+            count = self._read_count(fd)
+            for i, job_id in enumerate(ids):
+                if not (0 <= job_id < count):
+                    continue
+                rec = self._read_rec(fd, job_id)
+                status, reps, w = rec[0], rec[1], rec[2]
+                if expect_mask and not ((1 << status) & expect_mask):
+                    continue
+                if expect_worker and w != expect_worker:
+                    continue
+                if to == Status.BROKEN:
+                    reps += 1
+                self._write_rec(fd, job_id, int(to), reps, w, rec[3],
+                                rec[4], rec[5:])
+                out[i] = True
+            return out
+        finally:
+            os.close(fd)
+
+    def commit_batch(self, entries: Sequence[tuple],
+                     worker: int) -> List[bool]:
+        """Retire a batch in ONE flock cycle: for each ``(job_id,
+        times5)`` entry, iff the record is RUNNING|FINISHED and ``worker``
+        owns the claim, write the job times INTO the record and flip it
+        WRITTEN. The v1 protocol spent two status CASes plus a times-
+        sidecar rename per job here; embedding times in the record
+        (JSIX0002) folds all three into this one locked pass. Per-entry
+        success flags in input order."""
+        out = [False] * len(entries)
+        if not entries or not os.path.exists(self.path):
+            return out
+        commit_mask = (1 << Status.RUNNING) | (1 << Status.FINISHED)
+        fd = self._open_locked()
+        try:
+            count = self._read_count(fd)
+            for i, (job_id, times) in enumerate(entries):
+                if not (0 <= job_id < count):
+                    continue
+                rec = self._read_rec(fd, job_id)
+                status, reps, w = rec[0], rec[1], rec[2]
+                if not ((1 << status) & commit_mask):
+                    continue
+                if worker and w != worker:
+                    continue
+                self._write_rec(fd, job_id, Status.WRITTEN, reps, w,
+                                rec[3], rec[4], times or _ZERO_TIMES)
+                out[i] = True
+            return out
+        finally:
+            os.close(fd)
+
+    def set_times(self, job_id: int, times: Sequence[float]) -> bool:
+        """Record a job's times without touching its status (the single-
+        job set_job_times path; commit_batch is the amortized route)."""
+        if not os.path.exists(self.path):
+            return False
+        fd = self._open_locked()
+        try:
+            if not (0 <= job_id < self._read_count(fd)):
+                return False
+            rec = self._read_rec(fd, job_id)
+            self._write_rec(fd, job_id, rec[0], rec[1], rec[2], rec[3],
+                            rec[4], times)
+            return True
+        finally:
+            os.close(fd)
+
+    def get(self, job_id: int) -> Optional[tuple]:
+        """(status, reps, worker, started, times5 | None) or None when
+        missing/out of bounds."""
         if not os.path.exists(self.path):
             return None
         fd = self._open_locked()
         try:
             if not (0 <= job_id < self._read_count(fd)):
                 return None
-            status, reps, w, st, _ = self._read_rec(fd, job_id)
-            return status, reps, w, st
+            rec = self._read_rec(fd, job_id)
+            return rec[0], rec[1], rec[2], rec[3], self._times_of(rec)
         finally:
             os.close(fd)
 
@@ -172,8 +319,7 @@ class PyJobIndex:
             return out
         fd = self._open_locked()
         try:
-            for jid in range(self._read_count(fd)):
-                status, *_ = self._read_rec(fd, jid)
+            for status, *_ in self._read_all(fd):
                 out[Status(status)] += 1
             return out
         finally:
@@ -185,10 +331,11 @@ class PyJobIndex:
         fd = self._open_locked()
         try:
             n = 0
-            for jid in range(self._read_count(fd)):
-                status, reps, w, st, rv = self._read_rec(fd, jid)
+            for jid, rec in enumerate(self._read_all(fd)):
+                status, reps = rec[0], rec[1]
                 if status == Status.BROKEN and reps >= max_retries:
-                    self._write_rec(fd, jid, Status.FAILED, reps, w, st, rv)
+                    self._write_rec(fd, jid, Status.FAILED, reps, rec[2],
+                                    rec[3], rec[4], rec[5:])
                     n += 1
             return n
         finally:
@@ -205,11 +352,12 @@ class PyJobIndex:
         fd = self._open_locked()
         try:
             n = 0
-            for jid in range(self._read_count(fd)):
-                status, reps, w, st, hb = self._read_rec(fd, jid)
+            for jid, rec in enumerate(self._read_all(fd)):
+                status, reps, w, st, hb = rec[:5]
                 if (status in (Status.RUNNING, Status.FINISHED) and
                         max(st, hb) < cutoff):
-                    self._write_rec(fd, jid, Status.BROKEN, reps + 1, w, st, hb)
+                    self._write_rec(fd, jid, Status.BROKEN, reps + 1, w,
+                                    st, hb, rec[5:])
                     n += 1
             return n
         finally:
@@ -224,24 +372,53 @@ class PyJobIndex:
         try:
             if not (0 <= job_id < self._read_count(fd)):
                 return False
-            status, reps, w, st, _ = self._read_rec(fd, job_id)
+            rec = self._read_rec(fd, job_id)
+            status, reps, w, st = rec[:4]
             if status not in (Status.RUNNING, Status.FINISHED):
                 return False
             if worker and w != worker:
                 return False
-            self._write_rec(fd, job_id, status, reps, w, st, now)
+            self._write_rec(fd, job_id, status, reps, w, st, now, rec[5:])
             return True
         finally:
             os.close(fd)
 
-    def snapshot(self) -> List[Tuple[int, int, int, float]]:
-        """All records (status, reps, worker, started) in one locked pass —
-        the bulk-stats read path (avoids one flock per job)."""
+    def heartbeat_batch(self, ids: Sequence[int], worker: int,
+                        now: float) -> int:
+        """:meth:`heartbeat` over many records under ONE flock — the
+        batch lease's single heartbeat thread beats every leased job in
+        one lock cycle. Returns how many beats landed."""
+        if not ids or not os.path.exists(self.path):
+            return 0
+        fd = self._open_locked()
+        try:
+            count = self._read_count(fd)
+            n = 0
+            for job_id in ids:
+                if not (0 <= job_id < count):
+                    continue
+                rec = self._read_rec(fd, job_id)
+                status, reps, w, st = rec[:4]
+                if status not in (Status.RUNNING, Status.FINISHED):
+                    continue
+                if worker and w != worker:
+                    continue
+                self._write_rec(fd, job_id, status, reps, w, st, now,
+                                rec[5:])
+                n += 1
+            return n
+        finally:
+            os.close(fd)
+
+    def snapshot(self) -> List[tuple]:
+        """All records (status, reps, worker, started, times5 | None) in
+        one locked pass over one bulk read — the stats/jobs() read path
+        (v1 additionally opened one times-sidecar JSON per job here)."""
         if not os.path.exists(self.path):
             return []
         fd = self._open_locked()
         try:
-            return [self._read_rec(fd, jid)[:4]
-                    for jid in range(self._read_count(fd))]
+            return [rec[:4] + (self._times_of(rec),)
+                    for rec in self._read_all(fd)]
         finally:
             os.close(fd)

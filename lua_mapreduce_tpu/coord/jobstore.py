@@ -32,6 +32,34 @@ class JobStore(abc.ABC):
     """Coordination-plane interface (control plane only — bulk data goes
     through the storage layer, never through the job store)."""
 
+    # -- control-plane round-trip accounting -------------------------------
+    #
+    # Each claim/commit lock-or-IO cycle through THIS instance bumps a
+    # counter: "claim" for claim()/claim_batch() passes, "commit" for
+    # status/times writes. In-process pools share one instance, so the
+    # server's IterationStats fold sees the whole pool's control traffic;
+    # in multi-process pools each process counts its own (coord_bench
+    # aggregates the workers' counters explicitly). A single class-level
+    # lock guards lazy creation AND the read-modify-write — shared worker
+    # threads must not lose increments of the protocol's effectiveness
+    # metric (contention is negligible: one tiny bump per store round
+    # trip that itself does real IO).
+
+    _rounds_lock = threading.Lock()
+
+    def _bump(self, op: str, n: int = 1) -> None:
+        with JobStore._rounds_lock:
+            r = getattr(self, "rounds", None)
+            if r is None:
+                r = self.rounds = {"claim": 0, "commit": 0}
+            r[op] = r.get(op, 0) + n
+
+    def round_counts(self) -> Dict[str, int]:
+        """Snapshot of {"claim": ..., "commit": ...} round trips so far."""
+        with JobStore._rounds_lock:
+            return dict(getattr(self, "rounds", None) or
+                        {"claim": 0, "commit": 0})
+
     # -- task singleton (orchestrator checkpoint, task.lua:96-116) ---------
 
     @abc.abstractmethod
@@ -67,6 +95,88 @@ class JobStore(abc.ABC):
         Returns the full job doc (with ``_id``, ``status``, ``repetitions``)
         or None if nothing is claimable.
         """
+
+    def claim_batch(self, ns: str, worker: str, k: int = 1,
+                    preferred_ids: Optional[Sequence[int]] = None,
+                    steal: bool = True) -> List[dict]:
+        """Atomically claim up to ``k`` WAITING|BROKEN jobs → RUNNING for
+        ``worker`` — the batch-lease entry point. One job's claim is one
+        control-plane round trip; at the ~2,000-tiny-jobs fan-in the
+        reference README targets, those round trips dominate wall time
+        once PR 1 pipelined the data plane. A batch claim leases k jobs
+        in ONE locked index pass; the worker executes them back-to-back
+        and retires them through :meth:`commit_batch`.
+
+        Semantics per job are identical to :meth:`claim` (preferred ids
+        first, ``steal=False`` restricts to them); every leased job gets
+        its own fresh liveness clock, so :meth:`requeue_stale` judges
+        each batch member independently — a SIGKILLed worker's whole
+        batch returns to the pool, job by job.
+
+        This default serves stores without a native batch path: k single
+        claims (correct, unamortized). Returns the claimed docs in claim
+        order; [] when nothing is claimable."""
+        out = []
+        for _ in range(max(1, k)):
+            doc = self.claim(ns, worker, preferred_ids, steal)
+            if doc is None:
+                break
+            out.append(doc)
+        return out
+
+    def commit_batch(self, ns: str, worker: str,
+                     entries: Sequence[tuple]) -> List[int]:
+        """Retire a batch of executed jobs: for each ``(job_id, times)``
+        entry, RUNNING→FINISHED→WRITTEN CASed on ``worker``'s ownership,
+        with the job times recorded between the two transitions (the
+        v1 per-job finish discipline, amortized). Entries
+        whose claim was lost (stale-requeued and re-claimed) are skipped
+        without disturbing the new claimant. Returns the job ids whose
+        commit landed.
+
+        This default loops the single-job protocol; batch-native stores
+        override to do each transition sweep in one locked pass."""
+        done = []
+        for job_id, times in entries:
+            if not self.set_job_status(ns, job_id, Status.FINISHED,
+                                       expect=(Status.RUNNING,),
+                                       expect_worker=worker):
+                continue
+            if times is not None:
+                self.set_job_times(ns, job_id, times)
+            self.set_job_status(ns, job_id, Status.WRITTEN,
+                                expect=(Status.FINISHED,),
+                                expect_worker=worker)
+            done.append(job_id)
+        return done
+
+    def release_batch(self, ns: str, worker: str,
+                      job_ids: Sequence[int]) -> int:
+        """Return leased-but-unstarted jobs to the pool: RUNNING→WAITING
+        CASed on ownership, WITHOUT bumping repetitions — these jobs
+        never ran, so they must not creep toward the scavenger's FAILED
+        threshold. Used when a batch aborts partway (user-code error);
+        a SIGKILLed worker never gets to call this, which is fine — the
+        stale requeue recovers its leases as BROKEN instead. Returns how
+        many were released."""
+        n = 0
+        for job_id in job_ids:
+            if self.set_job_status(ns, job_id, Status.WAITING,
+                                   expect=(Status.RUNNING,),
+                                   expect_worker=worker):
+                n += 1
+        return n
+
+    def heartbeat_batch(self, ns: str, job_ids: Sequence[int],
+                        worker: str) -> int:
+        """:meth:`heartbeat` for every leased job of a batch — the batch
+        lease runs ONE beat thread for all its jobs. Returns how many
+        beats landed (jobs already committed/requeued simply miss)."""
+        n = 0
+        for job_id in job_ids:
+            if self.heartbeat(ns, job_id, worker):
+                n += 1
+        return n
 
     @abc.abstractmethod
     def set_job_status(self, ns: str, job_id: int, status: Status,
@@ -207,32 +317,72 @@ class MemJobStore(JobStore):
             return ids
 
     def claim(self, ns, worker, preferred_ids=None, steal=True):
+        got = self.claim_batch(ns, worker, 1, preferred_ids, steal)
+        return got[0] if got else None
+
+    def claim_batch(self, ns, worker, k=1, preferred_ids=None, steal=True):
+        self._bump("claim")
         with self._lock:
             queue = self._jobs.get(ns, [])
+            out = []
 
             def try_claim(d):
-                if d["status"] in CLAIMABLE:
+                if d["status"] in CLAIMABLE and len(out) < k:
                     d["status"] = Status.RUNNING
                     d["worker"] = worker
                     d["started_time"] = time.time()
                     d["hb_time"] = None   # fresh claim, fresh silence clock
-                    return dict(d)
-                return None
+                    out.append(dict(d))
 
             for jid in (preferred_ids or ()):
                 if 0 <= jid < len(queue):
-                    got = try_claim(queue[jid])
-                    if got:
-                        return got
+                    try_claim(queue[jid])
             if steal:
                 for d in queue:
-                    got = try_claim(d)
-                    if got:
-                        return got
-            return None
+                    if len(out) >= k:
+                        break
+                    try_claim(d)
+            return out
+
+    def commit_batch(self, ns, worker, entries):
+        self._bump("commit")
+        with self._lock:
+            queue = self._jobs.get(ns, [])
+            done = []
+            for job_id, times in entries:
+                if not (0 <= job_id < len(queue)):
+                    continue
+                d = queue[job_id]
+                # RUNNING|FINISHED, matching the index engines: a job a
+                # crashed commit left FINISHED must retire, not wait for
+                # the stale requeue to re-execute completed work
+                if (d["status"] not in (Status.RUNNING, Status.FINISHED)
+                        or d["worker"] != worker):
+                    continue       # claim lost: the new claimant owns it
+                if times is not None:
+                    d["times"] = dict(times)
+                d["status"] = Status.WRITTEN
+                done.append(job_id)
+            return done
+
+    def heartbeat_batch(self, ns, job_ids, worker):
+        with self._lock:
+            queue = self._jobs.get(ns, [])
+            n = 0
+            now = time.time()
+            for job_id in job_ids:
+                if not (0 <= job_id < len(queue)):
+                    continue
+                d = queue[job_id]
+                if d["status"] in (Status.RUNNING, Status.FINISHED) \
+                        and d["worker"] == worker:
+                    d["hb_time"] = now
+                    n += 1
+            return n
 
     def set_job_status(self, ns, job_id, status, expect=None,
                        expect_worker=None):
+        self._bump("commit")
         with self._lock:
             queue = self._jobs.get(ns, [])
             if not (0 <= job_id < len(queue)):
@@ -257,6 +407,7 @@ class MemJobStore(JobStore):
             return [dict(d) for d in self._jobs.get(ns, [])]
 
     def set_job_times(self, ns, job_id, times):
+        self._bump("commit")
         with self._lock:
             queue = self._jobs.get(ns)
             if queue is not None and 0 <= job_id < len(queue):
@@ -360,3 +511,14 @@ def utest() -> None:
     c = s.counts("map_jobs")
     assert c[Status.WRITTEN] == 1 and c[Status.WAITING] == 2
     assert len(s.job_workers("map_jobs")) == 1
+
+    # batch lease: claim the remaining two in one pass, commit in one pass
+    batch = s.claim_batch("map_jobs", "w2", k=5)
+    assert [d["_id"] for d in batch] == [1, 2]
+    assert all(d["status"] == Status.RUNNING for d in batch)
+    t = {"started": 0.0, "finished": 0.0, "written": 0.0, "cpu": 0.0,
+         "real": 0.0}
+    assert s.commit_batch("map_jobs", "w2",
+                          [(1, t), (2, t)]) == [1, 2]
+    assert s.counts("map_jobs")[Status.WRITTEN] == 3
+    assert s.round_counts()["claim"] >= 2

@@ -13,10 +13,16 @@
 // is what makes worker failure recovery sound with no lease machinery.
 //
 // Layout (little-endian, matching idx_py.py):
-//   header: char magic[8] = "JSIX0001"; int64 count;
+//   header: char magic[8] = "JSIX0002"; int64 count;
 //   record: int32 status; int32 repetitions; int64 worker; double started;
-//           double reserved;   // 32 bytes; reserved = last heartbeat
-//                              // time (0.0 = never beaten)
+//           double reserved;   // reserved = last heartbeat time
+//                              // (0.0 = never beaten)
+//           double times[5];   // job times (started, finished, written,
+//                              // cpu, real); all-zero = not recorded.
+//                              // 72 bytes total. JSIX0002 embeds the
+//                              // times so a batch commit retires status
+//                              // AND timing in one flock cycle (the v1
+//                              // sidecar was a tempfile+rename per job).
 
 #include <cstdint>
 #include <cstring>
@@ -25,12 +31,14 @@
 #include <sys/file.h>
 #include <sys/stat.h>
 #include <unistd.h>
+#include <vector>
 
 namespace {
 
-constexpr char kMagic[8] = {'J', 'S', 'I', 'X', '0', '0', '0', '1'};
+constexpr char kMagic[8] = {'J', 'S', 'I', 'X', '0', '0', '0', '2'};
 constexpr int64_t kHeaderSize = 16;
-constexpr int64_t kRecordSize = 32;
+constexpr int64_t kRecordSize = 72;
+constexpr int kNTimes = 5;
 
 // Status values mirror core/constants.py (reference utils.lua:33-40).
 enum Status : int32_t {
@@ -55,6 +63,7 @@ struct Record {
   int64_t worker;
   double started;
   double reserved;
+  double times[kNTimes];
 };
 #pragma pack(pop)
 
@@ -99,6 +108,19 @@ class LockedIndex {
            (ssize_t)sizeof rec;
   }
 
+  // One bulk pread of every record — scan-shaped operations (claim,
+  // counts, snapshot, scavenge, requeue) pay ONE IO round trip under the
+  // flock instead of one pread per record; mutated records are written
+  // back individually (few per pass).
+  bool read_all(std::vector<Record>* out) const {
+    const int64_t n = count();
+    if (n < 0) return false;
+    out->resize((size_t)n);
+    if (n == 0) return true;
+    const ssize_t want = (ssize_t)(n * kRecordSize);
+    return pread(fd_, out->data(), want, kHeaderSize) == want;
+  }
+
  private:
   int fd_;
 };
@@ -113,13 +135,18 @@ double now_seconds() {
 
 extern "C" {
 
+int64_t jsx_claim_batch(const char* path, int64_t worker,
+                        const int64_t* preferred, int64_t n_preferred,
+                        int32_t steal, int64_t* out_ids, int32_t* out_reps,
+                        int64_t k);
+
 // Append n WAITING records; returns first new id, or -1 on error.
 int64_t jsx_insert(const char* path, int64_t n) {
   LockedIndex idx(path, /*create=*/true);
   if (!idx.ok()) return -1;
   int64_t count = idx.count();  // 0 for a freshly created empty file
   if (count < 0) return -1;
-  Record rec{kWaiting, 0, 0, 0.0, 0.0};
+  Record rec{kWaiting, 0, 0, 0.0, 0.0, {}};
   for (int64_t i = 0; i < n; ++i) {
     if (!idx.write(count + i, rec)) return -1;
   }
@@ -137,36 +164,63 @@ int64_t jsx_count(const char* path) {
 
 // Claim first WAITING|BROKEN record for worker (preferred ids first; when
 // steal == 0 only the preferred ids are considered — map-affinity mode).
-// Returns claimed id or -1.
+// Returns claimed id or -1. Thin wrapper over the batch path (k = 1), so
+// both share the one-bulk-read scan.
 int64_t jsx_claim(const char* path, int64_t worker, const int64_t* preferred,
                   int64_t n_preferred, int32_t steal) {
-  if (access(path, F_OK) != 0) return -1;
+  int64_t id = -1;
+  int32_t reps = 0;
+  const int64_t n = jsx_claim_batch(path, worker, preferred, n_preferred,
+                                    steal, &id, &reps, 1);
+  return n == 1 ? id : -1;
+}
+
+// Claim up to k WAITING|BROKEN records for worker in ONE locked pass (the
+// batch-lease amortization of jsx_claim). Fills out_ids/out_reps with the
+// claimed ids and their pre-claim repetition counts; returns how many were
+// claimed (0 when nothing is claimable), or -1 on error. Preferred ids are
+// tried first; steal == 0 restricts the scan to them.
+int64_t jsx_claim_batch(const char* path, int64_t worker,
+                        const int64_t* preferred, int64_t n_preferred,
+                        int32_t steal, int64_t* out_ids, int32_t* out_reps,
+                        int64_t k) {
+  if (k <= 0) return 0;
+  if (access(path, F_OK) != 0) return 0;
   LockedIndex idx(path, false);
   if (!idx.ok()) return -1;
-  const int64_t count = idx.count();
-  if (count <= 0) return -1;
+  std::vector<Record> recs;
+  if (!idx.read_all(&recs)) return -1;
+  const int64_t count = (int64_t)recs.size();
+  if (count <= 0) return 0;
+  const double now = now_seconds();
+  int64_t taken = 0;
 
+  // scan in memory; a claimed record's in-buffer status flips to RUNNING,
+  // which also makes it unclaimable again this pass (a preferred id later
+  // reachable by the steal scan)
   auto try_id = [&](int64_t id) -> bool {
-    Record rec;
-    if (!idx.read(id, &rec)) return false;
+    Record& rec = recs[(size_t)id];
     if (!((1u << rec.status) & kClaimMask)) return false;
+    out_ids[taken] = id;
+    out_reps[taken] = rec.repetitions;
     rec.status = kRunning;
     rec.worker = worker;
-    rec.started = now_seconds();
-    rec.reserved = 0.0;  // fresh claim, fresh silence clock (= idx_py)
-    return idx.write(id, rec);
+    rec.started = now;
+    rec.reserved = 0.0;  // fresh claim: fresh silence clock AND fresh
+    for (int t = 0; t < kNTimes; ++t) rec.times[t] = 0.0;  // times
+    if (!idx.write(id, rec)) return false;
+    ++taken;
+    return true;
   };
 
-  for (int64_t i = 0; i < n_preferred; ++i) {
+  for (int64_t i = 0; i < n_preferred && taken < k; ++i) {
     const int64_t id = preferred[i];
-    if (id >= 0 && id < count && try_id(id)) return id;
+    if (id >= 0 && id < count) try_id(id);
   }
   if (steal) {
-    for (int64_t id = 0; id < count; ++id) {
-      if (try_id(id)) return id;
-    }
+    for (int64_t id = 0; id < count && taken < k; ++id) try_id(id);
   }
-  return -1;
+  return taken;
 }
 
 // CAS status; expect_mask is a bitmask of (1<<status), 0 = unconditional;
@@ -190,9 +244,87 @@ int jsx_cas_status(const char* path, int64_t id, int32_t to,
   return idx.write(id, rec) ? 1 : -1;
 }
 
-// Read one record. Returns 1 on success, 0 if out of bounds, -1 on error.
+// jsx_cas_status over n ids under ONE flock — the batch-commit
+// amortization. ok_out[i] = 1 where the CAS landed; each id is judged
+// independently (one lost claim never blocks the rest of the batch).
+// Returns how many landed, or -1 on IO error.
+int64_t jsx_cas_status_batch(const char* path, const int64_t* ids, int64_t n,
+                             int32_t to, uint32_t expect_mask,
+                             int64_t expect_worker, int32_t* ok_out) {
+  for (int64_t i = 0; i < n; ++i) ok_out[i] = 0;
+  if (n <= 0) return 0;
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  const int64_t count = idx.count();
+  int64_t landed = 0;
+  Record rec;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t id = ids[i];
+    if (id < 0 || id >= count) continue;
+    if (!idx.read(id, &rec)) return -1;
+    if (expect_mask && !((1u << rec.status) & expect_mask)) continue;
+    if (expect_worker != 0 && rec.worker != expect_worker) continue;
+    if (to == kBroken) rec.repetitions += 1;
+    rec.status = to;
+    if (!idx.write(id, rec)) return -1;
+    ok_out[i] = 1;
+    ++landed;
+  }
+  return landed;
+}
+
+// Retire a batch in ONE flock cycle: for each id, iff the record is
+// RUNNING|FINISHED and `worker` owns the claim (0 = skip the check),
+// write its 5 job times (times + i*5) into the record and flip it
+// WRITTEN. ok_out[i] = 1 where the commit landed. Returns how many
+// landed, or -1 on IO error. The v1 protocol spent two status CASes plus
+// a times-sidecar rename per job here.
+int64_t jsx_commit_batch(const char* path, const int64_t* ids, int64_t n,
+                         int64_t worker, const double* times,
+                         int32_t* ok_out) {
+  for (int64_t i = 0; i < n; ++i) ok_out[i] = 0;
+  if (n <= 0) return 0;
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  const int64_t count = idx.count();
+  int64_t landed = 0;
+  Record rec;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t id = ids[i];
+    if (id < 0 || id >= count) continue;
+    if (!idx.read(id, &rec)) return -1;
+    if (rec.status != kRunning && rec.status != kFinished) continue;
+    if (worker != 0 && rec.worker != worker) continue;
+    rec.status = kWritten;
+    for (int t = 0; t < kNTimes; ++t) rec.times[t] = times[i * kNTimes + t];
+    if (!idx.write(id, rec)) return -1;
+    ok_out[i] = 1;
+    ++landed;
+  }
+  return landed;
+}
+
+// Record a job's times without touching its status (the single-job
+// set_job_times path). Returns 1 on success, 0 on bounds/missing, -1 on
+// IO error.
+int jsx_set_times(const char* path, int64_t id, const double* times5) {
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  if (id < 0 || id >= idx.count()) return 0;
+  Record rec;
+  if (!idx.read(id, &rec)) return -1;
+  for (int t = 0; t < kNTimes; ++t) rec.times[t] = times5[t];
+  return idx.write(id, rec) ? 1 : -1;
+}
+
+// Read one record (times5 gets the 5 job times; all-zero = none
+// recorded). Returns 1 on success, 0 if out of bounds, -1 on error.
 int jsx_get(const char* path, int64_t id, int32_t* status,
-            int32_t* repetitions, int64_t* worker, double* started) {
+            int32_t* repetitions, int64_t* worker, double* started,
+            double* times5) {
   if (access(path, F_OK) != 0) return 0;
   LockedIndex idx(path, false);
   if (!idx.ok()) return -1;
@@ -203,6 +335,7 @@ int jsx_get(const char* path, int64_t id, int32_t* status,
   *repetitions = rec.repetitions;
   *worker = rec.worker;
   *started = rec.started;
+  for (int t = 0; t < kNTimes; ++t) times5[t] = rec.times[t];
   return 1;
 }
 
@@ -212,13 +345,12 @@ int64_t jsx_counts(const char* path, int64_t* out6) {
   if (access(path, F_OK) != 0) return 0;
   LockedIndex idx(path, false);
   if (!idx.ok()) return -1;
-  const int64_t count = idx.count();
-  Record rec;
-  for (int64_t id = 0; id < count; ++id) {
-    if (!idx.read(id, &rec)) return -1;
+  std::vector<Record> recs;
+  if (!idx.read_all(&recs)) return -1;
+  for (const Record& rec : recs) {
     if (rec.status >= 0 && rec.status < 6) out6[rec.status] += 1;
   }
-  return count;
+  return (int64_t)recs.size();
 }
 
 // RUNNING|FINISHED records whose last liveness signal — claim time or
@@ -231,11 +363,11 @@ int64_t jsx_requeue_stale(const char* path, double cutoff) {
   if (access(path, F_OK) != 0) return 0;
   LockedIndex idx(path, false);
   if (!idx.ok()) return -1;
-  const int64_t count = idx.count();
+  std::vector<Record> recs;
+  if (!idx.read_all(&recs)) return -1;
   int64_t n = 0;
-  Record rec;
-  for (int64_t id = 0; id < count; ++id) {
-    if (!idx.read(id, &rec)) return -1;
+  for (int64_t id = 0; id < (int64_t)recs.size(); ++id) {
+    Record& rec = recs[(size_t)id];
     const double live =
         rec.reserved > rec.started ? rec.reserved : rec.started;
     if ((rec.status == kRunning || rec.status == kFinished) &&
@@ -267,22 +399,51 @@ int jsx_heartbeat(const char* path, int64_t id, int64_t worker, double now) {
   return idx.write(id, rec) ? 1 : -1;
 }
 
-// Bulk snapshot: fill caller arrays (capacity cap) with every record's
-// state in one locked pass. Returns the number filled, or -1 on error.
-int64_t jsx_snapshot(const char* path, int32_t* statuses, int32_t* reps,
-                     int64_t* workers, double* started, int64_t cap) {
+// jsx_heartbeat over n ids under ONE flock — the batch lease's single
+// heartbeat thread beats every leased job in one lock cycle. Returns how
+// many beats landed, or -1 on IO error.
+int64_t jsx_heartbeat_batch(const char* path, const int64_t* ids, int64_t n,
+                            int64_t worker, double now) {
+  if (n <= 0) return 0;
   if (access(path, F_OK) != 0) return 0;
   LockedIndex idx(path, false);
   if (!idx.ok()) return -1;
-  int64_t count = idx.count();
-  if (count > cap) count = cap;
+  const int64_t count = idx.count();
+  int64_t landed = 0;
   Record rec;
-  for (int64_t id = 0; id < count; ++id) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t id = ids[i];
+    if (id < 0 || id >= count) continue;
     if (!idx.read(id, &rec)) return -1;
+    if (rec.status != kRunning && rec.status != kFinished) continue;
+    if (worker != 0 && rec.worker != worker) continue;
+    rec.reserved = now;
+    if (!idx.write(id, rec)) return -1;
+    ++landed;
+  }
+  return landed;
+}
+
+// Bulk snapshot: fill caller arrays (capacity cap) with every record's
+// state in one locked pass. Returns the number filled, or -1 on error.
+int64_t jsx_snapshot(const char* path, int32_t* statuses, int32_t* reps,
+                     int64_t* workers, double* started, double* times,
+                     int64_t cap) {
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  std::vector<Record> recs;
+  if (!idx.read_all(&recs)) return -1;
+  int64_t count = (int64_t)recs.size();
+  if (count > cap) count = cap;
+  for (int64_t id = 0; id < count; ++id) {
+    const Record& rec = recs[(size_t)id];
     statuses[id] = rec.status;
     reps[id] = rec.repetitions;
     workers[id] = rec.worker;
     started[id] = rec.started;
+    for (int t = 0; t < kNTimes; ++t)
+      times[id * kNTimes + t] = rec.times[t];
   }
   return count;
 }
@@ -292,11 +453,11 @@ int64_t jsx_scavenge(const char* path, int32_t max_retries) {
   if (access(path, F_OK) != 0) return 0;
   LockedIndex idx(path, false);
   if (!idx.ok()) return -1;
-  const int64_t count = idx.count();
+  std::vector<Record> recs;
+  if (!idx.read_all(&recs)) return -1;
   int64_t n = 0;
-  Record rec;
-  for (int64_t id = 0; id < count; ++id) {
-    if (!idx.read(id, &rec)) return -1;
+  for (int64_t id = 0; id < (int64_t)recs.size(); ++id) {
+    Record& rec = recs[(size_t)id];
     if (rec.status == kBroken && rec.repetitions >= max_retries) {
       rec.status = kFailed;
       if (!idx.write(id, rec)) return -1;

@@ -114,13 +114,21 @@ class LocalExecutor:
 
     def __init__(self, spec: TaskSpec, map_parallelism: int = 1,
                  max_iterations: int = 1000, pipeline: bool = False,
-                 premerge_min_runs: int = 4, premerge_max_runs: int = 8):
+                 premerge_min_runs: int = 4, premerge_max_runs: int = 8,
+                 batch_k: int = 1):
         self.spec = spec
         self.map_parallelism = max(1, map_parallelism)
         self.max_iterations = max_iterations
         self.pipeline = pipeline
         self.premerge_min_runs = premerge_min_runs
         self.premerge_max_runs = premerge_max_runs
+        # API parity with the distributed engine's batch-lease knob
+        # (Server/Worker batch_k). In-process there is no control plane
+        # to amortize — the analog is executor overhead: batch_k > 1
+        # submits barrier-path jobs to the thread pool in chunks of k
+        # executed back-to-back, one future per lease instead of per
+        # job. Semantics (and output bytes) are identical either way.
+        self.batch_k = max(1, int(batch_k))
         self.store = get_storage_from(spec.storage)
         self.result_store = (get_storage_from(spec.result_storage)
                              if spec.result_storage else self.store)
@@ -130,8 +138,13 @@ class LocalExecutor:
     def _run_jobs(self, fns) -> List[JobTimes]:
         if self.map_parallelism == 1 or len(fns) <= 1:
             return [fn() for fn in fns]
+        k = self.batch_k
         with ThreadPoolExecutor(max_workers=self.map_parallelism) as pool:
-            return list(pool.map(lambda fn: fn(), fns))
+            if k <= 1:
+                return list(pool.map(lambda fn: fn(), fns))
+            chunks = [fns[i:i + k] for i in range(0, len(fns), k)]
+            nested = pool.map(lambda chunk: [fn() for fn in chunk], chunks)
+            return [t for chunk_times in nested for t in chunk_times]
 
     def run_one_iteration(self, iteration: int) -> Any:
         """One map→shuffle→reduce→final cycle; returns finalfn's verdict."""

@@ -238,13 +238,44 @@ def discover_pipelined(store, result_ns: str,
     run_re = run_name_re(result_ns)
     items: Dict[int, List[Tuple]] = {}
     covered: Dict[int, List[Tuple[int, int]]] = {}
+    spills: Dict[int, List[Tuple[int, int, str]]] = {}
     for name in store.list(f"{result_ns}.P*.{SPILL_TAG}-*"):
         parsed = parse_spill_name(result_ns, name)
         if parsed is None:
             continue
         part, a, b = parsed
-        items.setdefault(part, []).append(((a, 0, name), name))
-        covered.setdefault(part, []).append((a, b))
+        spills.setdefault(part, []).append((a, b, name))
+    # overlapping spills: a zombie pre-merge worker surviving a server
+    # crash/restart can publish a range the restarted server also
+    # covered (its commit CAS fails, but the data-plane publish is not
+    # gated on it). A NESTED overlap keeps the widest spill — it carries
+    # a superset of the same runs' data — and sweeps the narrower; a
+    # STAGGERED overlap cannot be de-duplicated at file granularity
+    # (each spill uniquely holds some positions and duplicates others),
+    # so it fails loudly instead of silently double-counting.
+    for part, lst in spills.items():
+        accepted: List[Tuple[int, int, str]] = []
+        for a, b, name in sorted(lst, key=lambda t: (t[0], t[0] - t[1])):
+            box = next(((a0, b0, n0) for a0, b0, n0 in accepted
+                        if a <= b0 and a0 <= b), None)
+            if box is None:
+                accepted.append((a, b, name))
+                continue
+            a0, b0, n0 = box
+            if a0 <= a and b <= b0:       # nested: widest already kept
+                try:
+                    store.remove(name)    # duplicate data; sweep
+                except Exception:
+                    pass
+                continue
+            raise RuntimeError(
+                f"partition {part}: staggered overlapping spills "
+                f"{n0!r} ({a0}-{b0}) and {name!r} ({a}-{b}) — cannot "
+                "de-duplicate at file granularity; clear the stale "
+                "spill files and re-run the iteration")
+        for a, b, name in accepted:
+            items.setdefault(part, []).append(((a, 0, name), name))
+            covered.setdefault(part, []).append((a, b))
     for name in store.list(f"{result_ns}.P*.M*"):
         m = run_re.match(name)
         if not m:

@@ -84,7 +84,7 @@ class Server:
                  stale_timeout_s: Optional[float] = 600.0,
                  verbose: bool = False, strict: bool = False,
                  pipeline: bool = False, premerge_min_runs: int = 4,
-                 premerge_max_runs: int = 8):
+                 premerge_max_runs: int = 8, batch_k: int = 1):
         self.store = store
         self.poll_interval = poll_interval
         self.stale_timeout_s = stale_timeout_s
@@ -93,6 +93,14 @@ class Server:
         self.pipeline = pipeline
         self.premerge_min_runs = premerge_min_runs
         self.premerge_max_runs = premerge_max_runs
+        # fleet default for the batch-lease protocol (DESIGN §16): the
+        # value lands in the task document, and every worker whose own
+        # batch_k is unset follows it — one server-side knob switches a
+        # whole deployment to k-job claim leases. Workers still size the
+        # EFFECTIVE lease adaptively (long jobs degrade to k=1), and the
+        # stale-requeue treats each leased job independently, so the
+        # knob trades only round trips, never recoverability.
+        self.batch_k = max(1, int(batch_k))
         self.spec: Optional[TaskSpec] = None
         self.stats = TaskStats()
         self.finished_value: Any = None
@@ -174,7 +182,11 @@ class Server:
                 # on the doc marker, so a doc that predates it must not
                 # leave published pre_merge jobs unclaimable
                 self.pipeline = bool(task.get("pipeline", self.pipeline))
-                self.store.update_task({"pipeline": self.pipeline})
+                # batch_k is a perf knob with no crash-consistency tie
+                # to on-disk state (unlike the shuffle mode), so the
+                # resuming server's configuration wins over the doc's
+                self.store.update_task({"pipeline": self.pipeline,
+                                        "batch_k": self.batch_k})
                 if status == TaskStatus.REDUCE.value:
                     skip_map = True
         if self.spec is None:
@@ -188,6 +200,9 @@ class Server:
                 # workers gate their pre_jobs probe on this marker, so
                 # barrier deployments pay zero extra claim round-trips
                 "pipeline": self.pipeline,
+                # the fleet's default claim-lease size; workers with no
+                # explicit batch_k of their own follow this
+                "batch_k": self.batch_k,
                 "started": time.time(),
             })
 
@@ -198,6 +213,7 @@ class Server:
         while True:
             it_stats = IterationStats(iteration=iteration)
             it_t0 = time.time()
+            rounds0 = self.store.round_counts()
 
             if not skip_map:
                 delete_results(result_store, self.spec.result_ns)
@@ -229,6 +245,11 @@ class Server:
                 verdict = self.spec.finalfn(
                     iter_results(result_store, self.spec.result_ns))
 
+            # control-plane traffic seen through this store instance
+            # (the whole pool's, when the pool shares it in-process)
+            rounds1 = self.store.round_counts()
+            it_stats.claim_rounds = rounds1["claim"] - rounds0["claim"]
+            it_stats.commit_rounds = rounds1["commit"] - rounds0["commit"]
             it_stats.wall_time = time.time() - it_t0
             self.stats.iterations.append(it_stats)
             self.store.update_task({"stats": it_stats.as_dict()})
@@ -410,6 +431,12 @@ class Server:
                          if d["status"] in (Status.WRITTEN, Status.FAILED)
                          and d["_id"] not in seen_committed]
             if newly:
+                # ONE namespace listing for the whole poll, shared by
+                # every newly committed job: all storage backends
+                # enumerate the namespace and filter client-side
+                # (store/base.py fnmatch), so per-key "scoped" lists
+                # would multiply full enumerations by the commit burst
+                # size — and batch leases make bursts the normal case
                 runs_by_key: Dict[str, Dict[int, str]] = {}
                 for name in store.list(f"{ns}.P*.M*"):
                     m = run_re.match(name)

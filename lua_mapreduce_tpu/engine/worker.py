@@ -18,7 +18,7 @@ import threading
 import time
 import traceback
 import uuid
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from lua_mapreduce_tpu.core.constants import (DEFAULT_SLEEP, MAX_IDLE_COUNT,
                                               MAX_WORKER_RETRIES, Status,
@@ -35,7 +35,12 @@ PRE_NS = "pre_jobs"     # eager pre-merge jobs, published DURING the map
                         # phase by a pipelined server (engine/premerge.py)
 
 _CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases",
-                "heartbeat_s")
+                "heartbeat_s", "batch_k", "batch_lease_s")
+
+# EWMA smoothing for the observed per-job duration that drives adaptive
+# batch sizing (recent jobs dominate: a phase whose jobs suddenly get big
+# must shrink the next lease quickly)
+_DUR_ALPHA = 0.3
 
 
 class Worker:
@@ -64,10 +69,22 @@ class Worker:
         # map/reduce is never requeued out from under a live worker.
         # None/0 disables (staleness falls back to elapsed-since-claim).
         self.heartbeat_s = 60.0
+        # batch leases (DESIGN §16): claim up to batch_k jobs in one
+        # control-plane round trip and retire them in one commit. None =
+        # follow the task document's batch_k (the server-deployed
+        # default), so a fleet switches without reconfiguring workers;
+        # an explicit configure(batch_k=...) wins. The EFFECTIVE k
+        # adapts per namespace to the observed job duration: a lease
+        # should hold no more than ~batch_lease_s of work, so tiny jobs
+        # batch wide while long jobs degrade to k=1 and stay stealable.
+        self.batch_k = None
+        self.batch_lease_s = 5.0
+        self._dur_ewma: Dict[str, float] = {}   # ns -> smoothed real secs
         self._spec_cache: Dict[str, TaskSpec] = {}
         self._affinity: list = []       # map-job ids this worker ran before
         self._idle_count = 0
         self.jobs_executed = 0
+        self._jobs_at_start = 0         # execute()'s bounded-lifetime base
 
     def configure(self, **params) -> "Worker":
         """Set max_iter / max_sleep / max_tasks; unknown keys are rejected
@@ -99,11 +116,12 @@ class Worker:
             if "map" in self.phases:
                 preferred = self._affinity if iteration > 1 else None
                 steal = not preferred or self._idle_count >= MAX_IDLE_COUNT
-                job = self.store.claim(MAP_NS, self.name, preferred,
-                                       steal=steal)
-                if job is not None:
+                jobs = self.store.claim_batch(
+                    MAP_NS, self.name, self._effective_k(MAP_NS, task),
+                    preferred, steal=steal)
+                if jobs:
                     self._idle_count = 0
-                    self._execute_map(spec, job)
+                    self._execute_batch(spec, MAP_NS, jobs)
                     return "executed"
             # eager pre-merge rides INSIDE the map phase (pipelined
             # shuffle): reduce-side consolidation of committed runs, so
@@ -113,10 +131,11 @@ class Worker:
             # marker gates the probe: barrier-mode tasks never pay the
             # extra pre_jobs claim round-trip per idle poll
             if "reduce" in self.phases and task.get("pipeline"):
-                job = self.store.claim(PRE_NS, self.name)
-                if job is not None:
+                jobs = self.store.claim_batch(
+                    PRE_NS, self.name, self._effective_k(PRE_NS, task))
+                if jobs:
                     self._idle_count = 0
-                    self._execute_premerge(spec, job)
+                    self._execute_batch(spec, PRE_NS, jobs)
                     return "executed"
             if "map" not in self.phases:
                 return "out-of-phase"
@@ -126,23 +145,59 @@ class Worker:
         if task["status"] == TaskStatus.REDUCE.value:
             if "reduce" not in self.phases:
                 return "out-of-phase"
-            job = self.store.claim(RED_NS, self.name)
-            if job is None:
+            jobs = self.store.claim_batch(
+                RED_NS, self.name, self._effective_k(RED_NS, task))
+            if not jobs:
                 return "idle"
-            self._execute_reduce(spec, job)
+            self._execute_batch(spec, RED_NS, jobs)
             return "executed"
 
         raise RuntimeError(f"unknown task status {task['status']!r}")
 
+    # -- batch-lease sizing --------------------------------------------------
+
+    def _effective_k(self, ns: str, task: dict) -> int:
+        """How many jobs the next lease should hold. The cap is this
+        worker's ``batch_k`` (or, when unset, the task document's — the
+        server-deployed fleet default); within the cap, size from the
+        observed per-job duration so one lease holds at most about
+        ``batch_lease_s`` of work. Long jobs therefore degrade to k=1
+        (a straggler's siblings stay claimable/stealable by idle
+        workers), an unknown duration probes with k=1 first, and a
+        bounded-lifetime worker never leases past its remaining job
+        budget (it could not execute what it holds)."""
+        cap = self.batch_k
+        if cap is None:
+            cap = int(task.get("batch_k") or 1)
+        if self.max_jobs is not None:
+            cap = min(cap, self.max_jobs - self.jobs_executed
+                      + self._jobs_at_start)
+        if cap <= 1:
+            return max(1, cap)
+        dur = self._dur_ewma.get(ns)
+        if dur is None:
+            return 1                    # first job calibrates the EWMA
+        if dur <= 0:
+            return cap
+        return max(1, min(cap, int(self.batch_lease_s / dur)))
+
+    def _note_duration(self, ns: str, real_s: float) -> None:
+        prev = self._dur_ewma.get(ns)
+        self._dur_ewma[ns] = (real_s if prev is None else
+                              _DUR_ALPHA * real_s + (1 - _DUR_ALPHA) * prev)
+
     # -- job execution ------------------------------------------------------
 
     @contextlib.contextmanager
-    def _beating(self, ns: str, jid: int):
-        """Heartbeat the claimed job every ``heartbeat_s`` seconds from a
-        daemon thread while the (blocking, user-code) job body runs. Best
+    def _beating(self, ns: str, jids: List[int]):
+        """Heartbeat every leased job every ``heartbeat_s`` seconds from
+        ONE daemon thread while the (blocking, user-code) job bodies run —
+        a batch lease gets a single beat thread, not one per job, and
+        each beat refreshes the whole lease in one store round trip. Best
         effort: a failed beat is ignored — the CAS ownership checks keep
         correctness; the beat only prevents WASTEFUL requeues of live
-        long jobs."""
+        long jobs. Jobs the batch already committed simply miss (they
+        left the RUNNING|FINISHED states)."""
         if not self.heartbeat_s:
             yield
             return
@@ -151,12 +206,12 @@ class Worker:
         def beat():
             while not stop.wait(self.heartbeat_s):
                 try:
-                    self.store.heartbeat(ns, jid, self.name)
+                    self.store.heartbeat_batch(ns, jids, self.name)
                 except Exception:
                     pass
 
         t = threading.Thread(target=beat, daemon=True,
-                             name=f"{self.name}-hb-{ns}-{jid}")
+                             name=f"{self.name}-hb-{ns}")
         t.start()
         try:
             yield
@@ -164,92 +219,98 @@ class Worker:
             stop.set()
             t.join(timeout=5.0)
 
-    def _execute_map(self, spec: TaskSpec, job: dict) -> None:
-        ns, jid = MAP_NS, job["_id"]
-        try:
-            store = get_storage_from(spec.storage)
-            with self._beating(ns, jid):
-                times = run_map_job(spec, store, str(jid), job["key"],
-                                    job["value"])
-            if self._finish(ns, jid, times):
-                if jid not in self._affinity:
-                    self._affinity.append(jid)
-                self.jobs_executed += 1
-                self._log(f"map job {jid} done ({times.real:.3f}s)")
-        except Exception:
-            self._mark_broken(ns, jid)
-            raise
+    # -- job bodies (the per-namespace work; control flow lives in
+    # _execute_batch) --------------------------------------------------------
 
-    def _execute_premerge(self, spec: TaskSpec, job: dict) -> None:
+    def _map_body(self, spec: TaskSpec, job: dict):
+        store = get_storage_from(spec.storage)
+        return run_map_job(spec, store, str(job["_id"]), job["key"],
+                           job["value"])
+
+    def _premerge_body(self, spec: TaskSpec, job: dict):
         """Consolidate committed runs into a spill (pipelined shuffle).
         Input visibility/idempotence checks live in run_premerge_job —
         a lost-then-reclaimed job whose first claimant already published
         the spill short-circuits there instead of failing."""
-        ns, jid = PRE_NS, job["_id"]
-        try:
-            store = get_storage_from(spec.storage)
-            v = job["value"]
-            with self._beating(ns, jid):
-                times = run_premerge_job(spec, store, v["files"], v["spill"])
-            if self._finish(ns, jid, times):
-                self.jobs_executed += 1
-                self._log(f"pre_merge job {jid} done ({times.real:.3f}s)")
-        except Exception:
-            self._mark_broken(ns, jid)
-            raise
+        store = get_storage_from(spec.storage)
+        v = job["value"]
+        return run_premerge_job(spec, store, v["files"], v["spill"])
 
-    def _execute_reduce(self, spec: TaskSpec, job: dict) -> None:
-        ns, jid = RED_NS, job["_id"]
-        try:
-            store = get_storage_from(spec.storage)
-            result_store = (get_storage_from(spec.result_storage)
-                            if spec.result_storage else store)
-            v = job["value"]
-            # pull-integrity check: every producer's run must be visible
-            # through the storage backend BEFORE the merge starts. A
-            # missing run fails loudly and names its producer (the sshfs
-            # scp-from-mapper failure mode, fs.lua:148-157) instead of
-            # silently reducing fewer runs. One LIST round trip — a
-            # per-file exists() would serialize object-store latency
-            # across the whole fan-in. The ``.*`` glob covers raw runs
-            # AND pre-merged ``.SPILL-*`` inputs (the pipelined server's
-            # reduce jobs mix both) without matching the partition's own
-            # ``<ns>.P<part>`` result file.
-            visible = set(store.list(
-                f"{spec.result_ns}.P{v['part']}.*"))
-            missing = [f for f in v["files"] if f not in visible]
-            if missing:
-                raise RuntimeError(
-                    f"reduce {v['part']}: {len(missing)} run file(s) not "
-                    f"visible in storage (producers: "
-                    f"{v.get('mappers') or 'unknown'}): {missing[:3]} — "
-                    "cross-host pools need a backend every host can reach")
-            with self._beating(ns, jid):
-                times = run_reduce_job(spec, store, result_store,
-                                       str(v["part"]), v["files"],
-                                       v["result"])
-            if self._finish(ns, jid, times):
-                self.jobs_executed += 1
-                self._log(f"reduce job {jid} done ({times.real:.3f}s)")
-        except Exception:
-            self._mark_broken(ns, jid)
-            raise
+    def _reduce_body(self, spec: TaskSpec, job: dict):
+        store = get_storage_from(spec.storage)
+        result_store = (get_storage_from(spec.result_storage)
+                        if spec.result_storage else store)
+        v = job["value"]
+        # pull-integrity check: every producer's run must be visible
+        # through the storage backend BEFORE the merge starts. A
+        # missing run fails loudly and names its producer (the sshfs
+        # scp-from-mapper failure mode, fs.lua:148-157) instead of
+        # silently reducing fewer runs. One LIST round trip — a
+        # per-file exists() would serialize object-store latency
+        # across the whole fan-in. The ``.*`` glob covers raw runs
+        # AND pre-merged ``.SPILL-*`` inputs (the pipelined server's
+        # reduce jobs mix both) without matching the partition's own
+        # ``<ns>.P<part>`` result file.
+        visible = set(store.list(
+            f"{spec.result_ns}.P{v['part']}.*"))
+        missing = [f for f in v["files"] if f not in visible]
+        if missing:
+            raise RuntimeError(
+                f"reduce {v['part']}: {len(missing)} run file(s) not "
+                f"visible in storage (producers: "
+                f"{v.get('mappers') or 'unknown'}): {missing[:3]} — "
+                "cross-host pools need a backend every host can reach")
+        return run_reduce_job(spec, store, result_store,
+                              str(v["part"]), v["files"], v["result"])
 
-    def _finish(self, ns: str, jid: int, times) -> bool:
-        """RUNNING→FINISHED→WRITTEN, CASing on this worker's ownership.
-        Returns False when the claim was lost (stale-requeued and taken by
-        another worker) — the work's output still landed atomically, but
-        this worker must not touch the new claimant's state."""
-        if not self.store.set_job_status(ns, jid, Status.FINISHED,
-                                         expect=(Status.RUNNING,),
-                                         expect_worker=self.name):
-            self._log(f"job {jid}: claim lost before FINISHED; yielding")
-            return False
-        self.store.set_job_times(ns, jid, _times_dict(times))
-        self.store.set_job_status(ns, jid, Status.WRITTEN,
-                                  expect=(Status.FINISHED,),
-                                  expect_worker=self.name)
-        return True
+    _BODIES = {MAP_NS: _map_body, PRE_NS: _premerge_body,
+               RED_NS: _reduce_body}
+
+    def _execute_batch(self, spec: TaskSpec, ns: str,
+                       jobs: List[dict]) -> None:
+        """Execute a claimed lease back-to-back and retire it in one
+        commit (DESIGN §16). The whole lease shares one heartbeat thread;
+        each body's output still lands atomically through the storage
+        layer, so commit is pure control plane. A user-code failure on
+        job i commits the i completed jobs, RELEASES the unstarted tail
+        back to WAITING (never ran — no repetition bump), marks the
+        failing job BROKEN, and re-raises exactly like the single-job
+        path. Jobs whose claim was lost mid-lease (stale-requeued and
+        re-claimed) are skipped by the commit's ownership CAS — this
+        worker must not touch the new claimant's state."""
+        body = self._BODIES[ns]
+        label = {MAP_NS: "map", PRE_NS: "pre_merge", RED_NS: "reduce"}[ns]
+        jids = [j["_id"] for j in jobs]
+        done: List[tuple] = []          # (jid, times_dict), commit order
+        with self._beating(ns, jids):
+            for pos, job in enumerate(jobs):
+                try:
+                    times = body(self, spec, job)
+                except Exception:
+                    committed = self.store.commit_batch(ns, self.name, done)
+                    self._settle_committed(ns, committed)
+                    self.store.release_batch(ns, self.name, jids[pos + 1:])
+                    self._mark_broken(ns, job["_id"])
+                    raise
+                self._note_duration(ns, times.real)
+                done.append((job["_id"], _times_dict(times)))
+                self._log(f"{label} job {job['_id']} done "
+                          f"({times.real:.3f}s)"
+                          + (f" [{pos + 1}/{len(jobs)}]"
+                             if len(jobs) > 1 else ""))
+        committed = self.store.commit_batch(ns, self.name, done)
+        self._settle_committed(ns, committed)
+        lost = len(done) - len(committed)
+        if lost:
+            self._log(f"{label}: {lost} claim(s) lost mid-lease; yielded")
+
+    def _settle_committed(self, ns: str, committed: List[int]) -> None:
+        """Book committed jobs: execution count + map affinity."""
+        self.jobs_executed += len(committed)
+        if ns == MAP_NS:
+            for jid in committed:
+                if jid not in self._affinity:
+                    self._affinity.append(jid)
 
     def _mark_broken(self, ns: str, jid: int) -> None:
         """Job → BROKEN (+1 repetition) and error → errors stream
@@ -271,10 +332,10 @@ class Worker:
         tasks_done = 0
         sleep = DEFAULT_SLEEP
         saw_work = False
-        jobs_at_start = self.jobs_executed
+        self._jobs_at_start = self.jobs_executed
         while idle_iters < self.max_iter and tasks_done < self.max_tasks:
             if (self.max_jobs is not None and
-                    self.jobs_executed - jobs_at_start >= self.max_jobs):
+                    self.jobs_executed - self._jobs_at_start >= self.max_jobs):
                 self._log(f"leaving after {self.max_jobs} jobs "
                           "(bounded lifetime)")
                 break

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
+from lua_mapreduce_tpu.utils.jax_compat import shard_map
 
 
 class ALSResult(NamedTuple):
@@ -104,7 +105,7 @@ def als_fit(ratings, mask, item_factors0, *, n_iters: int = 10,
         u, v, hist = jax.jit(fit)(ratings, mask, item_factors0)
         return ALSResult(u, v, hist[-1], hist)
 
-    shard = jax.shard_map(
+    shard = shard_map(
         fit, mesh=mesh, in_specs=(P(axis), P(axis), P()),
         out_specs=(P(axis), P(), P()))
     ratings = jax.device_put(ratings, NamedSharding(mesh, P(axis)))

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
+from lua_mapreduce_tpu.utils.jax_compat import shard_map
 
 
 class KMeansResult(NamedTuple):
@@ -119,7 +120,7 @@ def kmeans_fit(x, centroids0, *, n_iters: int = 20,
 
     if mesh is None:
         return jax.jit(fit)(x, centroids0)
-    shard = jax.shard_map(
+    shard = shard_map(
         fit, mesh=mesh, in_specs=(P(axis), P()),
         out_specs=KMeansResult(P(), P(), P()))
     x = jax.device_put(x, NamedSharding(mesh, P(axis)))

@@ -44,6 +44,7 @@ from lua_mapreduce_tpu.parallel.ring_attention import (
     _NEG_INF, _ring_shard, _ring_shard_zigzag, _ulysses_shard,
     _zigzag_check, _zigzag_perm, attention_reference)
 from lua_mapreduce_tpu.train.accum import accum_value_and_grad
+from lua_mapreduce_tpu.utils.jax_compat import shard_map
 
 Params = Dict[str, jnp.ndarray]
 
@@ -493,7 +494,7 @@ def prefill(params: Params, prompt, *,
         # keep only the sequence sharded (the memory that matters at
         # long context is the L axis anyway)
         bspec = dp_axis if b % mesh.shape[dp_axis] == 0 else None
-        fn = jax.shard_map(
+        fn = shard_map(
             shard_fwd, mesh=mesh,
             in_specs=(P(), P(bspec, sp_axis)),
             out_specs=(P(bspec, sp_axis),
@@ -857,7 +858,7 @@ def make_sharded_apply(cfg: TransformerConfig, mesh, *,
         # zigzag: permute in, un-permute out — callers see
         # standard order (perm is None otherwise)
         tokens, perm = _maybe_zigzag(attn, n_sp, tokens)
-        fn = jax.shard_map(shard_fwd, mesh=mesh,
+        fn = shard_map(shard_fwd, mesh=mesh,
                            in_specs=(specs, P(dp_axis, sp_axis)),
                            out_specs=P(dp_axis, sp_axis))
         out = fn(params, tokens)
@@ -1020,13 +1021,13 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
             # check_vma off: the all_gather'd params ARE replicated
             # (chunks updated from dp-invariant inputs), but the static
             # varying-axes checker cannot prove it through all_gather
-            mapped = jax.shard_map(
+            mapped = shard_map(
                 shard_step_zero1, mesh=mesh,
                 in_specs=(P(), st_specs, P(dp_axis, sp_axis),
                           P(dp_axis, sp_axis)),
                 out_specs=(P(), st_specs, P()), check_vma=False)
             return mapped(params, opt_state, tokens, targets)
-        mapped = jax.shard_map(
+        mapped = shard_map(
             shard_step, mesh=mesh,
             in_specs=(specs, P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
             out_specs=(P(), specs))
@@ -1215,7 +1216,7 @@ def make_train_step_3d(cfg: TransformerConfig, mesh, optimizer, *,
         # same internal zigzag permutation as the 2-D step
         tokens, targets, _ = _maybe_zigzag(attn, n_sp, tokens, targets,
                                            pre_permuted=zigzag_layout)
-        mapped = jax.shard_map(
+        mapped = shard_map(
             shard_step, mesh=mesh,
             in_specs=(specs_tree(params), P(dp_axis, sp_axis),
                       P(dp_axis, sp_axis)),
@@ -1351,7 +1352,7 @@ def make_train_step_pp(cfg: TransformerConfig, mesh, optimizer, *,
 
     def step(params, opt_state, tokens, targets):
         specs = specs_for(params)
-        mapped = jax.shard_map(
+        mapped = shard_map(
             shard_step, mesh=mesh, in_specs=(specs, P(), P()),
             out_specs=(P(), specs))
         loss, grads = mapped(params, tokens, targets)

@@ -45,6 +45,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from lua_mapreduce_tpu.ops.attention import flash_attention
+from lua_mapreduce_tpu.utils.jax_compat import shard_map
 
 _NEG_INF = -1e30      # finite mask fill: -inf breaks the m-subtraction
 
@@ -306,7 +307,7 @@ def _ring_jit(mesh, axis: str, causal: bool, schedule: str = "contiguous",
         body = functools.partial(_ring_shard, axis=axis,
                                  n_shards=mesh.shape[axis],
                                  causal=causal, window=window)
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh, in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis))
@@ -392,7 +393,7 @@ def _ulysses_shard(q, k, v, *, axis: str, n_shards: int, causal: bool):
 
 @functools.lru_cache(maxsize=None)
 def _ulysses_jit(mesh, axis: str, causal: bool):
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ulysses_shard, axis=axis,
                           n_shards=mesh.shape[axis], causal=causal),
         mesh=mesh, in_specs=(P(None, axis), P(None, axis), P(None, axis)),

@@ -33,6 +33,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from lua_mapreduce_tpu.parallel.array_task import ArrayTaskSpec
+from lua_mapreduce_tpu.utils.jax_compat import shard_map
 
 _CROSS = {
     "sum": lax.psum,
@@ -86,7 +87,7 @@ class TpuExecutor:
             return jax.tree.map(lambda x: cross(x, axis), out)
 
         shard_spec = P(self.axis)
-        mapped = jax.shard_map(
+        mapped = shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(shard_spec,), out_specs=P())
         return jax.jit(mapped)
@@ -133,7 +134,7 @@ class TpuExecutor:
 
             return jax.tree.map(shuffle_reduce, buckets)
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(P(self.axis),), out_specs=P(self.axis))
         return jax.jit(mapped)
@@ -200,5 +201,5 @@ def differentiable_keyed(mapfn, mesh, axis: str = "dp",
         out = mapfn(params, batch)
         return jax.tree.map(lambda x: cross(x, axis), out)
 
-    return jax.shard_map(per_shard, mesh=mesh,
+    return shard_map(per_shard, mesh=mesh,
                          in_specs=(P(), P(axis)), out_specs=P())

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from lua_mapreduce_tpu.utils.jax_compat import shard_map
 
 
 def _chunk_len(n: int, n_dp: int) -> int:
@@ -111,6 +112,6 @@ def init_state(optimizer, params, mesh, *, dp_axis: str = "dp"):
 
     # check_vma off: chunk slicing by axis_index is rank-varying in a
     # way the static checker rejects for the replicated scalar leaves
-    fn = jax.shard_map(shard_init, mesh=mesh, in_specs=(P(),),
+    fn = shard_map(shard_init, mesh=mesh, in_specs=(P(),),
                        out_specs=specs, check_vma=False)
     return jax.jit(fn)(params)

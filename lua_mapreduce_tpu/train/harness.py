@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from lua_mapreduce_tpu.parallel import zero1 as _z1
 from lua_mapreduce_tpu.train import checkpoint as ckpt
 from lua_mapreduce_tpu.train.accum import accum_value_and_grad
+from lua_mapreduce_tpu.utils.jax_compat import shard_map
 
 
 @dataclasses.dataclass
@@ -129,7 +130,12 @@ class DataParallelTrainer:
                 return accum_value_and_grad(global_loss, params, (x, y),
                                             accum)
 
-            loss, grads = jax.shard_map(
+            # NB: no check_vma/check_rep override here — on older JAX,
+            # check_rep=False also disables the auto-psum of
+            # replicated-input cotangents this step's grads rely on
+            # (silently un-summed grads); the old checker's rejection of
+            # these out_specs is the loud failure mode we prefer
+            loss, grads = shard_map(
                 shard_step, mesh=self.mesh,
                 in_specs=(P(), P(axis), P(axis)), out_specs=(P(), P()),
             )(params, x, y)
@@ -160,7 +166,7 @@ class DataParallelTrainer:
                 return params, opt_state, lax.pmean(loss, axis)
 
             st_specs = _z1.state_specs(opt_state, axis)
-            return jax.shard_map(
+            return shard_map(
                 shard_step, mesh=self.mesh,
                 in_specs=(P(), st_specs, P(axis), P(axis)),
                 out_specs=(P(), st_specs, P()),

@@ -1,0 +1,21 @@
+"""JAX API drift shims (library-wide, lazily resolved).
+
+``jax.shard_map`` went public (with the ``check_vma`` kwarg) in newer
+JAX; installed older releases carry it as
+``jax.experimental.shard_map.shard_map`` with the same semantics under
+the ``check_rep`` kwarg. Every library call site routes through
+:func:`shard_map` here so the whole package — not just individual tests
+with local try/except shims — runs on both API generations.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *args, **kwargs):
+    import jax
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return fn(f, *args, **kwargs)

@@ -70,6 +70,15 @@ class IterationStats:
     premerge: PhaseStats = dataclasses.field(default_factory=PhaseStats)
     wall_time: float = 0.0
     overlap_fraction: float = 0.0   # see overlap_fraction() above
+    # control-plane round trips observed through the server's job-store
+    # instance this iteration (JobStore.round_counts deltas). In-process
+    # pools share that instance, so these count the whole pool's claim
+    # and commit traffic — the batch-lease protocol's effectiveness
+    # metric (claim_rounds << job count when batch_k amortizes); in
+    # multi-process pools each worker process counts its own and the
+    # coord bench aggregates them explicitly.
+    claim_rounds: int = 0
+    commit_rounds: int = 0
 
     @property
     def cluster_time(self) -> float:
@@ -86,6 +95,8 @@ class IterationStats:
             "reduce": self.reduce.as_dict(),
             "premerge": self.premerge.as_dict(),
             "overlap_fraction": self.overlap_fraction,
+            "claim_rounds": self.claim_rounds,
+            "commit_rounds": self.commit_rounds,
             "cluster_time": self.cluster_time,
             "wall_time": self.wall_time,
         }
@@ -126,6 +137,8 @@ def utest() -> None:
     d = TaskStats(iterations=[it]).as_dict()
     assert d["iterations"][0]["map"]["count"] == 2
     assert d["iterations"][0]["premerge"]["count"] == 0
+    assert d["iterations"][0]["claim_rounds"] == 0
+    assert d["iterations"][0]["commit_rounds"] == 0
     # overlap: map ends at 6.0; one pre-merge fully inside (2→4), one
     # half outside (5→7): hidden = 2 + 1 of real = 2 + 2 → 3/4
     pre = [JobTimes(started=2.0, finished=3.0, written=4.0, cpu=0.1),

@@ -51,6 +51,43 @@ def test_execute_server_strict_flag_parses():
     assert args.strict is True
 
 
+def test_batch_k_flags_parse():
+    """The batch-lease knob on both launchers: the server flag is the
+    fleet default (task doc), the worker flag an explicit override
+    (None = follow the doc)."""
+    args = execute_server.build_parser().parse_args(
+        ["mem", "a", "b", "c", "d", "--batch-k", "16"])
+    assert args.batch_k == 16
+    args = execute_worker.build_parser().parse_args(["/tmp/x"])
+    assert args.batch_k is None and args.max_jobs is None
+    args = execute_worker.build_parser().parse_args(
+        ["/tmp/x", "--batch-k", "8", "--max-jobs", "40"])
+    assert args.batch_k == 8 and args.max_jobs == 40
+
+
+def test_execute_server_batched_inline_workers(tmp_path, capsys):
+    """End-to-end through the server CLI with --batch-k: inline workers
+    inherit the lease size from the task document and the result still
+    matches the naive oracle."""
+    import examples.wordcount.finalfn as finalfn
+    finalfn.counts.clear()
+    rc = execute_server.main([
+        "mem",
+        "examples.wordcount.taskfn",
+        "examples.wordcount.mapfn",
+        "examples.wordcount.partitionfn",
+        "examples.wordcount.reducefn",
+        "--finalfn", "examples.wordcount.finalfn",
+        "--inline-workers", "2",
+        "--poll", "0.02",
+        "--batch-k", "4",
+        "--init-arg", f"files={os.pathsep.join(CORPUS)}",
+        "--quiet",
+    ])
+    assert rc == 0
+    assert dict(finalfn.counts) == naive_wordcount(CORPUS)
+
+
 def test_remove_results_drops_store_and_files(tmp_path):
     from lua_mapreduce_tpu.coord.filestore import FileJobStore
     from lua_mapreduce_tpu.coord.jobstore import make_job

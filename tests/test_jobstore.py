@@ -151,6 +151,125 @@ def test_ownership_cas_blocks_stale_claimant(tmp_path, idx):
     assert store.get_job("ns", 0)["repetitions"] == reps_before
 
 
+TIMES = {"started": 1.0, "finished": 2.0, "written": 3.0, "cpu": 0.5,
+         "real": 2.0}
+
+
+@pytest.mark.parametrize("idx", [0, 1, 2], ids=["mem", "file-py", "file-auto"])
+def test_claim_batch_conformance(tmp_path, idx):
+    """Batch-lease claim semantics, identical across every store: up to
+    k jobs in one pass, claim order, preferred-first, steal=False
+    restriction, exactly-once handout, empty result when drained."""
+    store = _stores(tmp_path)[idx]
+    store.insert_jobs("ns", [make_job(i, {"v": i}) for i in range(6)])
+
+    batch = store.claim_batch("ns", "w1", k=3)
+    assert [d["_id"] for d in batch] == [0, 1, 2]
+    assert all(d["status"] == Status.RUNNING and d["worker"] == "w1"
+               and d["value"] == {"v": d["_id"]} for d in batch)
+
+    # preferred ids come first; steal fills the remainder
+    batch = store.claim_batch("ns", "w2", k=2, preferred_ids=[5])
+    assert [d["_id"] for d in batch] == [5, 3]
+    # steal=False restricts to preferred (all taken -> nothing)
+    assert store.claim_batch("ns", "w2", k=2, preferred_ids=[5],
+                             steal=False) == []
+    # k larger than what's left: partial batch, then empty
+    assert [d["_id"] for d in store.claim_batch("ns", "w3", k=10)] == [4]
+    assert store.claim_batch("ns", "w3", k=10) == []
+
+
+@pytest.mark.parametrize("idx", [0, 1, 2], ids=["mem", "file-py", "file-auto"])
+def test_commit_batch_conformance(tmp_path, idx):
+    """Batch commit: RUNNING→WRITTEN with times, CASed per entry on
+    ownership — a claim lost mid-lease is skipped without disturbing the
+    new claimant, and the rest of the batch lands."""
+    store = _stores(tmp_path)[idx]
+    store.insert_jobs("ns", [make_job(i, i) for i in range(4)])
+    jids = [d["_id"] for d in store.claim_batch("ns", "w1", k=3)]
+    assert jids == [0, 1, 2]
+
+    # job 1's claim is stale-requeued and re-claimed by another worker
+    store.set_job_status("ns", 1, Status.BROKEN)
+    assert store.claim("ns", "thief")["_id"] == 1
+
+    # job 2 is mid-flight in the v1 crash window (FINISHED, not yet
+    # WRITTEN): commit_batch must retire RUNNING and FINISHED alike —
+    # identical across every store — instead of leaving it for the
+    # stale requeue to re-execute completed work
+    assert store.set_job_status("ns", 2, Status.FINISHED,
+                                expect=(Status.RUNNING,),
+                                expect_worker="w1")
+    done = store.commit_batch("ns", "w1", [(j, TIMES) for j in jids])
+    assert done == [0, 2]
+    for jid in (0, 2):
+        doc = store.get_job("ns", jid)
+        assert doc["status"] == Status.WRITTEN
+        assert doc["times"] == TIMES
+    assert store.get_job("ns", 1)["status"] == Status.RUNNING
+    # the thief's own commit still lands
+    assert store.commit_batch("ns", "thief", [(1, TIMES)]) == [1]
+    counts = store.counts("ns")
+    assert counts[Status.WRITTEN] == 3 and counts[Status.WAITING] == 1
+
+
+@pytest.mark.parametrize("idx", [0, 1, 2], ids=["mem", "file-py", "file-auto"])
+def test_release_batch_returns_unstarted_jobs(tmp_path, idx):
+    """A batch aborted partway releases its unstarted tail: RUNNING →
+    WAITING on ownership, repetitions untouched (the jobs never ran, so
+    they must not creep toward the scavenger's FAILED threshold)."""
+    store = _stores(tmp_path)[idx]
+    store.insert_jobs("ns", [make_job(i, i) for i in range(3)])
+    store.claim_batch("ns", "w1", k=3)
+    assert store.release_batch("ns", "other", [1, 2]) == 0   # non-owner
+    assert store.release_batch("ns", "w1", [1, 2]) == 2
+    for jid in (1, 2):
+        doc = store.get_job("ns", jid)
+        assert doc["status"] == Status.WAITING
+        assert doc["repetitions"] == 0
+    # released jobs are immediately re-claimable
+    assert store.claim("ns", "w2")["_id"] == 1
+
+
+@pytest.mark.parametrize("idx", [0, 1, 2], ids=["mem", "file-py", "file-auto"])
+def test_heartbeat_batch_beats_whole_lease(tmp_path, idx):
+    """One beat refreshes every leased job this worker still owns; jobs
+    already committed or re-claimed simply miss."""
+    store = _stores(tmp_path)[idx]
+    store.insert_jobs("ns", [make_job(i, i) for i in range(4)])
+    jids = [d["_id"] for d in store.claim_batch("ns", "w1", k=3)]
+    store.commit_batch("ns", "w1", [(0, TIMES)])      # retired early
+    assert store.heartbeat_batch("ns", jids, "w1") == 2
+    assert store.heartbeat_batch("ns", jids, "other") == 0
+    time.sleep(0.25)
+    assert store.heartbeat_batch("ns", [1], "w1") == 1
+    # job 1 beat just now survives the requeue; job 2's last signal is
+    # the claim itself — each lease member is judged INDEPENDENTLY
+    assert store.requeue_stale("ns", older_than_s=0.2) == 1
+    assert store.get_job("ns", 1)["status"] == Status.RUNNING
+    assert store.get_job("ns", 2)["status"] == Status.BROKEN
+
+
+def test_batch_interop_native_python(tmp_path):
+    """Batch ops mix freely across engines on the same file: native
+    claims a lease, python commits half of it, native sees the result."""
+    if not native_available():
+        pytest.skip("native index unavailable")
+    path = str(tmp_path / "interop-b.idx")
+    nat = open_index(path, "native")
+    py = PyJobIndex(path)
+    nat.insert(4)
+    assert [j for j, _ in nat.claim_batch(7, 1.0, 3)] == [0, 1, 2]
+    t5 = (1.0, 2.0, 3.0, 0.5, 2.0)
+    assert py.commit_batch([(0, t5), (1, t5)], worker=7) == [True, True]
+    got = nat.get(0)
+    assert got[0] == Status.WRITTEN and got[4] == t5
+    assert py.get(2)[0] == Status.RUNNING
+    assert nat.heartbeat_batch([2], 7, 9.0) == 1
+    assert py.cas_status_batch([2], Status.WAITING,
+                               1 << Status.RUNNING, 7) == [True]
+
+
 def test_cas_on_dropped_namespace_is_false(tmp_path):
     """Regression: straggler CAS after drop_ns returns False (both store
     kinds), never raises."""

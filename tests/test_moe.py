@@ -10,6 +10,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from lua_mapreduce_tpu.parallel import moe
 from lua_mapreduce_tpu.parallel.mesh import make_mesh
+from lua_mapreduce_tpu.utils.jax_compat import shard_map
 
 D, FF, E, CAP = 16, 32, 8, 4
 
@@ -75,7 +76,7 @@ def test_shard_matches_per_tile_reference(mesh, params):
              for k in params}
     sharded = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
                for k, v in params.items()}
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(specs, P("ep")),
         out_specs=(P("ep"), P())))
     got, aux = fn(sharded, jax.device_put(
@@ -104,7 +105,7 @@ def test_moe_trains_and_uses_multiple_experts(mesh):
         mse = jnp.mean((out - y) ** 2)
         return jax.lax.pmean(mse, "ep") + 0.01 * aux
 
-    grad_fn = jax.jit(jax.shard_map(
+    grad_fn = jax.jit(shard_map(
         lambda p, x, y: jax.value_and_grad(
             lambda p: body(p, x, y))(p),
         mesh=mesh, in_specs=(specs, P("ep"), P("ep")),
@@ -299,7 +300,7 @@ class TestTopK:
                    for k in params}
         pr = {k: jax.device_put(v, shard_p[k])
               for k, v in params.items()}
-        got = jax.jit(jax.shard_map(
+        got = jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=(P("ep"), {k: (P("ep") if k != "moe_router_W"
                                     else P()) for k in params}),
@@ -421,7 +422,7 @@ class TestSortedRouting:
             def body(params, x):
                 return moe.moe_ffn_shard(params, x, capacity=CAP,
                                          ep_axis="ep", impl=impl)
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map(
                 body, mesh=mesh, in_specs=(specs, P("ep")),
                 out_specs=(P("ep"), P())), static_argnums=())
             return fn(sharded, xs)

@@ -56,6 +56,7 @@ def test_global_batch_array_roundtrip():
 
 _WORKER = r'''
 import os, sys
+from lua_mapreduce_tpu.utils.jax_compat import shard_map
 pid = int(sys.argv[1]); port = sys.argv[2]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -109,7 +110,7 @@ assert np.allclose(float(poswsum(x)), want_pos, rtol=1e-6)
 # ring ppermute ACROSS the process boundary — the point-to-point
 # collective ring attention rides; shard i's rows must land on shard
 # i+1 (devices 1->2 and 3->0 cross processes here)
-ring = jax.jit(jax.shard_map(
+ring = jax.jit(shard_map(
     lambda a: jax.lax.ppermute(a, "dp", [(i, (i + 1) % 4)
                                          for i in range(4)]),
     mesh=mesh, in_specs=P("dp"), out_specs=P("dp")))
@@ -265,7 +266,7 @@ want = float(np.sum(gx * np.arange(8)[:, None]))
 assert np.allclose(float(poswsum(x)), want, rtol=1e-6), "row placement"
 
 # dp ppermute ring: every hop crosses a process boundary (pure DCN)
-ring = jax.jit(jax.shard_map(
+ring = jax.jit(shard_map(
     lambda a: jax.lax.ppermute(a, "dp", [(i, (i + 1) % 4)
                                          for i in range(4)]),
     mesh=mesh, in_specs=P("dp", "mp"), out_specs=P("dp", "mp")))
@@ -285,7 +286,7 @@ def loss_local(xs, ws):
     l = jnp.sum(y * y) / 8.0
     return jax.lax.psum(l, "dp")       # DCN-analog reduce
 
-lval = jax.jit(jax.shard_map(
+lval = jax.jit(shard_map(
     lambda xs, ws: loss_local(xs, ws),
     mesh=mesh, in_specs=(P("dp", "mp"), P("mp")),
     out_specs=P()))(x, wg)

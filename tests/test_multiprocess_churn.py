@@ -72,6 +72,27 @@ _STALL_REDUCE = (
     "import lua_mapreduce_tpu.core.native_merge as nm\n"
     "nm.native_available = lambda: False\n")
 
+# batch-lease victim: the FIRST map job of the batch completes (its runs
+# publish), the SECOND wedges — so the SIGKILL lands mid-lease with one
+# executed-but-uncommitted job, one wedged job, and the rest of the
+# lease claimed-but-unstarted. Every one of them must return to the pool
+# independently via the stale requeue.
+_STALL_MAP_MIDBATCH = (
+    "import examples.wordcount_big.bigtask as bt\n"
+    "import time\n"
+    "_orig_mapfn = bt.mapfn\n"
+    "_calls = [0]\n"
+    "def stall(k, v, emit):\n"
+    "    _calls[0] += 1\n"
+    "    if _calls[0] >= 3:\n"
+    "        print('CLAIMED', flush=True)\n"
+    "        time.sleep(3600)\n"
+    "    _orig_mapfn(k, v, emit)\n"
+    "bt.mapfn = stall\n"
+    # the native fast path would bypass the stalled python mapfn
+    "import lua_mapreduce_tpu.core.native_wcmap as nw\n"
+    "nw.native_available = lambda: False\n")
+
 
 @pytest.mark.heavy
 @pytest.mark.parametrize("pipeline", [False, True],
@@ -174,6 +195,106 @@ def test_nine_process_pool_survives_map_and_reduce_sigkill(tmp_path,
     it = stats.iterations[-1]
     assert it.map.failed == 0 and it.reduce.failed == 0
     assert it.map.count == N_SPLITS
+
+    result_store = get_storage_from(storage)
+    got = {k: vs[0] for k, vs in iter_results(result_store, "result")}
+    assert got == dict(golden)
+
+
+@pytest.mark.heavy
+def test_sigkill_mid_batch_lease_requeues_whole_lease(tmp_path):
+    """Batch leases under churn (ISSUE 2 satellite): a worker running
+    with batch_k=8 claims a LEASE of map jobs, completes the lease's
+    first job (runs published, commit still pending — batch commits
+    retire at lease end), wedges on the second, and is SIGKILLed. The
+    stale requeue must return every lease member to the pool
+    INDEPENDENTLY — the committed probe job stays WRITTEN, the
+    executed-but-uncommitted job, the wedged job, and the
+    claimed-but-unstarted tail all go BROKEN and are re-executed by a
+    healthy batched pool — and the result must equal the golden count
+    byte-for-byte (re-runs republish the identical run files)."""
+    from examples.wordcount_big import corpus
+
+    corpus_dir = str(tmp_path / "corpus")
+    corpus.build(corpus_dir, n_splits=N_SPLITS)
+    golden = Counter()
+    for i in range(N_SPLITS):
+        with open(corpus.split_path(corpus_dir, i)) as f:
+            golden.update(f.read().split())
+
+    coord = str(tmp_path / "coord")
+    obj = str(tmp_path / "obj")
+    storage = f"object:{obj}"
+    store = FileJobStore(coord)
+    mod = "examples.wordcount_big.bigtask"
+    spec = TaskSpec(taskfn=mod, mapfn=mod, partitionfn=mod, reducefn=mod,
+                    init_args={"corpus_dir": corpus_dir,
+                               "n_splits": N_SPLITS, "build": False},
+                    storage=storage)
+
+    env = _env()
+    procs = []
+    batch_cfg = ("max_iter=2000, max_sleep=0.05, batch_k=8, "
+                 "batch_lease_s=3600.0")   # wide lease: 5 jobs, 1 claim
+
+    def spawn(code, capture=False):
+        p = subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE if capture else subprocess.DEVNULL,
+            text=capture)
+        procs.append(p)
+        return p
+
+    victim = spawn(_worker_code(coord, extra=_STALL_MAP_MIDBATCH,
+                                configure=batch_cfg), capture=True)
+
+    started = {"b": False}
+    lock = threading.Lock()
+
+    def wave_b():
+        with lock:
+            if started["b"]:
+                return
+            started["b"] = True
+        if victim.poll() is None:
+            victim.kill()
+        for _ in range(3):
+            spawn(_worker_code(coord, configure=batch_cfg))
+
+    def chaos():
+        # CLAIMED prints from the lease's second job: the first lease
+        # job already executed (uncommitted), the tail is unstarted
+        victim.stdout.readline()
+        time.sleep(0.2)
+        wave_b()
+
+    t = threading.Thread(target=chaos, daemon=True)
+    t.start()
+    watchdog = threading.Timer(120, wave_b)
+    watchdog.daemon = True
+    watchdog.start()
+
+    try:
+        server = Server(store, poll_interval=0.05, stale_timeout_s=1.5,
+                        batch_k=8).configure(spec)
+        stats = server.loop()
+    finally:
+        watchdog.cancel()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+    it = stats.iterations[-1]
+    assert it.map.failed == 0 and it.reduce.failed == 0
+    assert it.map.count == N_SPLITS
+    # the victim's lease really was requeued: re-executed jobs carry
+    # repetitions from the stale requeue
+    assert any(d["repetitions"] > 0 for d in store.jobs("map_jobs"))
 
     result_store = get_storage_from(storage)
     got = {k: vs[0] for k, vs in iter_results(result_store, "result")}

@@ -209,3 +209,37 @@ def test_pipelined_server_inprocess():
         assert it.premerge.failed == 0
     finally:
         del sys.modules["_pipe_srv_mod"]
+
+
+def test_discover_pipelined_overlapping_spills():
+    """Zombie double-publish recovery (code-review r6): a NESTED
+    overlapping spill pair resolves to the widest (same runs' data, a
+    superset) with the narrower swept; a STAGGERED overlap — where each
+    spill uniquely holds some positions and duplicates others — fails
+    loudly instead of silently double-counting."""
+    from lua_mapreduce_tpu.engine.job import map_key_str
+    from lua_mapreduce_tpu.engine.premerge import (discover_pipelined,
+                                                   spill_name)
+    from lua_mapreduce_tpu.store.router import get_storage_from
+
+    ns = "result"
+    keys = [map_key_str(i) for i in range(10)]
+
+    def put(store, name):
+        b = store.builder()
+        b.write("x 1\n")
+        b.build(name)
+
+    st = get_storage_from("mem:overlap-nested")
+    put(st, spill_name(ns, 0, 0, 7))          # restarted server's spill
+    put(st, spill_name(ns, 0, 0, 5))          # zombie's narrower spill
+    put(st, f"{ns}.P0.M{keys[8]}")            # tail raw run
+    parts = discover_pipelined(st, ns, keys)
+    assert parts[0] == [spill_name(ns, 0, 0, 7), f"{ns}.P0.M{keys[8]}"]
+    assert not st.exists(spill_name(ns, 0, 0, 5))   # swept
+
+    st2 = get_storage_from("mem:overlap-staggered")
+    put(st2, spill_name(ns, 0, 0, 3))
+    put(st2, spill_name(ns, 0, 2, 5))
+    with pytest.raises(RuntimeError, match="staggered"):
+        discover_pipelined(st2, ns, keys)

@@ -15,6 +15,7 @@ from lua_mapreduce_tpu.engine.contract import TaskSpec
 from lua_mapreduce_tpu.engine.local import LocalExecutor
 from lua_mapreduce_tpu.parallel import (ArrayTaskSpec, TpuExecutor, host_mesh)
 from lua_mapreduce_tpu.parallel import collectives
+from lua_mapreduce_tpu.utils.jax_compat import shard_map
 
 VOCAB = 64
 NUM_P = 16      # partitions; mesh dp=8 → 2 partitions per device
@@ -144,7 +145,7 @@ def test_collectives_tree_ops(mesh):
     def body(t):
         return collectives.psum_tree({"a": t}, "dp")["a"]
 
-    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
                               out_specs=P()))
     # each shard is [1, 2]; psum keeps the local shape → global [1, 2]
     np.testing.assert_allclose(f(x), x.sum(axis=0, keepdims=True))
@@ -155,7 +156,7 @@ def test_collectives_tree_ops(mesh):
     def body_rs(t):
         return collectives.reduce_scatter_tree(t.reshape(8), "dp")
 
-    f2 = jax.jit(jax.shard_map(body_rs, mesh=mesh, in_specs=(P("dp"),),
+    f2 = jax.jit(shard_map(body_rs, mesh=mesh, in_specs=(P("dp"),),
                                out_specs=P("dp")))
     np.testing.assert_allclose(np.asarray(f2(x2)).reshape(-1), x2.sum(axis=0))
 
@@ -168,7 +169,7 @@ def test_ppermute_ring_rotates(mesh):
     def body(t):
         return collectives.ppermute_ring(t, "dp", mesh_size=8, shift=1)
 
-    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
                               out_specs=P("dp")))
     out = np.asarray(f(x)).reshape(-1)
     # device i's value moved to device i+1 → output is rolled by one

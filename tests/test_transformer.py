@@ -330,16 +330,21 @@ class TestMoE:
             vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
             max_seq=128, moe_experts=8, moe_capacity=256)
 
-    @pytest.mark.heavy
-    def test_sharded_forward_matches_oracle(self, moe_cfg):
+    def test_sharded_forward_matches_oracle(self):
         """Generous capacity (no drops) → routing is per-token, so the
-        ep-sharded forward equals the single-device oracle exactly."""
+        ep-sharded forward equals the single-device oracle exactly.
+        Default-suite shape (ADVICE r5): shrunk from the class cfg so
+        this end-to-end MoE golden diff runs on every `pytest tests/`,
+        not only under --full."""
+        cfg = tfm.TransformerConfig(
+            vocab=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+            max_seq=64, moe_experts=4, moe_capacity=128)  # = b*l: no drops
         mesh2 = make_mesh(dp=4, mp=2, devices=jax.devices("cpu")[:8],
                           axis_names=("dp", "sp"))
-        params = tfm.init_transformer(jax.random.PRNGKey(0), moe_cfg)
-        tokens = _tokens(moe_cfg, b=4, l=64)
-        want = tfm.transformer_apply(params, tokens, cfg=moe_cfg)
-        fwd = tfm.make_sharded_apply(moe_cfg, mesh2, attn="ring")
+        params = tfm.init_transformer(jax.random.PRNGKey(0), cfg)
+        tokens = _tokens(cfg, b=4, l=32)    # b divisible by dp=4
+        want = tfm.transformer_apply(params, tokens, cfg=cfg)
+        fwd = tfm.make_sharded_apply(cfg, mesh2, attn="ring")
         got = fwd(tfm.shard_params_moe(params, mesh2), tokens)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=3e-4, atol=3e-4)
@@ -474,17 +479,19 @@ class TestPipeline:
                                    n_micro=2)
 
 
-@pytest.mark.heavy
 def test_remat_matches_non_remat_grads():
     """cfg.remat recomputes blocks in backward — loss and grads must be
-    IDENTICAL to the saved-activation path (same math, less memory)."""
+    IDENTICAL to the saved-activation path (same math, less memory).
+    Default-suite shape (ADVICE r5): shortened sequence — the oracle
+    property is shape-independent, so this golden diff stays in every
+    `pytest tests/` run."""
     import dataclasses
 
     cfg = tfm.TransformerConfig.tiny()
     cfg_r = dataclasses.replace(cfg, remat=True)
     params = tfm.init_transformer(jax.random.PRNGKey(0), cfg)
     rng = np.random.RandomState(0)
-    seq = rng.randint(0, cfg.vocab, (2, 17))
+    seq = rng.randint(0, cfg.vocab, (2, 9))
     tok = jnp.asarray(seq[:, :-1], jnp.int32)
     tgt = jnp.asarray(seq[:, 1:], jnp.int32)
 
@@ -549,14 +556,17 @@ def test_flops_per_token_accounting():
 class TestGreedyDecode:
     """KV-cached decode vs the no-cache oracle: identical tokens."""
 
-    @pytest.mark.heavy
     def test_matches_full_forward_rerun(self, cfg):
+        # default-suite shape (ADVICE r5): fewer decode steps — each
+        # naive-rerun prefix length is its own XLA compile, so the step
+        # count, not the model, is the cost; the KV-cache-vs-oracle
+        # golden diff itself is length-independent
         rng = np.random.RandomState(13)
         params = tfm.init_transformer(jax.random.PRNGKey(13), cfg)
-        prompt = jnp.asarray(rng.randint(0, cfg.vocab, (3, 5)), jnp.int32)
-        n_new = 7
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab, (2, 5)), jnp.int32)
+        n_new = 4
         got = tfm.greedy_decode(params, prompt, n_new, cfg=cfg)
-        assert got.shape == (3, 12)
+        assert got.shape == (2, 9)
         assert np.array_equal(np.asarray(got[:, :5]), np.asarray(prompt))
 
         # naive loop: re-run the FULL forward at every prefix

@@ -12,6 +12,7 @@ from jax.sharding import PartitionSpec as P
 from lua_mapreduce_tpu.models import transformer as tfm
 from lua_mapreduce_tpu.parallel import zero1 as z1
 from lua_mapreduce_tpu.parallel.mesh import make_mesh
+from lua_mapreduce_tpu.utils.jax_compat import shard_map
 
 N_DP = 4
 
@@ -100,7 +101,7 @@ def test_padding_edge_leaf(mesh):
         return z1.gather_params(pc, p, "dp"), s
 
     st_specs = z1.state_specs(st, "dp")
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(), st_specs, P()),
         out_specs=(P(), st_specs), check_vma=False))
