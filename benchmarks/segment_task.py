@@ -1,0 +1,63 @@
+"""Pre-aggregated synthetic wordcount for the segment data-plane bench.
+
+The six-function module behind benchmarks/segment_bench.py: ``init``
+builds a deterministic per-job (word, count) shard table in module state
+(job VALUES stay tiny — the taskfn value cap applies, and the corpus must
+not ride through the job store), ``mapfn`` emits each pre-counted pair
+once, so map CPU per record is minimal and the task's cost concentrates
+in the SHUFFLE data plane: serialize → spill → merge-parse → reduce.
+That is the regime the v1-text vs v2-segment comparison is about; a
+tokenizing wordcount would measure its own split() loop instead.
+
+Reducer flags mirror examples/wordcount: sum is associative+commutative,
+and f(k, [v]) == v, so the singleton fast path is sound.
+"""
+
+from __future__ import annotations
+
+import random
+import zlib
+
+_STATE: dict = {}
+
+associative_reducer = True
+commutative_reducer = True
+idempotent_reducer = True
+
+
+def init(args) -> None:
+    n_jobs = int(args.get("n_jobs", 24))
+    vocab = int(args.get("vocab", 30000))
+    seed = int(args.get("seed", 0))
+    _STATE["parts"] = int(args.get("partitions", 4))
+    rng = random.Random(seed)
+    words = [f"word{i:06d}" for i in range(vocab)]
+    _STATE["jobs"] = {
+        str(j): [(w, rng.randint(1, 50)) for w in words]
+        for j in range(n_jobs)
+    }
+
+
+def taskfn(emit) -> None:
+    for k in _STATE["jobs"]:
+        emit(k, 0)
+
+
+def mapfn(key, value, emit) -> None:
+    for w, c in _STATE["jobs"][key]:
+        emit(w, c)
+
+
+def partitionfn(key) -> int:
+    # stable across processes (hash() is salted per interpreter; two legs
+    # must partition identically for the byte-compare to mean anything)
+    return zlib.crc32(key.encode()) % _STATE["parts"]
+
+
+def reducefn(key, values):
+    return sum(values)
+
+
+def expected_total() -> int:
+    """Sum of every count in the corpus — the cross-leg sanity oracle."""
+    return sum(c for pairs in _STATE["jobs"].values() for _, c in pairs)

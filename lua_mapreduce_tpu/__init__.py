@@ -60,7 +60,7 @@ __all__ = [
 
 def utest():
     """Run every module's self-test (reference mapreduce/test.lua:30-39)."""
-    from lua_mapreduce_tpu.core import heap, merge, serialize
+    from lua_mapreduce_tpu.core import heap, merge, segment, serialize
     from lua_mapreduce_tpu.coord import jobstore, persistent_table
     from lua_mapreduce_tpu.engine import contract, premerge, server, worker
     from lua_mapreduce_tpu.store import memfs, router
@@ -70,7 +70,8 @@ def utest():
     # where any jax compute would initialize — and hang on — a wedged
     # accelerator tunnel; jax-computing modules (ops/*) self-test under
     # the cpu-pinned pytest conftest instead (tests/test_q8.py etc.)
-    for mod in (tuples, heap, serialize, merge, jobstore, memfs, contract,
-                router, persistent_table, stats, premerge, worker, server):
+    for mod in (tuples, heap, serialize, segment, merge, jobstore, memfs,
+                contract, router, persistent_table, stats, premerge, worker,
+                server):
         if hasattr(mod, "utest"):
             mod.utest()

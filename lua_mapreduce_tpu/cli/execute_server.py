@@ -78,6 +78,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "control-plane round trip and commit them in one "
                         "batch (many-small-jobs amortization; workers "
                         "still shrink long-job leases to 1 adaptively)")
+    p.add_argument("--segment-format", choices=("v1", "v2"), default="v1",
+                   help="intermediate spill encoding, written to the task "
+                        "doc as the fleet default: v1 = JSON text lines, "
+                        "v2 = framed binary segments (block-compressed, "
+                        "CRC-guarded, ranged reads; docs/DESIGN.md §17). "
+                        "Readers sniff per file, final results stay v1")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -121,7 +127,8 @@ def main(argv=None) -> int:
                     pipeline=args.pipeline,
                     premerge_min_runs=args.premerge_min_runs,
                     premerge_max_runs=args.premerge_max_runs,
-                    batch_k=args.batch_k).configure(spec)
+                    batch_k=args.batch_k,
+                    segment_format=args.segment_format).configure(spec)
 
     for _ in range(args.inline_workers):
         w = Worker(store).configure(max_iter=10_000)

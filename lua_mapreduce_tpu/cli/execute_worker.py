@@ -27,6 +27,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="claim up to K jobs per control-plane round trip "
                         "(batch lease); default follows the task "
                         "document's server-deployed batch_k")
+    p.add_argument("--segment-format", choices=("v1", "v2"), default=None,
+                   help="spill encoding THIS worker writes (default: "
+                        "follow the task document's fleet default); pin "
+                        "v1 on hosts that must stay text-only during a "
+                        "rollout — readers sniff per file either way")
     p.add_argument("--phases", default="map,reduce",
                    help="comma list of phases this worker claims "
                         "(heterogeneous pools: dedicated mapper hosts "
@@ -56,6 +61,8 @@ def main(argv=None) -> int:
         max_tasks=args.max_tasks, phases=phases, max_jobs=args.max_jobs)
     if args.batch_k is not None:
         worker.configure(batch_k=args.batch_k)
+    if args.segment_format is not None:
+        worker.configure(segment_format=args.segment_format)
     worker.execute()
     return 0
 

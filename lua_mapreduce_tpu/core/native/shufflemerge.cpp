@@ -26,6 +26,15 @@
 // shape), the merge emits ["key",[<sum>]] directly, fusing the reduce
 // into the merge pass. Any non-integer value or int64 overflow returns
 // rc=2 so the Python reducefn (arbitrary precision) stays the truth.
+//
+// Input files may independently be v1 JSON-line text OR v2 "JSEG0001"
+// framed binary segments (core/segment.py, DESIGN §17): the run cursor
+// sniffs the 8-byte magic and decodes frames LAZILY — one frame
+// (~256KB decoded, CRC-checked) at a time, raw or zlib-compressed
+// (zlib only when built with -DLMR_HAVE_ZLIB -lz; a compressed frame
+// without it returns rc=2 so the Python reader stays the truth).
+// Output stays v1 text: readers sniff per file, so a text spill merged
+// from binary segments is always valid.
 
 #include <cerrno>
 #include <cmath>
@@ -38,6 +47,10 @@
 #include <queue>
 #include <string>
 #include <vector>
+
+#ifdef LMR_HAVE_ZLIB
+#include <zlib.h>
+#endif
 
 namespace {
 
@@ -308,6 +321,39 @@ const char* span_end(const char* p) {
     return p;
 }
 
+// ---- JSEG0001 segment decoding --------------------------------------------
+
+const char SEG_MAGIC[8] = {'J', 'S', 'E', 'G', '0', '0', '0', '1'};
+
+// CRC-32 (zlib polynomial) over the DECODED frame payload — implemented
+// locally so raw-codec segments verify even in a zlib-less build
+uint32_t crc32_ieee(const unsigned char* p, size_t n) {
+    static uint32_t table[256];
+    static bool init = false;
+    if (!init) {
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; ++k)
+                c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+            table[i] = c;
+        }
+        init = true;
+    }
+    uint32_t c = 0xFFFFFFFFu;
+    for (size_t i = 0; i < n; ++i)
+        c = table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+uint32_t le32(const unsigned char* p) {
+    return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+           ((uint32_t)p[3] << 24);
+}
+
+uint64_t le64(const unsigned char* p) {
+    return (uint64_t)le32(p) | ((uint64_t)le32(p + 4) << 32);
+}
+
 // ---- run-file cursor ------------------------------------------------------
 
 struct Run {
@@ -318,9 +364,115 @@ struct Run {
     std::string vals_raw;       // raw contents INSIDE the values [ ... ]
     bool ok = false;
 
+    // segment state (v2 inputs); text inputs keep seg=false
+    bool seg = false;
+    uint64_t frame_off = 0;     // next frame header offset
+    uint64_t frames_end = 0;    // first byte past the data region
+    std::string dbuf;           // decoded-but-unconsumed payload bytes
+    size_t dpos = 0;
+
+    // Sniff the open file: position it for text getline, or arm the
+    // frame decoder. Returns 0 ok, 1 open failure, 2 malformed segment.
+    int arm() {
+        if (!f.is_open()) return 1;
+        char head[8];
+        f.read(head, 8);
+        if (f.gcount() == 8 && memcmp(head, SEG_MAGIC, 8) == 0) {
+            f.clear();
+            f.seekg(0, std::ios::end);
+            uint64_t size = (uint64_t)f.tellg();
+            if (size < 32) return 2;             // magic + 24-byte trailer
+            unsigned char tr[24];
+            f.seekg((std::streamoff)(size - 24));
+            f.read(reinterpret_cast<char*>(tr), 24);
+            if (f.gcount() != 24 || memcmp(tr + 16, SEG_MAGIC, 8) != 0)
+                return 2;
+            frames_end = le64(tr);
+            if (frames_end < 8 || frames_end > size) return 2;
+            seg = true;
+            frame_off = 8;
+            f.clear();
+            f.seekg(8);
+            return 0;
+        }
+        f.clear();
+        f.seekg(0);
+        return 0;
+    }
+
+    // Decode the next frame into dbuf. 0 ok, 1 no more frames, 2 error.
+    int load_frame() {
+        if (frame_off >= frames_end) return 1;
+        unsigned char hdr[13];
+        f.seekg((std::streamoff)frame_off);
+        f.read(reinterpret_cast<char*>(hdr), 13);
+        if (f.gcount() != 13) return 2;
+        uint32_t enc = le32(hdr), dec = le32(hdr + 4);
+        unsigned codec = hdr[8];
+        uint32_t crc = le32(hdr + 9);
+        if (frame_off + 13 + enc > frames_end) return 2;
+        std::string data(enc, '\0');
+        f.read(&data[0], (std::streamsize)enc);
+        if ((uint32_t)f.gcount() != enc) return 2;
+        std::string payload;
+        if (codec == 0) {
+            payload.swap(data);
+        } else if (codec == 1) {
+#ifdef LMR_HAVE_ZLIB
+            payload.resize(dec);
+            uLongf dlen = dec;
+            if (uncompress(reinterpret_cast<Bytef*>(&payload[0]), &dlen,
+                           reinterpret_cast<const Bytef*>(data.data()),
+                           enc) != Z_OK || dlen != dec)
+                return 2;
+#else
+            return 2;           // compressed frame, zlib-less build
+#endif
+        } else {
+            return 2;           // lz4 (and anything newer): Python owns it
+        }
+        if (payload.size() != dec ||
+            crc32_ieee(reinterpret_cast<const unsigned char*>(
+                           payload.data()), payload.size()) != crc)
+            return 2;
+        // keep any half-consumed tail (payloads end in '\n', so this is
+        // defensive only) and swap the decoded frame in
+        dbuf.erase(0, dpos);
+        dbuf += payload;
+        dpos = 0;
+        frame_off += 13 + (uint64_t)enc;
+        return 0;
+    }
+
+    // 0 = line loaded, 1 = eof, 2 = error — the getline analog that
+    // serves both formats (frames decode lazily, one at a time)
+    int next_line() {
+        if (!seg)
+            return std::getline(f, line) ? 0 : 1;
+        while (true) {
+            size_t nl = dbuf.find('\n', dpos);
+            if (nl != std::string::npos) {
+                line.assign(dbuf, dpos, nl - dpos);
+                dpos = nl + 1;
+                return 0;
+            }
+            int st = load_frame();
+            if (st == 2) return 2;
+            if (st == 1) {
+                if (dpos < dbuf.size()) {        // unterminated tail
+                    line.assign(dbuf, dpos, std::string::npos);
+                    dpos = dbuf.size();
+                    return 0;
+                }
+                return 1;
+            }
+        }
+    }
+
     // 0 = record loaded, 1 = eof, 2 = parse error
     int advance() {
-        while (std::getline(f, line)) {
+        int st;
+        while ((st = next_line()) == 0) {
             size_t b = line.find_first_not_of(" \t\r\n");
             if (b == std::string::npos) continue;       // skip blank lines
             const char* p = line.c_str();
@@ -344,7 +496,7 @@ struct Run {
             return 0;
         }
         ok = false;
-        return 1;
+        return st;              // 1 eof, 2 frame/decode error
     }
 };
 
@@ -407,15 +559,16 @@ int smerge_core(const char** inputs, int n_inputs, const char* output,
     runs.reserve((size_t)n_inputs);
     for (int i = 0; i < n_inputs; ++i) {
         Run* r = new Run();
-        r->f.open(inputs[i]);
-        runs.push_back(r);
+        r->f.open(inputs[i], std::ios::binary);   // segments are binary;
+        runs.push_back(r);                        // getline is \n-framed
     }
     int rc = 0;
     {
         std::priority_queue<int, std::vector<int>, HeapCmp> heap(
             HeapCmp{&runs});
         for (int i = 0; i < n_inputs && rc == 0; ++i) {
-            if (!runs[(size_t)i]->f.is_open()) { rc = 1; break; }
+            rc = runs[(size_t)i]->arm();          // sniff v1 text vs v2 seg
+            if (rc) break;
             int st = runs[(size_t)i]->advance();
             if (st == 0) heap.push(i);
             else if (st == 2) rc = 2;

@@ -26,31 +26,51 @@ def _src_digest(src: str) -> str:
         return hashlib.sha256(f.read()).hexdigest()
 
 
-def _build(src: str, so: str) -> Optional[str]:
+def _build(src: str, so: str, extra_flags=()) -> Optional[str]:
     digest_file = so + ".src.sha256"
     digest = _src_digest(src)
+    variants = (tuple(extra_flags), ()) if extra_flags else ((),)
+    # the digest file records WHICH flag variant built the cached .so
+    # ("<sha> <flags>"); a cache built with the degraded bare variant is
+    # retried with the preferred flags once per process, so installing
+    # the optional library (e.g. zlib) upgrades the .so instead of the
+    # old fallback being served forever
+    cached_flags = None
     if os.path.exists(so):
         try:
             with open(digest_file) as f:
-                if f.read().strip() == digest:
+                rec = f.read().strip().split(None, 1)
+            if rec and rec[0] == digest:
+                cached_flags = tuple((rec[1] if len(rec) > 1 else "").split())
+                if cached_flags == variants[0]:
                     return so
         except OSError:
             pass
-    try:
-        # compile to a tmp name + atomic rename: a concurrent builder in
-        # another process must never load a half-written .so
-        tmp = f"{so}.tmp.{os.getpid()}"
-        subprocess.run(["g++", "-O2", "-fPIC", "-shared", "-o", tmp, src],
-                       check=True, capture_output=True, timeout=120)
-        os.replace(tmp, so)
-        with open(digest_file, "w") as f:
-            f.write(digest)
-        return so
-    except (OSError, subprocess.SubprocessError):
-        return None
+    # extra_flags are OPTIONAL capabilities (e.g. -DLMR_HAVE_ZLIB -lz for
+    # compressed-segment decode): try with them first, retry bare when
+    # the host lacks the library — the source gates the capability on
+    # the macro, so the bare build degrades features, not correctness
+    for flags in variants:
+        if flags == cached_flags:
+            return so           # this variant is exactly the cached .so
+        try:
+            # compile to a tmp name + atomic rename: a concurrent builder
+            # in another process must never load a half-written .so
+            tmp = f"{so}.tmp.{os.getpid()}"
+            subprocess.run(["g++", "-O2", "-fPIC", "-shared", "-o", tmp,
+                            src, *flags],
+                           check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+            with open(digest_file, "w") as f:
+                f.write(f"{digest} {' '.join(flags)}".rstrip())
+            return so
+        except (OSError, subprocess.SubprocessError):
+            continue
+    return None
 
 
-def load_native(src: str, so: str) -> Optional[ctypes.CDLL]:
+def load_native(src: str, so: str,
+                extra_flags=()) -> Optional[ctypes.CDLL]:
     """Build (if stale/absent) and load ``src`` as ``so``; None on any
     failure. Caches per-process: one compile attempt per .so path.
 
@@ -67,7 +87,7 @@ def load_native(src: str, so: str) -> Optional[ctypes.CDLL]:
     with _lock:
         if so in _cache:
             return _cache[so]
-        path = _build(src, so)
+        path = _build(src, so, extra_flags)
         lib = None
         if path is not None:
             try:

@@ -35,7 +35,10 @@ _SO = os.path.join(_NATIVE_DIR, "libshufflemerge.so")
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    lib = load_native(_SRC, _SO)
+    # zlib is an optional capability: with it the C++ pass decodes
+    # compressed v2 segment frames; without it those runs fall back to
+    # the Python reader (rc=2), raw-codec segments still decode natively
+    lib = load_native(_SRC, _SO, extra_flags=("-DLMR_HAVE_ZLIB", "-lz"))
     if lib is not None and not hasattr(lib.smerge_files, "_configured"):
         for fn in (lib.smerge_files, lib.smerge_fold_sum):
             fn.restype = ctypes.c_int

@@ -17,6 +17,7 @@ from __future__ import annotations
 import functools
 import json
 import re
+from math import isfinite
 from typing import Any, Iterable, List, Tuple as PyTuple
 
 from lua_mapreduce_tpu.core import tuples
@@ -45,12 +46,25 @@ def dump_record(key: Any, values: Iterable[Any]) -> str:
                 parts.append(str(v))
             elif tv is str and not _NEEDS_ESCAPE.search(v):
                 parts.append(f'"{v}"')
+            elif tv is float and isfinite(v):
+                # json.dumps emits float.__repr__ for finite floats, so
+                # repr() is byte-identical; inf/nan fall back to the slow
+                # path (json spells them Infinity/NaN, repr does not)
+                parts.append(repr(v))
             else:
                 break
         else:
             return f'["{key}",[{",".join(parts)}]]'
     return json.dumps([_plain(key), [_plain(v) for v in values]],
                       separators=(",", ":"), ensure_ascii=False)
+
+
+def dump_key(key: Any) -> str:
+    """Serialized JSON of a record KEY alone — byte-identical to the key
+    portion of :func:`dump_record`'s output. Segment footers index frames
+    by their first key in this form (core/segment.py)."""
+    return json.dumps(_plain(key), separators=(",", ":"),
+                      ensure_ascii=False)
 
 
 def load_record(line: str) -> PyTuple[Any, List[Any]]:
@@ -165,6 +179,13 @@ def utest() -> None:
     """Self-test (reference utils.lua:340-406 exercises serialization)."""
     line = dump_record("word", [1, 2, 3])
     assert load_record(line) == ("word", [1, 2, 3])
+
+    # float fast path: byte-identical to json.dumps; specials fall back
+    for vals in ([1.5, -0.0, 2.5e-8], [1, "a", 3.25], [float("inf")],
+                 [float("nan")]):
+        assert dump_record("k", vals) == json.dumps(
+            ["k", vals], separators=(",", ":"), ensure_ascii=False)
+    assert dump_key(("a", 1)) == '["a",1]'
 
     k, vs = load_record(dump_record(tuples.intern((1, "a")), [[2, 3]]))
     assert k is tuples.intern((1, "a"))

@@ -20,6 +20,7 @@ from lua_mapreduce_tpu.core.merge import merge_iterator
 from lua_mapreduce_tpu.core.native_merge import (native_merge_records,
                                                  native_merge_reduce_sum,
                                                  native_premerge)
+from lua_mapreduce_tpu.core.segment import check_format, writer_for
 from lua_mapreduce_tpu.core.serialize import (assert_serializable, dump_record,
                                               sorted_keys)
 from lua_mapreduce_tpu.engine.contract import TaskSpec
@@ -90,14 +91,21 @@ def map_output_name(result_ns: str, part: int, map_key: Any) -> str:
 
 
 def run_map_job(spec: TaskSpec, store: Store, job_id: str,
-                map_key: Any, map_value: Any) -> JobTimes:
+                map_key: Any, map_value: Any,
+                segment_format: str = "v1") -> JobTimes:
     """Execute one map job and write per-partition sorted run files.
 
     Mirrors job.lua:154-228: run user mapfn with the grouping emit; sort
     keys; apply combiner per key; route keys through partitionfn; write one
     atomic file per non-empty partition; remove any stale file first (the
     re-run / iteration case, job.lua:217-221).
+
+    ``segment_format`` picks the run-file encoding — ``"v1"`` text lines
+    or ``"v2"`` framed binary segments (core/segment.py) — negotiated via
+    the task document; readers sniff per file, so mixed formats in one
+    namespace are always valid.
     """
+    check_format(segment_format)
     times = JobTimes(started=time.time())
     cpu0 = time.process_time()
 
@@ -120,25 +128,33 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
     spec.mapfn(map_key, map_value, emit)
     times.finished = time.time()
 
-    builders: Dict[int, Any] = {}
-    for key in sorted_keys(result.keys()):
-        values = result[key]
-        if combiner is not None and len(values) > 1:
-            values = [combiner(key, values)]
-        for v in values:
-            assert_serializable(v, f"map value for key {key!r}")
-        part = int(spec.partitionfn(key))
-        if part < 0:
-            raise ValueError(f"partitionfn({key!r}) returned negative {part}")
-        b = builders.get(part)
-        if b is None:
-            b = builders[part] = store.builder()
-        b.write(dump_record(key, values) + "\n")
+    writers: Dict[int, Any] = {}
+    try:
+        for key in sorted_keys(result.keys()):
+            values = result[key]
+            if combiner is not None and len(values) > 1:
+                values = [combiner(key, values)]
+            for v in values:
+                assert_serializable(v, f"map value for key {key!r}")
+            part = int(spec.partitionfn(key))
+            if part < 0:
+                raise ValueError(
+                    f"partitionfn({key!r}) returned negative {part}")
+            w = writers.get(part)
+            if w is None:
+                w = writers[part] = writer_for(store, segment_format)
+            w.add(key, values)
 
-    for part, b in builders.items():
-        name = map_output_name(spec.result_ns, part, job_id)
-        store.remove(name)
-        b.build(name)
+        for part, w in writers.items():
+            name = map_output_name(spec.result_ns, part, job_id)
+            store.remove(name)
+            w.build(name)
+    finally:
+        # deterministic release of any unbuilt builder (failed user code
+        # / partitionfn): writer threads, fds, and tempfiles must not
+        # wait for GC on a long-lived elastic worker
+        for w in writers.values():
+            w.close()
 
     times.cpu = time.process_time() - cpu0
     times.written = time.time()
@@ -146,7 +162,8 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
 
 
 def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
-                     spill_file: str) -> JobTimes:
+                     spill_file: str,
+                     segment_format: str = "v1") -> JobTimes:
     """Eagerly consolidate committed sorted runs into one spill run —
     the pipelined-shuffle work unit (engine/premerge.py).
 
@@ -158,6 +175,7 @@ def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
     (claim lost to a stale requeue): an existing spill short-circuits to
     a sweep of any leftover inputs.
     """
+    check_format(segment_format)
     times = JobTimes(started=time.time())
     cpu0 = time.process_time()
     if store.exists(spill_file):
@@ -173,15 +191,21 @@ def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
         raise RuntimeError(
             f"pre_merge {spill_file}: {len(missing)} input run(s) missing "
             f"with no spill published: {missing[:3]}")
+    # the native single-pass merge publishes a TEXT spill regardless of
+    # the negotiated format (readers sniff per file, so that is always
+    # valid); the Python path emits the negotiated format
     if not native_premerge(store, run_files, spill_file):
-        builder = store.builder()
-        merged = native_merge_records(store, run_files)
-        if merged is None:
-            merged = merge_iterator(store, run_files)
-        for key, values in merged:
-            builder.write(dump_record(key, values) + "\n")
-        store.remove(spill_file)
-        builder.build(spill_file)
+        writer = writer_for(store, segment_format)
+        try:
+            merged = native_merge_records(store, run_files)
+            if merged is None:
+                merged = merge_iterator(store, run_files)
+            for key, values in merged:
+                writer.add(key, values)
+            store.remove(spill_file)
+            writer.build(spill_file)
+        finally:
+            writer.close()
     times.finished = time.time()
     for name in run_files:
         store.remove(name)
@@ -226,24 +250,30 @@ def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
             store.remove(name)
         return times
 
+    # final partition results stay v1 TEXT in every segment-format mode:
+    # finalfn iterators, golden byte-compares, and downstream consumers
+    # of result files are format-invariants of this engine
     builder = result_store.builder()
-    # native C++ single-pass merge when the runs are local files (shared
-    # backend); identical groups to the Python heap merge — golden-diffed
-    # in tests/test_native_merge.py
-    merged = native_merge_records(store, run_files)
-    if merged is None:
-        merged = merge_iterator(store, run_files)
-    for key, values in merged:
-        if fast and len(values) == 1:
-            reduced = values[0]
-        else:
-            reduced = reducefn(key, values)
-        assert_serializable(reduced, f"reduce value for key {key!r}")
-        builder.write(dump_record(key, [reduced]) + "\n")
-    times.finished = time.time()
+    try:
+        # native C++ single-pass merge when the runs are local files
+        # (shared backend); identical groups to the Python heap merge —
+        # golden-diffed in tests/test_native_merge.py
+        merged = native_merge_records(store, run_files)
+        if merged is None:
+            merged = merge_iterator(store, run_files)
+        for key, values in merged:
+            if fast and len(values) == 1:
+                reduced = values[0]
+            else:
+                reduced = reducefn(key, values)
+            assert_serializable(reduced, f"reduce value for key {key!r}")
+            builder.write(dump_record(key, [reduced]) + "\n")
+        times.finished = time.time()
 
-    result_store.remove(result_file)
-    builder.build(result_file)
+        result_store.remove(result_file)
+        builder.build(result_file)
+    finally:
+        builder.close()
     times.cpu = time.process_time() - cpu0
     times.written = time.time()
 

@@ -115,7 +115,7 @@ class LocalExecutor:
     def __init__(self, spec: TaskSpec, map_parallelism: int = 1,
                  max_iterations: int = 1000, pipeline: bool = False,
                  premerge_min_runs: int = 4, premerge_max_runs: int = 8,
-                 batch_k: int = 1):
+                 batch_k: int = 1, segment_format: str = "v1"):
         self.spec = spec
         self.map_parallelism = max(1, map_parallelism)
         self.max_iterations = max_iterations
@@ -129,6 +129,10 @@ class LocalExecutor:
         # executed back-to-back, one future per lease instead of per
         # job. Semantics (and output bytes) are identical either way.
         self.batch_k = max(1, int(batch_k))
+        # intermediate spill encoding (DESIGN §17): "v2" packs runs into
+        # framed binary segments; results stay v1 text either way
+        from lua_mapreduce_tpu.core.segment import check_format
+        self.segment_format = check_format(segment_format)
         self.store = get_storage_from(spec.storage)
         self.result_store = (get_storage_from(spec.result_storage)
                              if spec.result_storage else self.store)
@@ -167,8 +171,9 @@ class LocalExecutor:
             it_stats.reduce.fold(reduce_times)
         else:
             map_times = self._run_jobs([
-                (lambda k=k, v=v, i=i: run_map_job(spec, self.store, str(i),
-                                                   k, v))
+                (lambda k=k, v=v, i=i: run_map_job(
+                    spec, self.store, str(i), k, v,
+                    segment_format=self.segment_format))
                 for i, (k, v) in enumerate(jobs)])
             it_stats.map.fold(map_times)
 
@@ -219,7 +224,8 @@ class LocalExecutor:
 
         def premerge_one(sp):
             try:
-                t = run_premerge_job(spec, self.store, sp.files, sp.name)
+                t = run_premerge_job(spec, self.store, sp.files, sp.name,
+                                     segment_format=self.segment_format)
             except Exception as e:
                 with lock:
                     pre_failed[0] += 1
@@ -235,7 +241,8 @@ class LocalExecutor:
                 tracker.spill_done(sp.part, sp.seq)
 
         def map_one(i, k, v):
-            t = run_map_job(spec, self.store, str(i), k, v)
+            t = run_map_job(spec, self.store, str(i), k, v,
+                            segment_format=self.segment_format)
             produced = {}
             for name in self.store.list(
                     f"{spec.result_ns}.P*.M{map_keys[i]}"):

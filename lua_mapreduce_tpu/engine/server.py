@@ -84,7 +84,8 @@ class Server:
                  stale_timeout_s: Optional[float] = 600.0,
                  verbose: bool = False, strict: bool = False,
                  pipeline: bool = False, premerge_min_runs: int = 4,
-                 premerge_max_runs: int = 8, batch_k: int = 1):
+                 premerge_max_runs: int = 8, batch_k: int = 1,
+                 segment_format: str = "v1"):
         self.store = store
         self.poll_interval = poll_interval
         self.stale_timeout_s = stale_timeout_s
@@ -101,6 +102,14 @@ class Server:
         # stale-requeue treats each leased job independently, so the
         # knob trades only round trips, never recoverability.
         self.batch_k = max(1, int(batch_k))
+        # intermediate spill encoding (DESIGN §17): "v1" text lines or
+        # "v2" framed binary segments. Written to the task document;
+        # every worker whose own segment_format is unset follows it, so
+        # one server-side knob rolls a fleet over. Readers sniff per
+        # file — final results stay v1 text in both modes — so the knob
+        # is free of crash-consistency ties (unlike the shuffle mode).
+        from lua_mapreduce_tpu.core.segment import check_format
+        self.segment_format = check_format(segment_format)
         self.spec: Optional[TaskSpec] = None
         self.stats = TaskStats()
         self.finished_value: Any = None
@@ -182,11 +191,14 @@ class Server:
                 # on the doc marker, so a doc that predates it must not
                 # leave published pre_merge jobs unclaimable
                 self.pipeline = bool(task.get("pipeline", self.pipeline))
-                # batch_k is a perf knob with no crash-consistency tie
-                # to on-disk state (unlike the shuffle mode), so the
-                # resuming server's configuration wins over the doc's
-                self.store.update_task({"pipeline": self.pipeline,
-                                        "batch_k": self.batch_k})
+                # batch_k / segment_format are perf knobs with no
+                # crash-consistency tie to on-disk state (readers sniff
+                # spill formats per file; unlike the shuffle mode), so
+                # the resuming server's configuration wins over the doc's
+                self.store.update_task({
+                    "pipeline": self.pipeline,
+                    "batch_k": self.batch_k,
+                    "segment_format": self.segment_format})
                 if status == TaskStatus.REDUCE.value:
                     skip_map = True
         if self.spec is None:
@@ -203,6 +215,9 @@ class Server:
                 # the fleet's default claim-lease size; workers with no
                 # explicit batch_k of their own follow this
                 "batch_k": self.batch_k,
+                # the fleet's spill encoding (workers with no explicit
+                # segment_format follow this; readers sniff per file)
+                "segment_format": self.segment_format,
                 "started": time.time(),
             })
 
@@ -589,9 +604,10 @@ def utest() -> None:
         assert it.map.count == 3 and it.map.failed == 0
         assert it.reduce.count == 1 and it.reduce.failed == 0
 
-        # pipelined-shuffle leg: same task, eager pre-merge enabled —
-        # result must be identical (premerge count depends on worker
-        # timing, so only the invariants are asserted)
+        # pipelined-shuffle leg: same task, eager pre-merge enabled AND
+        # v2 framed segments negotiated through the task doc — result
+        # must be identical (premerge count depends on worker timing,
+        # so only the invariants are asserted)
         mod.result = None
         store2 = MemJobStore()
         spec2 = TaskSpec(taskfn="_server_utest_mod",
@@ -601,7 +617,8 @@ def utest() -> None:
                          finalfn="_server_utest_mod",
                          storage="mem:_server_utest_pipe")
         server2 = Server(store2, poll_interval=0.01, pipeline=True,
-                         premerge_min_runs=2).configure(spec2)
+                         premerge_min_runs=2,
+                         segment_format="v2").configure(spec2)
         w2 = Worker(store2).configure(max_iter=400, max_sleep=0.02)
         t2 = threading.Thread(target=w2.execute, daemon=True)
         t2.start()

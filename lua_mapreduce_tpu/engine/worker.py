@@ -35,7 +35,7 @@ PRE_NS = "pre_jobs"     # eager pre-merge jobs, published DURING the map
                         # phase by a pipelined server (engine/premerge.py)
 
 _CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases",
-                "heartbeat_s", "batch_k", "batch_lease_s")
+                "heartbeat_s", "batch_k", "batch_lease_s", "segment_format")
 
 # EWMA smoothing for the observed per-job duration that drives adaptive
 # batch sizing (recent jobs dominate: a phase whose jobs suddenly get big
@@ -79,6 +79,14 @@ class Worker:
         # batch wide while long jobs degrade to k=1 and stay stealable.
         self.batch_k = None
         self.batch_lease_s = 5.0
+        # intermediate spill encoding (DESIGN §17): None = follow the
+        # task document's segment_format (the server-deployed fleet
+        # default); an explicit "v1"/"v2" wins — which is how a
+        # mixed-fleet member (an old v1-only host) is emulated and how
+        # one worker is pinned during a rollout. READERS always sniff
+        # per file, so any mix of formats in one namespace is valid.
+        self.segment_format = None
+        self._task_segment_format = None        # last task doc's value
         self._dur_ewma: Dict[str, float] = {}   # ns -> smoothed real secs
         self._spec_cache: Dict[str, TaskSpec] = {}
         self._affinity: list = []       # map-job ids this worker ran before
@@ -93,6 +101,10 @@ class Worker:
             if k not in _CONFIG_KEYS:
                 raise KeyError(f"unknown worker config key {k!r}; "
                                f"known: {_CONFIG_KEYS}")
+            if k == "segment_format" and v is not None:
+                # fail at configure time, not as a per-job failure storm
+                from lua_mapreduce_tpu.core.segment import check_format
+                check_format(v)
             setattr(self, k, v)
         return self
 
@@ -111,6 +123,7 @@ class Worker:
 
         spec = self._get_spec(task["spec"])
         iteration = int(task.get("iteration", 1))
+        self._task_segment_format = task.get("segment_format")
 
         if task["status"] == TaskStatus.MAP.value:
             if "map" in self.phases:
@@ -222,10 +235,16 @@ class Worker:
     # -- job bodies (the per-namespace work; control flow lives in
     # _execute_batch) --------------------------------------------------------
 
+    def _segment_format(self) -> str:
+        """The spill encoding this worker writes: its own override, else
+        the task document's fleet default, else v1."""
+        return self.segment_format or self._task_segment_format or "v1"
+
     def _map_body(self, spec: TaskSpec, job: dict):
         store = get_storage_from(spec.storage)
         return run_map_job(spec, store, str(job["_id"]), job["key"],
-                           job["value"])
+                           job["value"],
+                           segment_format=self._segment_format())
 
     def _premerge_body(self, spec: TaskSpec, job: dict):
         """Consolidate committed runs into a spill (pipelined shuffle).
@@ -234,7 +253,8 @@ class Worker:
         the spill short-circuits there instead of failing."""
         store = get_storage_from(spec.storage)
         v = job["value"]
-        return run_premerge_job(spec, store, v["files"], v["spill"])
+        return run_premerge_job(spec, store, v["files"], v["spill"],
+                                segment_format=self._segment_format())
 
     def _reduce_body(self, spec: TaskSpec, job: dict):
         store = get_storage_from(spec.storage)

@@ -5,13 +5,43 @@ a file-builder factory, and a lines-iterator factory (fs.lua:185-208,
 255-257). Here a single :class:`Store` object carries all three roles:
 ``builder()`` (atomic writer), ``lines()`` (streaming reader), plus
 list/remove/exists.
+
+The v2 shuffle data plane (core/segment.py, DESIGN §17) adds a RAW-BYTES
+surface: ``FileBuilder.write_bytes`` on the write side, ``Store.read_range``
+/ ``Store.size`` on the read side, so framed binary segments move through
+few large ranged reads instead of per-line text iteration. All three
+bundled backends implement it natively; the base class carries a TEXT-SHIM
+fallback (bytes ↔ str via latin-1, which maps bytes 0-255 onto code points
+0-255 losslessly) so any third-party Store that stores written strings
+verbatim keeps working unmodified. The shim is NOT safe for stores that
+newline-translate or re-encode text on the way to disk — those must
+override the three methods (as sharedfs/objectfs do).
 """
 
 from __future__ import annotations
 
 import abc
 import fnmatch
-from typing import Iterator, List
+from typing import Iterator, List, Sequence, Union
+
+
+def encode_chunks(chunks: Sequence[Union[str, bytes]]) -> bytes:
+    """Flatten a mixed str/bytes chunk list to bytes, encoding runs of
+    text in one pass (str chunks arrive one-per-record on the hot write
+    path; encoding them individually would pay per-record)."""
+    out: List[bytes] = []
+    strs: List[str] = []
+    for c in chunks:
+        if isinstance(c, str):
+            strs.append(c)
+        else:
+            if strs:
+                out.append("".join(strs).encode("utf-8"))
+                strs = []
+            out.append(c)
+    if strs:
+        out.append("".join(strs).encode("utf-8"))
+    return b"".join(out)
 
 
 class FileBuilder(abc.ABC):
@@ -28,6 +58,25 @@ class FileBuilder(abc.ABC):
     @abc.abstractmethod
     def build(self, name: str) -> None:
         """Atomically publish the accumulated content as ``name``."""
+
+    def write_bytes(self, data: bytes) -> None:
+        """Append raw bytes (segment frames). Default TEXT SHIM: latin-1
+        maps every byte to the same-ordinal code point, so stores that
+        keep written strings verbatim round-trip losslessly through
+        ``Store.read_range``'s matching shim."""
+        self.write(data.decode("latin-1"))
+
+    def close(self) -> None:
+        """Release resources of an UNBUILT builder (failed producer).
+        Idempotent; a no-op after ``build``. Default: nothing to release
+        (in-memory builders); file-backed builders override to stop
+        writer threads, close fds, and unlink tempfiles."""
+
+    def __enter__(self) -> "FileBuilder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class Store(abc.ABC):
@@ -55,6 +104,31 @@ class Store(abc.ABC):
     @abc.abstractmethod
     def remove(self, name: str) -> None:
         """Delete ``name`` if present (idempotent)."""
+
+    # -- raw-bytes surface (v2 segments; DESIGN §17) -----------------------
+
+    def read_range(self, name: str, offset: int, length: int) -> bytes:
+        """``length`` bytes of ``name`` starting at ``offset`` (short read
+        at EOF). Default TEXT SHIM: materializes the whole file through
+        ``lines`` and slices — functional for verbatim-string stores,
+        O(file) per call; real backends override with seek+read / ranged
+        GET."""
+        return self._shim_bytes(name)[offset:offset + length]
+
+    def size(self, name: str) -> int:
+        """Total byte size of ``name`` (segment readers locate the
+        trailer with it). Default text shim, same caveats as
+        :meth:`read_range`."""
+        return len(self._shim_bytes(name))
+
+    def _shim_bytes(self, name: str) -> bytes:
+        data = "".join(self.lines(name))
+        try:
+            return data.encode("latin-1")   # inverse of the write shim
+        except UnicodeEncodeError:
+            # code points >255 ⇒ genuine text (v1 JSON with raw unicode,
+            # ensure_ascii=False), never shim-written segment bytes
+            return data.encode("utf-8")
 
     # -- shared helpers ----------------------------------------------------
 

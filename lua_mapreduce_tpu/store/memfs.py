@@ -3,27 +3,42 @@
 The GridFS analog (SURVEY.md §7 step 3): on TPU VMs intermediate shuffle data
 stays in host DRAM; this is the default backend and the fastest. Thread-safe
 so an in-process elastic worker pool can share it.
+
+Files are stored as ``str`` (text builds — v1 runs, results) or ``bytes``
+(raw builds — v2 segments); the raw-bytes surface serves both, encoding
+text on demand, so format sniffing and mixed-format namespaces work
+exactly as on the file-backed stores.
 """
 
 from __future__ import annotations
 
 import io
-import threading
-from typing import Dict, Iterator, List
+from typing import Dict, Iterator, List, Union
 
-from lua_mapreduce_tpu.store.base import FileBuilder, Store
+import threading
+
+from lua_mapreduce_tpu.store.base import FileBuilder, Store, encode_chunks
 
 
 class _MemBuilder(FileBuilder):
     def __init__(self, store: "MemStore"):
         self._store = store
-        self._buf = io.StringIO()
+        self._chunks: List[Union[str, bytes]] = []
+        self._any_bytes = False
 
     def write(self, data: str) -> None:
-        self._buf.write(data)
+        self._chunks.append(data)
+
+    def write_bytes(self, data: bytes) -> None:
+        self._chunks.append(data)
+        self._any_bytes = True
 
     def build(self, name: str) -> None:
-        data = self._buf.getvalue()
+        data: Union[str, bytes]
+        if self._any_bytes:
+            data = encode_chunks(self._chunks)
+        else:
+            data = "".join(self._chunks)
         with self._store._lock:
             self._store._files[name] = data
 
@@ -32,7 +47,7 @@ class MemStore(Store):
     """Dict-of-files store; ``build`` swaps content in atomically."""
 
     def __init__(self):
-        self._files: Dict[str, str] = {}
+        self._files: Dict[str, Union[str, bytes]] = {}
         self._lock = threading.Lock()
 
     def builder(self) -> FileBuilder:
@@ -41,7 +56,20 @@ class MemStore(Store):
     def lines(self, name: str) -> Iterator[str]:
         with self._lock:
             data = self._files[name]
+        if isinstance(data, bytes):
+            data = data.decode("utf-8")     # binary segments fail loudly
         return iter(io.StringIO(data))
+
+    def read_range(self, name: str, offset: int, length: int) -> bytes:
+        return self._bytes(name)[offset:offset + length]
+
+    def size(self, name: str) -> int:
+        return len(self._bytes(name))
+
+    def _bytes(self, name: str) -> bytes:
+        with self._lock:
+            data = self._files[name]
+        return data if isinstance(data, bytes) else data.encode("utf-8")
 
     def list(self, pattern: str) -> List[str]:
         with self._lock:
@@ -70,6 +98,15 @@ def utest() -> None:
     assert list(s.lines("f.P0")) == ["x 1\n", "y 2\n"]
     assert s.list("f.P*") == ["f.P0"]
     assert s.list("g.*") == []
+    assert s.read_range("f.P0", 2, 3) == b"1\ny"
+    assert s.size("f.P0") == 8
     s.remove("f.P0")
     assert not s.exists("f.P0")
     s.remove("f.P0")                     # remove-if-exists, no raise
+
+    # raw-bytes builds coexist with text files in one namespace
+    b = s.builder()
+    b.write_bytes(b"\x00\xffbin")
+    b.build("g.bin")
+    assert s.read_range("g.bin", 0, 5) == b"\x00\xffbin"
+    assert s.size("g.bin") == 5

@@ -9,6 +9,12 @@ this image — zero egress); otherwise a bucket is emulated as a local
 directory with strict object semantics: whole-object PUT (no append, no
 rename visible to readers) and GET, which is exactly GCS's contract.
 
+Ranged reads (v2 segments, DESIGN §17) map 1:1 onto the object contract:
+``read_range`` is a ranged GET (``download_as_bytes(start=,end=)``) and
+``size`` comes from object metadata — this is precisely the access
+pattern FaaSTube-style batched transfers want from an object store,
+replacing the whole-object GET + per-line split of the v1 text path.
+
 URI forms accepted: ``object:/abs/dir``, ``object:relative/dir``,
 ``object:gs://bucket/prefix`` (real GCS only).
 """
@@ -17,9 +23,9 @@ from __future__ import annotations
 
 import os
 import tempfile
-from typing import Iterator, List
+from typing import Iterator, List, Union
 
-from lua_mapreduce_tpu.store.base import FileBuilder, Store
+from lua_mapreduce_tpu.store.base import FileBuilder, Store, encode_chunks
 from lua_mapreduce_tpu.store.sharedfs import (FLUSH_BYTES, READ_BUFFER,
                                               _decode, _encode)
 
@@ -30,14 +36,15 @@ class _ObjectBuilder(FileBuilder):
     Writes batch in memory and hit the staging tempfile in ~1MB chunks
     (the line-at-a-time ``f.write`` per record was a syscall per record),
     keeping the object contract untouched: readers only ever see the
-    single atomic PUT in ``build``.
+    single atomic PUT in ``build``. The staging file is binary so text
+    records and raw segment frames share one path.
     """
 
     def __init__(self, store: "ObjectStore"):
         self._store = store
         fd, self._tmp = tempfile.mkstemp(prefix="objfs.")
-        self._f = os.fdopen(fd, "w")
-        self._chunks = []
+        self._f = os.fdopen(fd, "wb")
+        self._chunks: List[Union[str, bytes]] = []
         self._size = 0
         self._built = False
 
@@ -47,9 +54,15 @@ class _ObjectBuilder(FileBuilder):
         if self._size >= FLUSH_BYTES:
             self._drain()
 
+    def write_bytes(self, data: bytes) -> None:
+        self._chunks.append(data)
+        self._size += len(data)
+        if self._size >= FLUSH_BYTES:
+            self._drain()
+
     def _drain(self) -> None:
         if self._chunks:
-            self._f.write("".join(self._chunks))
+            self._f.write(encode_chunks(self._chunks))
             self._chunks, self._size = [], 0
 
     def build(self, name: str) -> None:
@@ -60,16 +73,21 @@ class _ObjectBuilder(FileBuilder):
         os.remove(self._tmp)
         self._built = True
 
+    def close(self) -> None:
+        """Release an unbuilt builder: close the fd, drop the staging
+        file. Idempotent; no-op after ``build``."""
+        if not self._f.closed:
+            self._f.close()
+        if not self._built:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+
     def __del__(self):
-        """Abandoned builder: close the fd and drop the staging file."""
+        """GC backstop for builders nobody closed."""
         try:
-            if not self._f.closed:
-                self._f.close()
-            if not getattr(self, "_built", False):
-                try:
-                    os.unlink(self._tmp)
-                except OSError:
-                    pass
+            self.close()
         except Exception:
             pass
 
@@ -92,7 +110,8 @@ class ObjectStore(Store):
             self._dir = uri
             os.makedirs(uri, exist_ok=True)
 
-    # -- object primitives (PUT/GET/LIST/DELETE only — no rename/append) ---
+    # -- object primitives (PUT/GET/ranged GET/LIST/DELETE — no rename or
+    # append) ---------------------------------------------------------------
 
     def _put(self, name: str, data: bytes) -> None:
         if self._gcs is not None:
@@ -132,6 +151,32 @@ class ObjectStore(Store):
         data = self._get(name).decode()          # real GCS: whole-object GET
         for line in data.splitlines(keepends=True):
             yield line
+
+    def read_range(self, name: str, offset: int, length: int) -> bytes:
+        if length <= 0:
+            return b""
+        if self._gcs is not None:
+            # ranged GET; GCS's end is INCLUSIVE. Past-EOF starts raise
+            # RequestRangeNotSatisfiable — normalize to the POSIX
+            # short-read contract the segment reader expects
+            try:
+                return self._gcs.blob(self._key(name)).download_as_bytes(
+                    start=offset, end=offset + length - 1)
+            except Exception:
+                if offset >= self.size(name):
+                    return b""
+                raise
+        with open(os.path.join(self._dir, _encode(name)), "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    def size(self, name: str) -> int:
+        if self._gcs is not None:
+            blob = self._gcs.get_blob(self._key(name))
+            if blob is None:
+                raise FileNotFoundError(name)
+            return int(blob.size)
+        return os.path.getsize(os.path.join(self._dir, _encode(name)))
 
     def list(self, pattern: str) -> List[str]:
         if self._gcs is not None:

@@ -8,6 +8,11 @@ same tmp+rename discipline as fs.lua:80-115.
 
 File names may contain ``/`` — they are flattened with an escape so one task
 namespace maps onto one flat directory (keeps glob listing trivial and safe).
+
+Builders run in BINARY mode internally (text chunks encode to utf-8 at
+flush, exactly what the old TextIOWrapper did per flush), which is what
+lets ``write_bytes`` interleave raw segment frames with text through one
+tempfile; ``read_range``/``size`` are plain seek+read/stat.
 """
 
 from __future__ import annotations
@@ -17,9 +22,9 @@ import os
 import queue
 import tempfile
 import threading
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Union
 
-from lua_mapreduce_tpu.store.base import FileBuilder, Store
+from lua_mapreduce_tpu.store.base import FileBuilder, Store, encode_chunks
 
 # read/flush granularity: k-way merges used to pay a syscall per ~8KB
 # default buffer; 1MB batches make both sides of the shuffle IO chunky
@@ -46,13 +51,19 @@ class _DirBuilder(FileBuilder):
     deferred write error, then keeps the fs.lua:80-115 durability
     discipline: flush → fsync → atomic rename. Small files (< one flush
     batch) never pay the thread: their single chunk is written inline.
+
+    A builder abandoned before ``build`` (the producing job raised) must
+    be released with :meth:`close` — explicitly, via the context-manager
+    form, or (backstop only) by GC — so the writer thread, the fd, and
+    the ``.tmp.`` file never outlive the failure on a long-lived elastic
+    worker.
     """
 
     def __init__(self, store: "SharedStore"):
         self._store = store
         fd, self._tmp = tempfile.mkstemp(dir=store.path, prefix=".tmp.")
-        self._f = os.fdopen(fd, "w")
-        self._chunks: List[str] = []
+        self._f = os.fdopen(fd, "wb")
+        self._chunks: List[Union[str, bytes]] = []
         self._size = 0
         self._q: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
@@ -65,16 +76,24 @@ class _DirBuilder(FileBuilder):
         if self._size >= FLUSH_BYTES:
             self._flush_async()
 
+    def write_bytes(self, data: bytes) -> None:
+        self._chunks.append(data)
+        self._size += len(data)
+        if self._size >= FLUSH_BYTES:
+            self._flush_async()
+
     def _flush_async(self) -> None:
         if self._err_box:
             raise self._err_box[0]
-        chunk, self._chunks, self._size = "".join(self._chunks), [], 0
+        chunk = encode_chunks(self._chunks)
+        self._chunks, self._size = [], 0
         if self._thread is None:
             # bounded queue: a slow disk backpressures the producer at
             # ~4MB in flight instead of buffering the whole file. The
             # thread closes over (q, f, err_box) — NOT the builder — so
-            # an abandoned builder stays collectable and __del__ can
-            # shut the thread down instead of leaking it blocked in get()
+            # an abandoned builder stays collectable and close()/__del__
+            # can shut the thread down instead of leaking it blocked in
+            # get()
             self._q = queue.Queue(maxsize=4)
             self._thread = threading.Thread(
                 target=_writer_loop, args=(self._q, self._f, self._err_box),
@@ -85,13 +104,13 @@ class _DirBuilder(FileBuilder):
     def build(self, name: str) -> None:
         if self._thread is not None:
             if self._chunks:
-                self._q.put("".join(self._chunks))
+                self._q.put(encode_chunks(self._chunks))
                 self._chunks, self._size = [], 0
             self._q.put(None)
             self._thread.join()
             self._thread = None
         elif self._chunks:
-            self._f.write("".join(self._chunks))
+            self._f.write(encode_chunks(self._chunks))
             self._chunks, self._size = [], 0
         if self._err_box:
             raise self._err_box[0]
@@ -101,22 +120,29 @@ class _DirBuilder(FileBuilder):
         os.replace(self._tmp, os.path.join(self._store.path, _encode(name)))
         self._built = True
 
+    def close(self) -> None:
+        """Release an unbuilt builder: stop the writer thread, close the
+        fd, drop the ``.tmp.`` file. Idempotent; no-op after ``build``.
+        The deterministic form of what ``__del__`` could only do at GC
+        time — job runners call it on their failure paths."""
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join(timeout=5.0)
+        self._thread = None
+        if not self._f.closed:
+            self._f.close()
+        if not self._built:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+
     def __del__(self):
-        """Abandoned builder (the producing job raised before build):
-        stop the writer thread, close the fd, drop the .tmp. file — a
-        long-lived elastic worker retrying failing jobs must not
-        accumulate stuck threads/fds/orphan tempfiles."""
+        """GC backstop for builders nobody closed — a long-lived elastic
+        worker retrying failing jobs must not accumulate stuck
+        threads/fds/orphan tempfiles even if a caller forgot close()."""
         try:
-            if self._thread is not None and self._thread.is_alive():
-                self._q.put(None)
-                self._thread.join(timeout=5.0)
-            if not self._f.closed:
-                self._f.close()
-            if not self._built:
-                try:
-                    os.unlink(self._tmp)
-                except OSError:
-                    pass
+            self.close()
         except Exception:
             pass
 
@@ -151,6 +177,14 @@ class SharedStore(Store):
         with open(os.path.join(self.path, _encode(name)),
                   buffering=READ_BUFFER) as f:
             yield from f
+
+    def read_range(self, name: str, offset: int, length: int) -> bytes:
+        with open(os.path.join(self.path, _encode(name)), "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    def size(self, name: str) -> int:
+        return os.path.getsize(os.path.join(self.path, _encode(name)))
 
     def local_path(self, name: str) -> str:
         """POSIX path of ``name`` — lets native code (the C++ shuffle

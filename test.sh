@@ -13,4 +13,11 @@ python -c "import lua_mapreduce_tpu; lua_mapreduce_tpu.utest(); print('utest: al
 # --continue-on-collection-errors run that still reports green dots
 python -m pytest tests/ --collect-only -q > /dev/null
 echo "collect gate: tests/ collects cleanly"
+# segment conformance under BOTH merge engines: the v1/v2 interop +
+# fuzz suite runs once with the native C++ pass (built on demand) and
+# once forced onto the pure-Python data plane — mixed-format runs,
+# mixed fleets, and frame decode must agree byte-for-byte in both
+python -m pytest tests/test_segment.py -q
+LMR_DISABLE_NATIVE=1 python -m pytest tests/test_segment.py -q
+echo "segment conformance: python + native merge engines agree"
 python -m pytest tests/ -q --full

@@ -65,6 +65,51 @@ def test_batch_k_flags_parse():
     assert args.batch_k == 8 and args.max_jobs == 40
 
 
+def test_segment_format_flags_parse():
+    """The spill-encoding knob on both launchers: server flag = fleet
+    default (task doc), worker flag = explicit per-host pin (None =
+    follow the doc); bogus values are rejected at parse time."""
+    import pytest
+
+    args = execute_server.build_parser().parse_args(
+        ["mem", "a", "b", "c", "d", "--segment-format", "v2"])
+    assert args.segment_format == "v2"
+    args = execute_server.build_parser().parse_args(
+        ["mem", "a", "b", "c", "d"])
+    assert args.segment_format == "v1"
+    args = execute_worker.build_parser().parse_args(["/tmp/x"])
+    assert args.segment_format is None
+    args = execute_worker.build_parser().parse_args(
+        ["/tmp/x", "--segment-format", "v1"])
+    assert args.segment_format == "v1"
+    with pytest.raises(SystemExit):
+        execute_server.build_parser().parse_args(
+            ["mem", "a", "b", "c", "d", "--segment-format", "v3"])
+
+
+def test_execute_server_segment_v2_end_to_end(capsys):
+    """End-to-end through the server CLI with --segment-format v2:
+    inline workers pick the format up from the task document and the
+    result matches the naive oracle (results themselves stay v1)."""
+    import examples.wordcount.finalfn as finalfn
+    finalfn.counts.clear()
+    rc = execute_server.main([
+        "mem",
+        "examples.wordcount.taskfn",
+        "examples.wordcount.mapfn",
+        "examples.wordcount.partitionfn",
+        "examples.wordcount.reducefn",
+        "--finalfn", "examples.wordcount.finalfn",
+        "--inline-workers", "2",
+        "--poll", "0.02",
+        "--segment-format", "v2",
+        "--init-arg", f"files={os.pathsep.join(CORPUS)}",
+        "--quiet",
+    ])
+    assert rc == 0
+    assert dict(finalfn.counts) == naive_wordcount(CORPUS)
+
+
 def test_execute_server_batched_inline_workers(tmp_path, capsys):
     """End-to-end through the server CLI with --batch-k: inline workers
     inherit the lease size from the task document and the result still

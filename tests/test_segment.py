@@ -1,0 +1,456 @@
+"""Framed binary spill segments (JSEG0001, core/segment.py): format
+round-trip, the store raw-bytes surface, v1 ↔ v2 interop (mixed runs,
+mixed fleets), fuzz/property equivalence of the two data planes, and the
+Python ↔ native merge golden diff over segments.
+
+Run under BOTH merge engines (test.sh): once natively, once with
+LMR_DISABLE_NATIVE=1 — the conformance matrix of DESIGN §17.
+"""
+
+import json
+import random
+import sys
+import types
+import zlib
+
+import pytest
+
+from lua_mapreduce_tpu.core import tuples
+from lua_mapreduce_tpu.core.merge import merge_iterator
+from lua_mapreduce_tpu.core.segment import (FRAME_BYTES, SegmentWriter,
+                                            open_segment, record_stream,
+                                            writer_for)
+from lua_mapreduce_tpu.core.serialize import (dump_key, dump_record, key_lt,
+                                              sorted_keys)
+from lua_mapreduce_tpu.store.memfs import MemStore
+from lua_mapreduce_tpu.store.objectfs import ObjectStore
+from lua_mapreduce_tpu.store.sharedfs import SharedStore
+
+
+def _backends(tmp_path):
+    return {
+        "mem": MemStore(),
+        "shared": SharedStore(str(tmp_path / "shared")),
+        "object": ObjectStore(str(tmp_path / "object")),
+    }
+
+
+# ---------------------------------------------------------------------------
+# format round-trip
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", ["mem", "shared", "object"])
+@pytest.mark.parametrize("codec", ["raw", "zlib"])
+def test_segment_roundtrip(tmp_path, backend, codec):
+    store = _backends(tmp_path)[backend]
+    recs = [(f"key{i:05d}", [i, f"v{i}", [i, i + 1]]) for i in range(2000)]
+    w = SegmentWriter(store.builder(), codec=codec, frame_bytes=4096)
+    for k, v in recs:
+        w.add(k, v)
+    w.build("runs.P0.M1")
+
+    r = open_segment(store, "runs.P0.M1")
+    assert r is not None
+    assert r.records == len(recs)
+    assert len(r.frames) > 1              # multi-frame at this frame size
+    assert r.frames[0][3] == dump_key(recs[0][0])
+    got = list(r.iter_records())
+    assert got == recs
+    # the format-agnostic stream serves the same records
+    assert list(record_stream(store, "runs.P0.M1")) == recs
+
+
+@pytest.mark.parametrize("backend", ["mem", "shared", "object"])
+def test_raw_bytes_surface(tmp_path, backend):
+    """write_bytes / read_range / size on every bundled backend."""
+    store = _backends(tmp_path)[backend]
+    b = store.builder()
+    payload = bytes(range(256)) * 5
+    b.write_bytes(payload)
+    b.build("blob")
+    assert store.size("blob") == len(payload)
+    assert store.read_range("blob", 0, 8) == payload[:8]
+    assert store.read_range("blob", 300, 10) == payload[300:310]
+    # short read at EOF, POSIX-style
+    assert store.read_range("blob", len(payload) - 4, 100) == payload[-4:]
+
+
+def test_text_shim_default_surface():
+    """A Store subclass with ONLY the text methods still serves segments
+    through the base-class latin-1 shim (third-party backend path)."""
+    from lua_mapreduce_tpu.store.base import FileBuilder, Store
+
+    class _ShimStore(Store):
+        def __init__(self):
+            self.files = {}
+
+        def builder(self):
+            outer = self
+
+            class _B(FileBuilder):
+                def __init__(self):
+                    self.parts = []
+
+                def write(self, data):
+                    self.parts.append(data)
+
+                def build(self, name):
+                    outer.files[name] = "".join(self.parts)
+            return _B()
+
+        def lines(self, name):
+            return iter(self.files[name].splitlines(keepends=True))
+
+        def list(self, pattern):
+            return self._match(self.files, pattern)
+
+        def exists(self, name):
+            return name in self.files
+
+        def remove(self, name):
+            self.files.pop(name, None)
+
+    store = _ShimStore()
+    recs = [(f"k{i}", [i]) for i in range(50)]
+    w = writer_for(store, "v2")           # rides the write_bytes shim
+    for k, v in recs:
+        w.add(k, v)
+    w.build("seg")
+    assert list(record_stream(store, "seg")) == recs
+    # and v1 text through the same shim store still sniffs as text
+    w = writer_for(store, "v1")
+    w.add("a", [1])
+    w.build("txt")
+    assert open_segment(store, "txt") is None
+    assert list(record_stream(store, "txt")) == [("a", [1])]
+
+
+def test_corrupt_frame_detected(tmp_path):
+    store = MemStore()
+    w = writer_for(store, "v2")
+    for i in range(100):
+        w.add(f"k{i}", [i])
+    w.build("seg")
+    raw = store._files["seg"]
+    flip = 8 + 13 + 7                     # a payload byte of frame 0
+    store._files["seg"] = raw[:flip] + bytes([raw[flip] ^ 0xFF]) + raw[flip + 1:]
+    with pytest.raises((ValueError, zlib.error)):
+        list(open_segment(store, "seg").iter_records())
+
+
+def test_truncated_segment_detected():
+    store = MemStore()
+    w = writer_for(store, "v2")
+    for i in range(100):
+        w.add(f"k{i}", [i])
+    w.build("seg")
+    store._files["trunc"] = store._files["seg"][:-9]   # clip the trailer
+    with pytest.raises(ValueError):
+        open_segment(store, "trunc")
+
+
+def test_float_fast_path_byte_identity():
+    """Satellite: the dump_record fast path now covers finite floats and
+    must be byte-identical to the json.dumps slow path."""
+    rng = random.Random(0)
+    cases = [[0.0, -0.0, 1.5, 3.141592653589793, 1e-300, -2.5e17]]
+    for _ in range(200):
+        cases.append([rng.choice([
+            rng.random(), rng.uniform(-1e9, 1e9), float(rng.randint(0, 99)),
+            rng.randint(-100, 100), f"s{rng.randint(0, 9)}"])
+            for _ in range(rng.randint(0, 5))])
+    cases += [[float("inf")], [float("-inf")], [float("nan")], [True], [None]]
+    for values in cases:
+        fast = dump_record("k", values)
+        slow = json.dumps(["k", values], separators=(",", ":"),
+                          ensure_ascii=False)
+        assert fast == slow, (values, fast, slow)
+
+
+# ---------------------------------------------------------------------------
+# fuzz/property: v1 text ↔ v2 frames equivalence
+# ---------------------------------------------------------------------------
+
+def _random_key(rng, depth=0):
+    choices = ["int", "float", "str", "bool", "none"]
+    if depth < 2:
+        choices.append("tuple")
+    kind = rng.choice(choices)
+    if kind == "int":
+        return rng.randint(-10**12, 10**12)
+    if kind == "float":
+        return rng.uniform(-1e6, 1e6)
+    if kind == "str":
+        return "".join(rng.choice('abc XYZ0"\\\n\té漢')
+                       for _ in range(rng.randint(0, 8)))
+    if kind == "bool":
+        return rng.random() < 0.5
+    if kind == "none":
+        return None
+    return tuples.intern(tuple(_random_key(rng, depth + 1)
+                               for _ in range(rng.randint(0, 3))))
+
+
+def _random_value(rng, depth=0):
+    kind = rng.choice(["int", "float", "str", "bool", "none"] +
+                      (["list", "dict"] if depth < 2 else []))
+    if kind == "int":
+        return rng.randint(-10**9, 10**9)
+    if kind == "float":
+        return rng.uniform(-1e9, 1e9)
+    if kind == "str":
+        return "".join(rng.choice('ab"\\\n\t €deΩ')
+                       for _ in range(rng.randint(0, 10)))
+    if kind == "bool":
+        return rng.random() < 0.5
+    if kind == "none":
+        return None
+    if kind == "list":
+        return [_random_value(rng, depth + 1)
+                for _ in range(rng.randint(0, 4))]
+    return {f"f{i}": _random_value(rng, depth + 1)
+            for i in range(rng.randint(0, 3))}
+
+
+def _sorted_run(rng, n):
+    keys = []
+    seen = set()
+    while len(keys) < n:
+        k = _random_key(rng)
+        marker = dump_key(k)
+        if marker not in seen:            # run files hold unique keys
+            seen.add(marker)
+            keys.append(k)
+    return [(k, [_random_value(rng) for _ in range(rng.randint(1, 4))])
+            for k in sorted_keys(keys)]
+
+
+@pytest.mark.parametrize("backend", ["mem", "shared", "object"])
+def test_fuzz_v1_v2_identical_streams_and_merge(tmp_path, backend):
+    """Satellite: random heterogeneous records written through BOTH data
+    planes read back as identical (key, values) streams, and the k-way
+    merge over {all-v1} / {all-v2} / {mixed} run sets yields identical
+    groups on every backend."""
+    store = _backends(tmp_path)[backend]
+    rng = random.Random(hash(backend) & 0xFFFF)
+    runs = [_sorted_run(rng, rng.randint(5, 60)) for _ in range(5)]
+
+    for i, run in enumerate(runs):
+        for fmt in ("v1", "v2"):
+            w = SegmentWriter(store.builder(), frame_bytes=512) \
+                if fmt == "v2" else writer_for(store, "v1")
+            for k, v in run:
+                w.add(k, v)
+            w.build(f"{fmt}.run{i}")
+        # per-run stream equivalence (tuple keys come back interned)
+        a = list(record_stream(store, f"v1.run{i}"))
+        b = list(record_stream(store, f"v2.run{i}"))
+        assert a == b
+        assert [type(k) for k, _ in a] == [type(k) for k, _ in b]
+
+    names_v1 = [f"v1.run{i}" for i in range(len(runs))]
+    names_v2 = [f"v2.run{i}" for i in range(len(runs))]
+    mixed = [(names_v1[i] if i % 2 else names_v2[i])
+             for i in range(len(runs))]
+    m1 = list(merge_iterator(store, names_v1))
+    m2 = list(merge_iterator(store, names_v2))
+    mx = list(merge_iterator(store, mixed))
+    assert m1 == m2 == mx
+    # merged keys are strictly ascending in the canonical order
+    for (ka, _), (kb, _) in zip(m1, m1[1:]):
+        assert key_lt(ka, kb)
+
+
+def test_native_merge_golden_diff_over_segments(tmp_path):
+    """Python heap merge vs the C++ pass over v2 (zlib-framed) segments:
+    identical groups. Skips where the toolchain is absent or disabled."""
+    from lua_mapreduce_tpu.core import native_merge
+    if not native_merge.native_available():
+        pytest.skip("native merge unavailable (toolchain/LMR_DISABLE_NATIVE)")
+    store = SharedStore(str(tmp_path / "nat"))
+    rng = random.Random(42)
+    runs = [_sorted_run(rng, 40) for _ in range(4)]
+    names = []
+    for i, run in enumerate(runs):
+        w = SegmentWriter(store.builder(), frame_bytes=1024)
+        for k, v in run:
+            w.add(k, v)
+        w.build(f"seg{i}")
+        names.append(f"seg{i}")
+    py = list(merge_iterator(store, names))
+    nat = native_merge.native_merge_records(store, names)
+    if nat is None:
+        pytest.skip("native pass declined these records")
+    assert list(nat) == py
+
+
+# ---------------------------------------------------------------------------
+# engine interop: mixed formats, mixed fleets
+# ---------------------------------------------------------------------------
+
+def _wc_module(name):
+    mod = types.ModuleType(name)
+    corpus = {f"d{i}": " ".join(
+        random.Random(i).choice(["alpha", "beta", "gamma", "delta", "eps"])
+        for _ in range(200)) for i in range(8)}
+    mod.taskfn = lambda emit: [emit(k, v) for k, v in corpus.items()]
+
+    def mapfn(key, value, emit):
+        for w in value.split():
+            emit(w, 1)
+    mod.mapfn = mapfn
+    mod.partitionfn = lambda key: sum(key.encode()) % 3
+    mod.reducefn = lambda key, values: sum(values)
+    mod.associative_reducer = True
+    mod.commutative_reducer = True
+    sys.modules[name] = mod
+    return mod
+
+
+@pytest.mark.parametrize("backend", ["mem", "shared", "object"])
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_local_executor_v1_v2_byte_identical(tmp_path, backend, pipeline):
+    """Acceptance: final wordcount output byte-identical between the v1
+    and v2 data planes, per backend, under both shuffle modes."""
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.local import LocalExecutor
+
+    _wc_module("_seg_interop_wc")
+    outs = {}
+    for fmt in ("v1", "v2"):
+        storage = {
+            "mem": f"mem:_seg_ip_{pipeline}_{fmt}",
+            "shared": f"shared:{tmp_path}/sh_{pipeline}_{fmt}",
+            "object": f"object:{tmp_path}/ob_{pipeline}_{fmt}",
+        }[backend]
+        spec = TaskSpec(taskfn="_seg_interop_wc", mapfn="_seg_interop_wc",
+                        partitionfn="_seg_interop_wc",
+                        reducefn="_seg_interop_wc", storage=storage)
+        ex = LocalExecutor(spec, map_parallelism=2, pipeline=pipeline,
+                           premerge_min_runs=2, segment_format=fmt)
+        ex.run()
+        out = {}
+        for name in ex.result_store.list(f"{spec.result_ns}.P*"):
+            out[name] = "".join(ex.result_store.lines(name))
+        outs[fmt] = out
+    assert outs["v1"] == outs["v2"]
+    assert outs["v1"], "no result partitions produced"
+
+
+def test_reduce_over_mixed_format_runs(tmp_path):
+    """v1 writer + v2 reader and vice versa at the job level: one
+    partition whose runs were written by a v1 mapper AND a v2 mapper
+    reduces to the same bytes as the all-v1 and all-v2 cases."""
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.job import run_map_job, run_reduce_job
+
+    _wc_module("_seg_mixed_wc")
+    spec = TaskSpec(taskfn="_seg_mixed_wc", mapfn="_seg_mixed_wc",
+                    partitionfn="_seg_mixed_wc", reducefn="_seg_mixed_wc",
+                    storage="mem:_seg_mixed")
+    results = {}
+    for combo in (("v1", "v1"), ("v2", "v2"), ("v1", "v2"), ("v2", "v1")):
+        store = SharedStore(str(tmp_path / f"mix_{combo[0]}_{combo[1]}"))
+        jobs = []
+        sys.modules["_seg_mixed_wc"].taskfn(
+            lambda k, v: jobs.append((k, v)))
+        for i, (k, v) in enumerate(jobs):
+            run_map_job(spec, store, str(i), k, v,
+                        segment_format=combo[i % 2])
+        out = {}
+        for part in (0, 1, 2):
+            files = store.list(f"result.P{part}.M*")
+            if not files:
+                continue
+            run_reduce_job(spec, store, store, str(part), files,
+                           f"result.P{part}")
+            out[part] = "".join(store.lines(f"result.P{part}"))
+        results[combo] = out
+    assert len({json.dumps(v, sort_keys=True)
+                for v in results.values()}) == 1
+    assert results[("v1", "v1")], "no output produced"
+
+
+def test_mixed_fleet_v1_and_v2_workers(tmp_path):
+    """Acceptance: a v1-only worker and a v2 worker complete the same
+    task against one store — the task doc negotiates v2, one worker pins
+    v1, readers sniff per file."""
+    import threading
+
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.server import Server
+    from lua_mapreduce_tpu.engine.worker import Worker
+
+    _wc_module("_seg_fleet_wc")
+    spec = TaskSpec(taskfn="_seg_fleet_wc", mapfn="_seg_fleet_wc",
+                    partitionfn="_seg_fleet_wc", reducefn="_seg_fleet_wc",
+                    storage="mem:_seg_fleet")
+    store = MemJobStore()
+    server = Server(store, poll_interval=0.01, segment_format="v2",
+                    pipeline=True, premerge_min_runs=2).configure(spec)
+    w_old = Worker(store, name="v1-only").configure(
+        max_iter=600, max_sleep=0.02, segment_format="v1")
+    w_new = Worker(store, name="v2").configure(max_iter=600, max_sleep=0.02)
+    threads = [threading.Thread(target=w.execute, daemon=True)
+               for w in (w_old, w_new)]
+    for t in threads:
+        t.start()
+    server.loop()
+    for t in threads:
+        t.join(timeout=30)
+
+    from lua_mapreduce_tpu.engine.local import iter_results
+    from lua_mapreduce_tpu.store.router import get_storage_from
+    got = {k: v[0] for k, v in
+           iter_results(get_storage_from("mem:_seg_fleet"), "result")}
+    expect = {}
+    jobs = []
+    sys.modules["_seg_fleet_wc"].taskfn(lambda k, v: jobs.append((k, v)))
+    for _, text in jobs:
+        for w in text.split():
+            expect[w] = expect.get(w, 0) + 1
+    assert got == expect
+    assert w_old.jobs_executed + w_new.jobs_executed > 0
+
+
+def test_builder_close_releases_resources(tmp_path):
+    """Satellite: _DirBuilder.close() (and the context-manager form)
+    deterministically stops the async writer thread and removes the
+    tempfile of an abandoned builder — no reliance on GC."""
+    import os
+
+    store = SharedStore(str(tmp_path / "cl"))
+    b = store.builder()
+    b.write("x" * (2 << 20))              # > FLUSH_BYTES: thread starts
+    assert b._thread is not None and b._thread.is_alive()
+    b.close()
+    assert b._thread is None
+    assert b._f.closed
+    assert not any(f.startswith(".tmp.")
+                   for f in os.listdir(store.path))
+    b.close()                             # idempotent
+
+    with store.builder() as b2:
+        b2.write("abc\n")
+        tmp2 = b2._tmp
+        assert os.path.exists(tmp2)
+    assert not os.path.exists(tmp2)       # CM exit released it
+
+    # close() after build is a no-op and the file survives
+    b3 = store.builder()
+    b3.write("keep\n")
+    b3.build("kept")
+    b3.close()
+    assert list(store.lines("kept")) == ["keep\n"]
+
+
+def test_worker_rejects_bad_segment_format():
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+    from lua_mapreduce_tpu.engine.server import Server
+
+    with pytest.raises(ValueError):
+        Server(MemJobStore(), segment_format="v3")
+    from lua_mapreduce_tpu.engine.job import run_map_job
+    with pytest.raises(ValueError):
+        run_map_job(None, None, "0", "k", "v", segment_format="binary")
