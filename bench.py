@@ -251,6 +251,34 @@ def _coord_batch_fields() -> dict:
     return out
 
 
+def _analysis_fields() -> dict:
+    """Detail fields for the analysis subsystem (DESIGN §18): the lint
+    pass's wall time over the whole package (it gates test.sh, so its
+    cost is part of the developer loop) and a small exhaustive
+    model-checker run (2 workers × 2 jobs, death included) with its
+    state count — the protocol-coverage figure. Never sinks the
+    flagship metric."""
+    import time as _t
+    out = {}
+    try:
+        from lua_mapreduce_tpu.analysis import run_lint
+        t0 = _t.perf_counter()
+        findings = run_lint()
+        out["analyze_lint_wall_s"] = round(_t.perf_counter() - t0, 3)
+        out["analyze_lint_findings"] = len(findings)
+    except Exception as e:
+        out["analyze_lint_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        from lua_mapreduce_tpu.analysis import ModelConfig, check_protocol
+        res = check_protocol(ModelConfig(n_workers=2, n_jobs=2))
+        out["analyze_protocol_states"] = res.states
+        out["analyze_protocol_ok"] = res.ok
+        out["analyze_protocol_wall_s"] = round(res.wall_s, 3)
+    except Exception as e:
+        out["analyze_protocol_error"] = f"{type(e).__name__}: {e}"[:200]
+    return out
+
+
 def _committed_tpu_tail() -> dict:
     """VERDICT r4 item 8: when the live run falls back to CPU (wedged
     tunnel), the driver-captured JSON must still TRANSPORT the newest
@@ -351,6 +379,9 @@ def main() -> None:
         # v1 text lines (benchmarks/segment_bench.py; >1.0 = frames win
         # on the IO-bound shuffle leg, byte-identical outputs)
         **_segment_fields(),
+        # static analysis: lint wall time over the package + the
+        # exhaustive lease-protocol check's state coverage (DESIGN §18)
+        **_analysis_fields(),
     }
     if on_tpu and "lm_train_mfu" in lm:
         # VERDICT r4 weak-1: the first number a reader (or the driver

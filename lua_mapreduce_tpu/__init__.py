@@ -60,6 +60,7 @@ __all__ = [
 
 def utest():
     """Run every module's self-test (reference mapreduce/test.lua:30-39)."""
+    from lua_mapreduce_tpu import analysis
     from lua_mapreduce_tpu.core import heap, merge, segment, serialize
     from lua_mapreduce_tpu.coord import jobstore, persistent_table
     from lua_mapreduce_tpu.engine import contract, premerge, server, worker
@@ -72,6 +73,6 @@ def utest():
     # the cpu-pinned pytest conftest instead (tests/test_q8.py etc.)
     for mod in (tuples, heap, serialize, segment, merge, jobstore, memfs,
                 contract, router, persistent_table, stats, premerge, worker,
-                server):
+                server, analysis):
         if hasattr(mod, "utest"):
             mod.utest()

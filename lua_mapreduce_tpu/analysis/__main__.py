@@ -1,0 +1,160 @@
+"""``lmr-analyze``: the analysis CLI.
+
+    python -m lua_mapreduce_tpu.analysis [lint|protocol|all] [options]
+
+``lint`` runs the framework-aware rule registry over the package (or
+explicit paths); ``protocol`` exhaustively model-checks the lease
+lifecycle; ``all`` (the default) runs both.  Exit code 0 = clean; with
+``--fail-on-findings`` any surviving lint finding exits 1 (the CI
+gate); a protocol violation of the shipped model always exits 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+from lua_mapreduce_tpu.analysis import lint as lint_mod
+from lua_mapreduce_tpu.analysis import protocol as proto_mod
+
+
+def _cmd_lint(args) -> tuple:
+    findings = lint_mod.run_lint(args.paths or None,
+                                 baseline=args.baseline)
+    fail = bool(findings) and args.fail_on_findings
+    return findings, fail
+
+
+def _protocol_suite(args):
+    """The default exhaustive sweep: the full lifecycle with worker
+    death, then the failure path (release/mark-broken) on a smaller
+    box, then the seeded-race regressions (each MUST be re-found)."""
+    runs = []
+    base = proto_mod.ModelConfig(n_workers=args.workers, n_jobs=args.jobs,
+                                 batch_k=args.batch_k)
+    runs.append(("lifecycle+death", base))
+    runs.append(("failure-path", dataclasses.replace(
+        base, n_jobs=2, batch_k=min(args.batch_k, 2), allow_fail=True,
+        allow_death=False)))
+    if args.seed_bug:
+        bugs = [args.seed_bug]
+    else:
+        bugs = list(proto_mod.KNOWN_BUGS)
+    out = []
+    failed = False
+    for name, cfg in runs:
+        res = proto_mod.check_protocol(cfg)
+        entry = {"run": name, "config": dataclasses.asdict(cfg),
+                 "states": res.states, "transitions": res.transitions,
+                 "quiescent_states": res.quiescent,
+                 "wall_s": round(res.wall_s, 3), "ok": res.ok}
+        if not res.ok:
+            entry["violation"] = res.violation.message
+            entry["trace"] = [list(t) for t in res.violation.trace]
+            failed = True
+        out.append(entry)
+    for bug in bugs:
+        cfg = dataclasses.replace(base, bug=bug)
+        res = proto_mod.check_protocol(cfg)
+        entry = {"run": f"seeded:{bug}", "states": res.states,
+                 "wall_s": round(res.wall_s, 3),
+                 "found": not res.ok}
+        if res.ok:
+            entry["error"] = ("seeded bug NOT detected — the checker "
+                              "lost its teeth")
+            failed = True
+        else:
+            entry["violation"] = res.violation.message
+            entry["trace_len"] = len(res.violation.trace)
+        out.append(entry)
+    return {"protocol": out}, failed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m lua_mapreduce_tpu.analysis",
+        description="framework-aware lint + lease-protocol model checker")
+    ap.add_argument("command", nargs="?", default="all",
+                    choices=("all", "lint", "protocol", "rules"))
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--fail-on-findings", action="store_true",
+                    help="exit 1 when lint findings survive suppression")
+    ap.add_argument("--baseline", default=None,
+                    help="suppression file (default: analysis/baseline.json)")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--jobs", type=int, default=3)
+    ap.add_argument("--batch-k", type=int, default=2)
+    ap.add_argument("--seed-bug", default=None,
+                    choices=proto_mod.KNOWN_BUGS,
+                    help="restrict the seeded-race regression to one bug")
+    args = ap.parse_args(argv)
+
+    if args.command == "rules":
+        catalog = lint_mod.rule_catalog()
+        if args.format == "json":
+            print(json.dumps(catalog, indent=2))
+        else:
+            for r in catalog:
+                print(f"{r['id']} [{r['severity']}] "
+                      f"({', '.join(r['paths'])}): {r['title']}")
+                print(f"    {r['rationale']}")
+        return 0
+
+    payload = {}
+    findings = None
+    rc = 0
+    if args.command in ("all", "lint"):
+        findings, fail = _cmd_lint(args)
+        payload.update(lint_mod.report_dict(findings))
+        rc = max(rc, 1 if fail else 0)
+    if args.command in ("all", "protocol"):
+        try:
+            proto_payload, fail = _protocol_suite(args)
+        except ValueError as e:
+            # out-of-range --workers/--jobs/--batch-k is a usage error,
+            # not a protocol violation
+            ap.error(str(e))
+        except RuntimeError as e:
+            # an allowed-but-too-big box (3 workers × 4 jobs) exceeding
+            # the state cap is equally a usage problem — report it
+            # cleanly, don't traceback
+            ap.error(f"{e}; try fewer workers/jobs")
+        payload.update(proto_payload)
+        rc = max(rc, 1 if fail else 0)
+
+    if args.format == "json":
+        print(json.dumps(payload, indent=2))
+        return rc
+    if findings is not None:
+        if findings:
+            print(lint_mod.format_text(findings))
+        print(f"lint: {len(findings)} finding(s)")
+    for entry in payload.get("protocol", ()):
+        if entry["run"].startswith("seeded:"):
+            status = ("re-found: " + entry["violation"]
+                      if entry["found"] else "MISSED")
+            print(f"protocol {entry['run']}: {status} "
+                  f"[{entry['states']} states, {entry['wall_s']}s]")
+        else:
+            status = "ok" if entry["ok"] else \
+                f"VIOLATION: {entry['violation']}"
+            print(f"protocol {entry['run']}: {status} "
+                  f"[{entry['states']} states, {entry['transitions']} "
+                  f"transitions, {entry['quiescent_states']} quiescent, "
+                  f"{entry['wall_s']}s]")
+    return rc
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `... | head` closed the pipe: not an error. Point stdout at
+        # devnull so interpreter shutdown does not retry the flush.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,589 @@
+"""Exhaustive small-scope model checker for the lease protocol.
+
+The JobStore claim/commit/release/heartbeat/requeue/scavenge machine is
+the one part of the system whose correctness cannot be established by
+running it: the dangerous behaviors are interleavings, and the SIGKILL
+churn suites only sample them.  This module extracts that protocol into
+an explicit transition system and enumerates EVERY interleaving of a
+small configuration (2-3 workers × 2-4 jobs), checking safety
+invariants in each reached state:
+
+- **legal transitions** — every per-job status edge is one the protocol
+  defines (WAITING→RUNNING, RUNNING→{FINISHED,BROKEN,WAITING},
+  FINISHED→{WRITTEN,BROKEN}, BROKEN→{RUNNING,FAILED,BROKEN}; WRITTEN
+  and FAILED are terminal);
+- **repetitions monotone** — a retry counter never decreases;
+- **no double commit** — at most one successful commit per job, ever;
+- **commit ownership** — a commit lands only for the worker that holds
+  the job's CURRENT claim (the CAS the protocol relies on);
+- **no lost or stuck job** — in every quiescent state with a live
+  worker, every job is WRITTEN or FAILED (in particular: never parked
+  FINISHED+unclaimed, the kill-between-FINISHED-and-WRITTEN gap).
+
+Time is a deterministic VIRTUAL CLOCK: every lease carries an age that
+a global ``tick`` transition advances; at ``stale_age`` the lease is
+eligible for the scavenger's requeue, and a heartbeat resets it.  This
+makes "the worker went silent" an explicit, enumerable event instead of
+a sleep in a stress test.
+
+The model mirrors the shipped protocol operation-for-operation:
+``claim_batch`` (one atomic pass, lowest ids first, exactly like both
+index engines), the default two-step per-job commit
+(RUNNING→FINISHED→WRITTEN CASed on ownership, engine/jobstore.py
+``commit_batch``), the failure path (commit done prefix, release the
+unstarted tail without a repetition bump, mark the failing job BROKEN),
+batched heartbeats (live only while job bodies run — the worker's beat
+thread stops before the success-path commit but covers the
+failure-path one, mirroring Worker._execute_batch), stale requeue
+(RUNNING|FINISHED), scavenge (BROKEN with reps ≥ max_retries → FAILED),
+and worker death at ANY step.
+
+On a violation the checker returns the shortest trace (BFS), and
+:func:`replay_trace` replays it against a real ``MemJobStore`` /
+``FileJobStore``: a trace from the correct model reproduces
+step-for-step and lands in the same final state; a trace from a seeded
+bug model DIVERGES at the exact store operation whose CAS closes the
+race — which is the confirmation that the real protocol is guarded
+where the model says it must be.
+
+Seedable bugs (``ModelConfig(bug=...)``):
+
+- ``"commit_skips_owner_cas"`` — commit checks status but not
+  ownership: the historical commit-racing-scavenger-requeue race (a
+  stale worker retires a job the scavenger already handed to someone
+  else);
+- ``"requeue_ignores_finished"`` — the scavenger skips FINISHED
+  leases: a worker killed between its FINISHED and WRITTEN transitions
+  wedges the barrier forever.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from lua_mapreduce_tpu.core.constants import Status
+
+_WAIT = int(Status.WAITING)
+_RUN = int(Status.RUNNING)
+_BRK = int(Status.BROKEN)
+_FIN = int(Status.FINISHED)
+_WRI = int(Status.WRITTEN)
+_FAI = int(Status.FAILED)
+
+_ALLOWED_EDGES = {
+    _WAIT: {_RUN},
+    _RUN: {_FIN, _BRK, _WAIT},
+    _FIN: {_WRI, _BRK},
+    _BRK: {_RUN, _FAI, _BRK},
+    _WRI: set(),
+    _FAI: set(),
+}
+
+KNOWN_BUGS = ("commit_skips_owner_cas", "requeue_ignores_finished")
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    n_workers: int = 2
+    n_jobs: int = 3
+    batch_k: int = 2
+    max_retries: int = 2
+    stale_age: int = 1
+    allow_death: bool = True
+    allow_fail: bool = False
+    bug: Optional[str] = None
+
+    def __post_init__(self):
+        if not (1 <= self.n_workers <= 3 and 1 <= self.n_jobs <= 4):
+            raise ValueError("small-scope checker: ≤3 workers, ≤4 jobs")
+        if not (1 <= self.batch_k <= self.n_jobs):
+            raise ValueError(
+                f"batch_k={self.batch_k} must be in [1, n_jobs]: a k<1 "
+                "worker never claims, which quiesces with every job "
+                "WAITING and would read as a fake lost-job violation")
+        if self.max_retries < 1 or self.stale_age < 1:
+            raise ValueError("max_retries and stale_age must be ≥ 1")
+        if self.bug is not None and self.bug not in KNOWN_BUGS:
+            raise ValueError(f"unknown bug {self.bug!r}; known: "
+                             f"{KNOWN_BUGS}")
+
+
+# Job record: (status, reps, owner, age).  owner is 0 (none) or
+# worker-index+1; age counts virtual ticks since the last liveness
+# signal and saturates at stale_age.  Worker modes:
+#   ("I",)                                       idle (polling)
+#   ("D",)                                       dead
+#   ("R", leased, pos, done)                     executing job bodies
+#   ("C", leased, entries, i, phase, tail, brk)  committing entry i
+#   ("L", leased, tail, brk)                     releasing unstarted tail
+#   ("K", leased, brk)                           marking the failed job
+# brk is the failing job id (failure path) or -1 (clean commit).
+
+_IDLE = ("I",)
+_DEAD = ("D",)
+
+
+@dataclasses.dataclass
+class Violation:
+    message: str
+    trace: List[tuple]
+    state: tuple
+
+
+@dataclasses.dataclass
+class CheckResult:
+    config: ModelConfig
+    states: int
+    transitions: int
+    quiescent: int
+    wall_s: float
+    violation: Optional[Violation]
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+
+class LeaseModel:
+    """The transition system: enumerate and apply protocol steps."""
+
+    def __init__(self, config: ModelConfig):
+        self.cfg = config
+        self._rep_cap = config.max_retries + 1   # saturate: finite space
+
+    def initial(self) -> tuple:
+        jobs = tuple((_WAIT, 0, 0, 0) for _ in range(self.cfg.n_jobs))
+        workers = tuple(_IDLE for _ in range(self.cfg.n_workers))
+        commits = (0,) * self.cfg.n_jobs
+        return (jobs, workers, commits)
+
+    # -- per-transition effects (each is ONE atomic store op or one
+    # worker-local step, which is exactly the interleaving granularity
+    # the locks give the real system) -----------------------------------
+
+    def _sat(self, reps: int) -> int:
+        return min(reps, self._rep_cap)
+
+    def transitions(self, state: tuple) -> List[Tuple[tuple, tuple]]:
+        """[(label, next_state), ...] — every enabled step."""
+        jobs, workers, commits = state
+        out: List[Tuple[tuple, tuple]] = []
+        cfg = self.cfg
+
+        def repl_job(j, rec):
+            return tuple(rec if i == j else r for i, r in enumerate(jobs))
+
+        def repl_w(w, mode, njobs=None, ncommits=None):
+            nw = tuple(mode if i == w else m for i, m in enumerate(workers))
+            return ((jobs if njobs is None else njobs), nw,
+                    (commits if ncommits is None else ncommits))
+
+        for w, mode in enumerate(workers):
+            kind = mode[0]
+            if kind == "D":
+                continue
+            if cfg.allow_death:
+                out.append((("die", w), repl_w(w, _DEAD)))
+            if kind == "I":
+                claimable = [j for j, (s, _, _, _) in enumerate(jobs)
+                             if s in (_WAIT, _BRK)]
+                take = tuple(claimable[:cfg.batch_k])
+                if take:
+                    nj = list(jobs)
+                    for j in take:
+                        s, r, _, _ = nj[j]
+                        nj[j] = (_RUN, r, w + 1, 0)
+                    out.append((("claim", w, take),
+                                repl_w(w, ("R", take, 0, ()),
+                                       tuple(nj))))
+            elif kind == "R":
+                _, leased, pos, done = mode
+                j = leased[pos]
+                out.append((("exec", w, j),
+                            repl_w(w, self._norm(
+                                ("R", leased, pos + 1, done + (j,))))))
+                if cfg.allow_fail:
+                    out.append((("exec_fail", w, j),
+                                repl_w(w, self._norm(
+                                    ("C", leased, done, 0, 0,
+                                     leased[pos + 1:], j)))))
+            elif kind == "C":
+                _, leased, entries, i, phase, tail, brk = mode
+                j = entries[i]
+                s, r, o, a = jobs[j]
+                owner_ok = (o == w + 1) or \
+                    (cfg.bug == "commit_skips_owner_cas")
+                if phase == 0:
+                    ok = (s == _RUN) and owner_ok
+                    nj = repl_job(j, (_FIN, r, o, a)) if ok else jobs
+                    nmode = ("C", leased, entries, i, 1, tail, brk) if ok \
+                        else ("C", leased, entries, i + 1, 0, tail, brk)
+                    out.append((("commit_a", w, j, ok),
+                                repl_w(w, self._norm(nmode), nj)))
+                else:
+                    ok = (s == _FIN) and owner_ok
+                    nj = repl_job(j, (_WRI, r, o, a)) if ok else jobs
+                    nc = tuple(min(c + 1, 2) if ok and i2 == j else c
+                               for i2, c in enumerate(commits))
+                    nmode = ("C", leased, entries, i + 1, 0, tail, brk)
+                    out.append((("commit_b", w, j, ok),
+                                repl_w(w, self._norm(nmode), nj, nc)))
+            elif kind == "L":
+                _, leased, tail, brk = mode
+                nj = list(jobs)
+                released = []
+                for t in tail:
+                    s, r, o, a = nj[t]
+                    if s == _RUN and o == w + 1:
+                        nj[t] = (_WAIT, r, o, 0)   # no repetition bump
+                        released.append(t)
+                out.append((("release", w, tail, tuple(released)),
+                            repl_w(w, self._norm(("K", leased, brk)),
+                                   tuple(nj))))
+            elif kind == "K":
+                _, leased, brk = mode
+                s, r, o, a = jobs[brk]
+                # ownership AND still-RUNNING: a job the scavenger
+                # already requeued (BROKEN) or failed (FAILED) must not
+                # be touched — Worker._mark_broken carries the matching
+                # expect=(RUNNING,) CAS
+                ok = (o == w + 1) and s == _RUN
+                nj = repl_job(brk, (_BRK, self._sat(r + 1), o, 0)) \
+                    if ok else jobs
+                out.append((("mark_broken", w, brk, ok),
+                            repl_w(w, _IDLE, nj)))
+            # heartbeats: alive while job bodies run (R) and on the
+            # failure path (the except runs inside the _beating scope);
+            # the clean commit happens after the beat thread stopped
+            beating = (kind == "R") or (
+                kind == "C" and (brk_of(mode) >= 0 or tail_of(mode))) \
+                or kind in ("L", "K")
+            if beating:
+                leased = mode[1]
+                beaten = tuple(t for t in leased
+                               if jobs[t][0] in (_RUN, _FIN)
+                               and jobs[t][2] == w + 1)
+                if any(jobs[t][3] > 0 for t in beaten):
+                    nj = list(jobs)
+                    for t in beaten:
+                        s, r, o, _ = nj[t]
+                        nj[t] = (s, r, o, 0)
+                    out.append((("beat", w, beaten),
+                                (tuple(nj), workers, commits)))
+
+        # -- global (server/scavenger/clock) steps -----------------------
+        aged = [j for j, (s, _, _, a) in enumerate(jobs)
+                if s in (_RUN, _FIN) and a < self.cfg.stale_age]
+        if aged:
+            nj = list(jobs)
+            for j in aged:
+                s, r, o, a = nj[j]
+                nj[j] = (s, r, o, a + 1)
+            out.append((("tick",), (tuple(nj), workers, commits)))
+
+        requeue_from = (_RUN,) if self.cfg.bug == "requeue_ignores_finished" \
+            else (_RUN, _FIN)
+        stale = tuple(j for j, (s, _, _, a) in enumerate(jobs)
+                      if s in requeue_from and a >= self.cfg.stale_age)
+        if stale:
+            nj = list(jobs)
+            for j in stale:
+                s, r, o, a = nj[j]
+                nj[j] = (_BRK, self._sat(r + 1), o, 0)
+            out.append((("requeue", stale), (tuple(nj), workers, commits)))
+
+        failed = tuple(j for j, (s, r, _, _) in enumerate(jobs)
+                       if s == _BRK and r >= self.cfg.max_retries)
+        if failed:
+            nj = list(jobs)
+            for j in failed:
+                s, r, o, a = nj[j]
+                nj[j] = (_FAI, r, o, a)
+            out.append((("scavenge", failed), (tuple(nj), workers, commits)))
+        return out
+
+    @staticmethod
+    def _norm(mode: tuple) -> tuple:
+        """Collapse empty stages so every mode has a pending action."""
+        while True:
+            kind = mode[0]
+            if kind == "R" and mode[2] >= len(mode[1]):
+                mode = ("C", mode[1], mode[3], 0, 0, (), -1)
+            elif kind == "C" and mode[3] >= len(mode[2]):
+                _, leased, _, _, _, tail, brk = mode
+                mode = ("L", leased, tail, brk) if tail else \
+                    (("K", leased, brk) if brk >= 0 else _IDLE)
+            elif kind == "L" and not mode[2]:
+                mode = ("K", mode[1], mode[3])
+            elif kind == "K" and mode[2] < 0:
+                mode = _IDLE
+            else:
+                return mode
+
+    # -- invariants -----------------------------------------------------
+
+    def step_violation(self, old: tuple, new: tuple,
+                       label: tuple) -> Optional[str]:
+        ojobs, _, ocommits = old
+        njobs, _, ncommits = new
+        for j, ((os_, or_, oo, _), (ns_, nr, no, _)) in enumerate(
+                zip(ojobs, njobs)):
+            if nr < or_:
+                return (f"repetitions of job {j} decreased {or_}→{nr} "
+                        f"on {label}")
+            if ns_ != os_ and ns_ not in _ALLOWED_EDGES[os_]:
+                return (f"illegal status edge job {j}: "
+                        f"{Status(os_).name}→{Status(ns_).name} on {label}")
+        if label[0] == "commit_b" and label[3]:
+            w, j = label[1], label[2]
+            if ncommits[j] > 1:
+                return (f"double commit: job {j} committed twice "
+                        f"(worker {w} landed a second commit)")
+            if ojobs[j][2] != w + 1:
+                return (f"commit without ownership: worker {w} committed "
+                        f"job {j} currently claimed by worker "
+                        f"{ojobs[j][2] - 1} — the scavenger requeued and "
+                        "re-claimed it mid-commit")
+        return None
+
+    def quiescent_violation(self, state: tuple) -> Optional[str]:
+        jobs, workers, _ = state
+        if all(m[0] == "D" for m in workers):
+            return None              # a fully dead pool may strand work
+        bad = {j: Status(s).name for j, (s, _, _, _) in enumerate(jobs)
+               if s not in (_WRI, _FAI)}
+        if bad:
+            return (f"lost/stuck jobs at quiescence with a live worker: "
+                    f"{bad} (every job must end WRITTEN or FAILED; a "
+                    "FINISHED entry here is the stuck-FINISHED+unclaimed "
+                    "gap)")
+        return None
+
+
+def brk_of(mode: tuple) -> int:
+    return mode[6] if mode[0] == "C" else -1
+
+
+def tail_of(mode: tuple) -> tuple:
+    return mode[5] if mode[0] == "C" else ()
+
+
+def check_protocol(config: ModelConfig = ModelConfig(),
+                   max_states: int = 5_000_000) -> CheckResult:
+    """Exhaustively enumerate every reachable interleaving (BFS, so a
+    violation trace is shortest-possible) and check all invariants."""
+    model = LeaseModel(config)
+    t0 = _time.perf_counter()
+    init = model.initial()
+    visited = {init}
+    parents: Dict[tuple, Tuple[Optional[tuple], Optional[tuple]]] = {
+        init: (None, None)}
+    frontier = [init]
+    n_trans = 0
+    n_quiescent = 0
+
+    def trace_to(state, extra=None):
+        labels = []
+        cur = state
+        while True:
+            prev, label = parents[cur]
+            if prev is None:
+                break
+            labels.append(label)
+            cur = prev
+        labels.reverse()
+        if extra is not None:
+            labels.append(extra)
+        return labels
+
+    while frontier:
+        next_frontier = []
+        for state in frontier:
+            trans = model.transitions(state)
+            # quiescence means no PROGRESS is possible; a worker death
+            # is an environment event, not progress — a state whose
+            # only enabled step is "somebody could still die" is
+            # already stuck, and must pass the lost-job invariant
+            if all(label[0] == "die" for label, _ in trans):
+                n_quiescent += 1
+                msg = model.quiescent_violation(state)
+                if msg:
+                    return CheckResult(config, len(visited), n_trans,
+                                       n_quiescent,
+                                       _time.perf_counter() - t0,
+                                       Violation(msg, trace_to(state),
+                                                 state))
+                continue
+            for label, new in trans:
+                n_trans += 1
+                msg = model.step_violation(state, new, label)
+                if msg:
+                    return CheckResult(config, len(visited), n_trans,
+                                       n_quiescent,
+                                       _time.perf_counter() - t0,
+                                       Violation(msg,
+                                                 trace_to(state, label),
+                                                 new))
+                if new not in visited:
+                    if len(visited) >= max_states:
+                        raise RuntimeError(
+                            f"state space exceeds {max_states} states — "
+                            "shrink the configuration")
+                    visited.add(new)
+                    parents[new] = (state, label)
+                    next_frontier.append(new)
+        frontier = next_frontier
+    return CheckResult(config, len(visited), n_trans, n_quiescent,
+                       _time.perf_counter() - t0, None)
+
+
+# -- trace replay against the real stores -----------------------------------
+
+_DUMMY_TIMES = {"started": 1.0, "finished": 2.0, "written": 3.0,
+                "cpu": 0.5, "real": 2.0}
+
+
+def replay_trace(store, trace: Sequence[tuple], config: ModelConfig,
+                 final_state: Optional[tuple] = None,
+                 ns: str = "model_jobs") -> dict:
+    """Run a model trace's store operations against a REAL JobStore.
+
+    Virtual-clock steps (``tick``) have no store analog; the staleness
+    they produce is applied surgically at the ``requeue`` step via the
+    same status CAS ``requeue_stale`` performs (RUNNING|FINISHED →
+    BROKEN, +1 repetition), on exactly the jobs the model requeued.
+
+    Returns ``{"ok": True, ...}`` when every operation's outcome matches
+    the model (and, when ``final_state`` is given, the store's final
+    per-job status/reps match it), else ``{"ok": False, "step": k,
+    "label": ..., "reason": ...}`` naming the first divergent step —
+    for a seeded-bug trace that divergence IS the real store's CAS
+    refusing the racy operation.
+    """
+    from lua_mapreduce_tpu.coord.jobstore import make_job
+
+    store.insert_jobs(ns, [make_job(f"k{j}", j)
+                           for j in range(config.n_jobs)])
+    wname = [f"mw{w}" for w in range(config.n_workers)]
+
+    def diverged(i, label, reason):
+        return {"ok": False, "step": i, "label": label, "reason": reason}
+
+    for i, label in enumerate(trace):
+        op = label[0]
+        if op in ("exec", "exec_fail", "die", "tick"):
+            continue
+        if op == "claim":
+            _, w, take = label
+            docs = store.claim_batch(ns, wname[w], k=config.batch_k)
+            got = tuple(d["_id"] for d in docs)
+            if got != tuple(take):
+                return diverged(i, label,
+                                f"claimed {got}, model claimed {take}")
+        elif op == "commit_a":
+            _, w, j, ok = label
+            got = store.set_job_status(ns, j, Status.FINISHED,
+                                       expect=(Status.RUNNING,),
+                                       expect_worker=wname[w])
+            if got != ok:
+                return diverged(
+                    i, label,
+                    f"FINISHED CAS returned {got}, model said {ok}"
+                    + ("" if ok else " — the store is weaker than the "
+                       "protocol allows")
+                    + (" — the real store's status+ownership CAS refuses "
+                       "the commit the buggy model allowed" if not got
+                       else ""))
+            if got:
+                store.set_job_times(ns, j, _DUMMY_TIMES)
+        elif op == "commit_b":
+            _, w, j, ok = label
+            got = store.set_job_status(ns, j, Status.WRITTEN,
+                                       expect=(Status.FINISHED,),
+                                       expect_worker=wname[w])
+            if got != ok:
+                return diverged(
+                    i, label,
+                    f"WRITTEN CAS returned {got}, model said {ok}"
+                    + (" — the real store's ownership CAS refuses the "
+                       "commit the buggy model allowed" if not got else ""))
+        elif op == "release":
+            _, w, tail, released = label
+            n = store.release_batch(ns, wname[w], list(tail))
+            if n != len(released):
+                return diverged(i, label,
+                                f"released {n}, model released "
+                                f"{len(released)}")
+        elif op == "mark_broken":
+            _, w, j, ok = label
+            got = store.set_job_status(ns, j, Status.BROKEN,
+                                       expect=(Status.RUNNING,),
+                                       expect_worker=wname[w])
+            if got != ok:
+                return diverged(i, label,
+                                f"BROKEN CAS returned {got}, model "
+                                f"said {ok}")
+        elif op == "beat":
+            _, w, beaten = label
+            n = store.heartbeat_batch(ns, list(beaten), wname[w])
+            if n != len(beaten):
+                return diverged(i, label,
+                                f"{n} beats landed, model landed "
+                                f"{len(beaten)}")
+        elif op == "requeue":
+            (_, stale) = label
+            for j in stale:
+                if not store.set_job_status(
+                        ns, j, Status.BROKEN,
+                        expect=(Status.RUNNING, Status.FINISHED)):
+                    return diverged(i, label,
+                                    f"requeue CAS refused job {j}")
+        elif op == "scavenge":
+            (_, failed) = label
+            n = store.scavenge(ns, config.max_retries)
+            if n != len(failed):
+                return diverged(i, label,
+                                f"scavenged {n}, model scavenged "
+                                f"{len(failed)}")
+        else:
+            return diverged(i, label, f"unknown trace op {op!r}")
+
+    result = {"ok": True, "steps": len(trace)}
+    if final_state is not None:
+        jobs, _, _ = final_state
+        cap = config.max_retries + 1
+        for j, (s, r, _, _) in enumerate(jobs):
+            doc = store.get_job(ns, j)
+            if int(doc["status"]) != s or min(int(doc["repetitions"]),
+                                              cap) != r:
+                return {"ok": False, "step": len(trace),
+                        "label": ("final",),
+                        "reason": f"job {j} ended "
+                                  f"({Status(int(doc['status'])).name}, "
+                                  f"{doc['repetitions']}), model ended "
+                                  f"({Status(s).name}, {r})"}
+    return result
+
+
+def utest() -> None:
+    """Self-test: a 1×2 exhaustive pass holds every invariant; both
+    seeded bugs are re-found; a violation trace replayed against the
+    real MemJobStore diverges exactly at the guarding CAS."""
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+
+    small = ModelConfig(n_workers=1, n_jobs=2, batch_k=2)
+    res = check_protocol(small)
+    assert res.ok and res.states > 10 and res.quiescent > 0
+
+    bug = check_protocol(dataclasses.replace(
+        small, n_workers=2, bug="commit_skips_owner_cas"))
+    assert not bug.ok and "ownership" in bug.violation.message
+    rep = replay_trace(MemJobStore(), bug.violation.trace,
+                       bug.config)
+    assert not rep["ok"] and rep["label"][0].startswith("commit")
+
+    stuck = check_protocol(dataclasses.replace(
+        small, n_workers=2, bug="requeue_ignores_finished"))
+    assert not stuck.ok and "FINISHED" in stuck.violation.message

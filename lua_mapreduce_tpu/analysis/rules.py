@@ -1,0 +1,562 @@
+"""The rule registry: every framework contract the lint pass enforces.
+
+Each rule is one class (id, severity, title, rationale, path scope,
+``check``).  The ids are stable — suppressions and the DESIGN §18
+catalog reference them — and new rules append, never renumber.
+
+Known analysis limits (deliberate: simple, predictable checks beat a
+dataflow engine that nobody can audit):
+
+- scope is one function at a time; a helper *called* under a lock is
+  not re-checked inside the locked region (helpers that themselves
+  misbehave are caught when their own body is linted);
+- ``self.x = builder`` hands ownership to the object (the wrapper class
+  is expected to expose/forward ``close``, as SegmentWriter does).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from lua_mapreduce_tpu.analysis.lint import FileContext, Finding, Rule
+
+# --- shared AST helpers ----------------------------------------------------
+
+
+def _chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Dotted-name parts of a Name/Attribute expr ('a.b.c' → (a, b, c))."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                   ast.Lambda)
+
+
+def _own_walk(nodes: Sequence[ast.AST]) -> Iterable[ast.AST]:
+    """Walk ``nodes`` without entering nested function/class scopes —
+    one scope's own statements only (nested scopes are analyzed as
+    their own _scopes entries)."""
+    stack = list(nodes)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _SCOPE_BARRIERS):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _scopes(tree: ast.Module):
+    """(scope_node, body) for the module and every function, nested
+    included — each analyzed independently."""
+    yield tree, tree.body
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield n, n.body
+
+
+def _parent_map(body: Sequence[ast.AST]) -> dict:
+    par = {}
+    for n in _own_walk(body):
+        for c in ast.iter_child_nodes(n):
+            par[c] = n
+    return par
+
+
+def _calls(body: Sequence[ast.AST]) -> Iterable[ast.Call]:
+    for n in _own_walk(body):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def _is_flock_ctor(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):
+        c = _chain(expr.func)
+        return bool(c) and c[-1] == "_FLock"
+    return False
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    """A with-context that holds a lock: ``_FLock(...)`` or a dotted
+    name whose last part mentions 'lock' (self._lock, _rounds_lock)."""
+    if _is_flock_ctor(expr):
+        return True
+    c = _chain(expr)
+    return bool(c) and "lock" in c[-1].lower()
+
+
+def _locked_regions(body: Sequence[ast.AST]):
+    """Locked critical sections in one function body.
+
+    Yields ``(kind, lock_node, stmts)`` where kind is:
+      - "lock":  a ``with <lock>:`` block (memory lock or _FLock);
+      - "index": everything after ``fd = self._open_locked(...)`` —
+        the idx engine's open/flock/operate/close discipline (the
+        region runs to the end of the enclosing block, which is how
+        the ``try: ... finally: os.close(fd)`` pattern is written).
+    """
+    for n in _own_walk(body):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            if any(_is_lock_expr(i.context_expr) for i in n.items):
+                yield "lock", n, n.body
+    # index regions: the function's own statement list plus every
+    # nested one (try/if/for bodies)
+    lists = [list(body)]
+    for n in _own_walk(body):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(n, field, None)
+            if isinstance(stmts, list) and stmts:
+                lists.append(stmts)
+    for stmts in lists:
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Assign) and isinstance(s.value, ast.Call):
+                c = _chain(s.value.func)
+                if c and c[-1] == "_open_locked":
+                    yield "index", s, stmts[i + 1:]
+
+
+# --- LMR001: builder / writer lifecycle ------------------------------------
+
+_BUILDER_CTORS = {"writer_for", "SegmentWriter", "TextWriter"}
+
+
+class BuilderLifecycleRule(Rule):
+    id = "LMR001"
+    severity = "error"
+    title = "builders must be closed on all paths"
+    rationale = (
+        "A FileBuilder left unbuilt (failed user code, a raise between "
+        "creation and build) holds a writer thread, an fd, and a .tmp. "
+        "file; on a long-lived elastic worker those leak per retry. "
+        "Every store.builder()/writer_for()/SegmentWriter/TextWriter "
+        "bound to a name needs a with-block, or a finally/except that "
+        "calls .close() on it (directly, or looping a container it was "
+        "stored into). Passing the fresh builder straight into a "
+        "wrapper call or returning it transfers ownership.")
+
+    @staticmethod
+    def _is_creation(call: ast.Call) -> bool:
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "builder" and not call.args
+                and not call.keywords):
+            return True
+        c = _chain(call.func)
+        return bool(c) and c[-1] in _BUILDER_CTORS
+
+    @staticmethod
+    def _closers(body: Sequence[ast.AST]) -> Set[str]:
+        """Names reliably closed in this scope: with-blocks on the name,
+        and .close() calls inside finally/except bodies (including the
+        for-each-over-container form)."""
+        closed: Set[str] = set()
+
+        def scan(stmts):
+            for n in _own_walk(stmts):
+                if isinstance(n, ast.Call) and isinstance(
+                        n.func, ast.Attribute) and n.func.attr == "close":
+                    c = _chain(n.func.value)
+                    if c:
+                        closed.add(c[0])
+                elif isinstance(n, ast.For):
+                    it = n.iter
+                    if isinstance(it, ast.Call) and isinstance(
+                            it.func, ast.Attribute) \
+                            and it.func.attr in ("values", "items"):
+                        it = it.func.value
+                    c = _chain(it)
+                    if c and isinstance(n.target, ast.Name):
+                        for m in _own_walk(n.body):
+                            if (isinstance(m, ast.Call)
+                                    and isinstance(m.func, ast.Attribute)
+                                    and m.func.attr == "close"
+                                    and isinstance(m.func.value, ast.Name)
+                                    and m.func.value.id == n.target.id):
+                                closed.add(c[0])
+
+        for n in _own_walk(body):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    c = _chain(item.context_expr)
+                    if c and len(c) == 1:
+                        closed.add(c[0])
+            elif isinstance(n, ast.Try):
+                if n.finalbody:
+                    scan(n.finalbody)
+                for h in n.handlers:
+                    scan(h.body)
+        return closed
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for _scope, body in _scopes(ctx.tree):
+            par = _parent_map(body)
+            closed = self._closers(body)
+            for call in _calls(body):
+                if not self._is_creation(call):
+                    continue
+                p = par.get(call)
+                if isinstance(p, ast.withitem):
+                    continue                      # with store.builder() as b
+                if isinstance(p, (ast.Call, ast.keyword, ast.Return)):
+                    continue                      # ownership transferred
+                if isinstance(p, (ast.Assign, ast.NamedExpr)):
+                    targets = (p.targets if isinstance(p, ast.Assign)
+                               else [p.target])
+                    names: Set[str] = set()
+                    owned_by_object = False
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+                        elif isinstance(t, ast.Subscript):
+                            c = _chain(t.value)
+                            if c:
+                                names.add(c[0])
+                        elif isinstance(t, ast.Attribute):
+                            owned_by_object = True
+                    if owned_by_object or names & closed:
+                        continue
+                    yield self.finding(
+                        ctx, call,
+                        f"builder bound to {sorted(names) or '<target>'} "
+                        "is never closed on failure paths — use a "
+                        "with-block or close it in a finally")
+                else:
+                    yield self.finding(
+                        ctx, call,
+                        "builder created and dropped — bind it and close "
+                        "it, or pass it directly to its owner")
+
+
+# --- LMR002: no foreign IO / callbacks under the index flock ---------------
+
+_IDX_OS_ALLOWED = {"read", "write", "lseek", "close", "fstat", "pread",
+                   "pwrite"}
+_IDX_DENY_ROOTS = {"json", "tempfile", "subprocess", "shutil", "socket",
+                   "urllib", "requests", "glob"}
+
+
+class IndexFlockIORule(Rule):
+    id = "LMR002"
+    severity = "error"
+    title = "no foreign IO or user callbacks under the index flock"
+    rationale = (
+        "The job index flock serializes every claim/commit in the "
+        "cluster. Anything but fd-local record IO inside it — opening "
+        "other files, JSON (de)serialization of payloads, store reads, "
+        "user callbacks — multiplies the critical section by an "
+        "unbounded cost and can deadlock against the payload path. "
+        "Payload/manifest IO belongs before the lock (insert) or after "
+        "release (claim's doc build), which is how filestore.py is "
+        "structured.")
+    paths = ("coord/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for scope, body in _scopes(ctx.tree):
+            params: Set[str] = set()
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = scope.args
+                params = {x.arg for x in (a.posonlyargs + a.args
+                                          + a.kwonlyargs)} - {"self", "cls"}
+            for kind, _node, stmts in _locked_regions(body):
+                if kind != "index":
+                    continue
+                for call in _calls(stmts):
+                    c = _chain(call.func)
+                    if not c:
+                        continue
+                    if c[0] in ("open", "print", "input") and len(c) == 1:
+                        yield self.finding(
+                            ctx, call, f"{c[0]}() under the index flock")
+                    elif c[0] in _IDX_DENY_ROOTS:
+                        yield self.finding(
+                            ctx, call,
+                            f"{'.'.join(c)} under the index flock — do "
+                            "payload/manifest IO outside the lock")
+                    elif (c[0] == "os" and len(c) > 1
+                          and c[1] not in _IDX_OS_ALLOWED
+                          and c[1] != "path"):
+                        yield self.finding(
+                            ctx, call,
+                            f"os.{c[1]} under the index flock (only "
+                            "fd-local record IO is allowed)")
+                    elif len(c) == 1 and c[0] in params:
+                        yield self.finding(
+                            ctx, call,
+                            f"call to parameter {c[0]!r} under the index "
+                            "flock — user callbacks must never run "
+                            "inside the lock")
+
+
+# --- LMR003: single lock-acquisition order ---------------------------------
+
+_LOCKING_METHODS = {"_bump", "round_counts", "_open_locked"}
+
+
+class LockOrderRule(Rule):
+    id = "LMR003"
+    severity = "error"
+    title = "no second lock while holding one"
+    rationale = (
+        "The coordination plane has exactly one safe order: take ONE "
+        "lock, operate, release. Acquiring a second lock (another "
+        "_FLock, the index flock, the instance lock, or a method that "
+        "takes the class-level rounds lock, like _bump) while holding "
+        "one creates an AB/BA deadlock the churn tests can only find "
+        "by luck.")
+    paths = ("coord/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for _scope, body in _scopes(ctx.tree):
+            for _kind, _node, stmts in _locked_regions(body):
+                for n in _own_walk(stmts):
+                    if isinstance(n, (ast.With, ast.AsyncWith)):
+                        for item in n.items:
+                            if _is_lock_expr(item.context_expr):
+                                yield self.finding(
+                                    ctx, n, "nested lock acquisition "
+                                    "inside a locked region")
+                    elif isinstance(n, ast.Call):
+                        c = _chain(n.func)
+                        if not c:
+                            continue
+                        if c[-1] == "_FLock" or (
+                                c[0] == "fcntl" and len(c) > 1
+                                and c[1] == "flock"):
+                            yield self.finding(
+                                ctx, n, f"{'.'.join(c)} acquired inside "
+                                "a locked region")
+                        elif c[-1] in _LOCKING_METHODS and len(c) > 1:
+                            yield self.finding(
+                                ctx, n,
+                                f"{'.'.join(c)}() takes another lock — "
+                                "call it before or after the critical "
+                                "section")
+                        elif c[-1] == "acquire":
+                            yield self.finding(
+                                ctx, n, "explicit .acquire() inside a "
+                                "locked region")
+
+
+# --- LMR004: no wall-clock reads under a coordination lock -----------------
+
+_CLOCK_CALLS = {"time", "monotonic", "time_ns", "perf_counter"}
+
+
+class WallclockUnderLockRule(Rule):
+    id = "LMR004"
+    severity = "error"
+    title = "no time.time() inside a locked critical section"
+    rationale = (
+        "Lease math (claim stamps, heartbeats, staleness cutoffs) must "
+        "use a timestamp decided BEFORE the lock: a wall-clock read "
+        "inside the critical section moves with lock contention, so "
+        "two runs of the same protocol order events differently — and "
+        "it grows the hold time of the hottest lock in the system. "
+        "Hoist ``now = time.time()`` above the acquisition (the index "
+        "engines take ``now`` as an argument for exactly this reason).")
+    paths = ("coord/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for _scope, body in _scopes(ctx.tree):
+            for _kind, _node, stmts in _locked_regions(body):
+                for call in _calls(stmts):
+                    c = _chain(call.func)
+                    if (c and len(c) == 2 and c[0] == "time"
+                            and c[1] in _CLOCK_CALLS):
+                        yield self.finding(
+                            ctx, call,
+                            f"{'.'.join(c)}() under a coordination lock "
+                            "— hoist the clock read above the lock")
+
+
+# --- LMR005: swallow-except hygiene ----------------------------------------
+
+_LOG_ATTRS = {"warning", "error", "exception", "critical", "info", "debug",
+              "log", "warn", "print_exc", "_exit", "exit"}
+
+
+class SwallowExceptRule(Rule):
+    id = "LMR005"
+    severity = "error"
+    title = "bare/BaseException handlers must re-raise or log"
+    rationale = (
+        "A handler that catches everything (bare except / "
+        "BaseException) and neither re-raises nor logs erases the real "
+        "failure — the async-writer and checkpoint threads have both "
+        "shipped bugs where the worker's actual exception context "
+        "vanished. Catch narrowly, or record what you swallowed. "
+        "(``except Exception`` on a best-effort sweep path is allowed; "
+        "this rule is about the catch-alls that also eat SystemExit/"
+        "KeyboardInterrupt.)")
+
+    @staticmethod
+    def _catches_everything(h: ast.ExceptHandler) -> bool:
+        if h.type is None:
+            return True
+        types = (h.type.elts if isinstance(h.type, ast.Tuple) else [h.type])
+        for t in types:
+            c = _chain(t)
+            if c and c[-1] == "BaseException":
+                return True
+        return False
+
+    @staticmethod
+    def _handles(body: Sequence[ast.AST]) -> bool:
+        for n in _own_walk(body):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                c = _chain(n.func)
+                if not c:
+                    continue
+                if c[-1] in _LOG_ATTRS or c[0] in ("print", "log"):
+                    return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.ExceptHandler) \
+                    and self._catches_everything(n) \
+                    and not self._handles(n.body):
+                yield self.finding(
+                    ctx, n, "catch-all handler swallows the exception — "
+                    "re-raise, log it, or narrow the except")
+
+
+# --- LMR006: raw-bytes store contract --------------------------------------
+
+class RawBytesContractRule(Rule):
+    id = "LMR006"
+    severity = "error"
+    title = "read_range/size come in pairs; shims are latin-1"
+    rationale = (
+        "The v2 segment reader locates the trailer with size() and "
+        "pulls frames with read_range(); a Store that overrides one "
+        "natively but inherits the other's O(file) text shim silently "
+        "mixes byte spaces (native bytes vs latin-1-decoded text) and "
+        "either corrupts frames or re-reads whole files per range. "
+        "Implement both or neither. Inside write_bytes/read_range/size "
+        "the only legal text bridge is latin-1 — utf-8 is not "
+        "byte-transparent (DESIGN §17).")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.ClassDef):
+                continue
+            bases = {c[-1] for c in map(_chain, n.bases) if c}
+            methods = {m.name: m for m in n.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            if any(b == "Store" or b.endswith("Store") for b in bases):
+                have = {"read_range", "size"} & set(methods)
+                if len(have) == 1:
+                    (name,) = have
+                    other = ({"read_range", "size"} - have).pop()
+                    yield self.finding(
+                        ctx, methods[name],
+                        f"{n.name} overrides {name}() but not {other}() "
+                        "— the raw-bytes surface is a pair")
+            for mname in ("write_bytes", "read_range", "size"):
+                m = methods.get(mname)
+                if m is None:
+                    continue
+                for call in _calls(m.body):
+                    if isinstance(call.func, ast.Attribute) \
+                            and call.func.attr in ("encode", "decode") \
+                            and call.args \
+                            and isinstance(call.args[0], ast.Constant) \
+                            and str(call.args[0].value).lower().replace(
+                                "-", "") != "latin1":
+                        yield self.finding(
+                            ctx, call,
+                            f"{mname}() bridges text with "
+                            f"{call.args[0].value!r} — only latin-1 maps "
+                            "bytes 0-255 losslessly")
+
+
+# --- LMR007: purity of jit/shard_map-traced functions ----------------------
+
+_TRACER_NAMES = {"jit", "shard_map", "pjit", "pallas_call", "vmap", "pmap",
+                 "grad", "value_and_grad", "checkpoint", "remat", "scan"}
+_IMPURE_ROOTS = {("np", "random"), ("numpy", "random"), ("random",),
+                 ("time",)}
+
+
+class JaxPurityRule(Rule):
+    id = "LMR007"
+    severity = "error"
+    title = "no host side effects inside traced functions"
+    rationale = (
+        "A function under jit/shard_map runs its Python body ONCE at "
+        "trace time: numpy/stdlib RNG draws become compile-time "
+        "constants baked into every call, time.time() measures tracing, "
+        "and print/open fire on trace, not on execution. Use "
+        "jax.random with explicit keys, jax.debug.print, and pass host "
+        "data in as arguments.")
+    paths = ("ops/", "parallel/")
+
+    @staticmethod
+    def _decorator_traces(dec: ast.AST) -> bool:
+        c = _chain(dec)
+        if c and c[-1] in _TRACER_NAMES:
+            return True
+        if isinstance(dec, ast.Call):
+            c = _chain(dec.func)
+            if c and c[-1] in _TRACER_NAMES:
+                return True
+            if c and c[-1] == "partial":
+                for a in dec.args[:1]:
+                    ca = _chain(a)
+                    if ca and ca[-1] in _TRACER_NAMES:
+                        return True
+        return False
+
+    def _traced_names(self, tree: ast.Module) -> Set[str]:
+        """Function names passed (positionally, first arg) to a tracing
+        transform anywhere in the module."""
+        out: Set[str] = set()
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Call):
+                c = _chain(n.func)
+                if c and c[-1] in _TRACER_NAMES and n.args:
+                    ca = _chain(n.args[0])
+                    if ca and len(ca) == 1:
+                        out.add(ca[0])
+        return out
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        traced = self._traced_names(ctx.tree)
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if n.name not in traced and not any(
+                    self._decorator_traces(d) for d in n.decorator_list):
+                continue
+            # the whole body, nested defs included — inner closures
+            # trace with their parent
+            for m in ast.walk(n):
+                if not isinstance(m, ast.Call):
+                    continue
+                c = _chain(m.func)
+                if not c:
+                    continue
+                if len(c) == 1 and c[0] in ("open", "input"):
+                    yield self.finding(
+                        ctx, m, f"{c[0]}() inside traced "
+                        f"function {n.name!r}")
+                elif len(c) == 1 and c[0] == "print":
+                    yield self.finding(
+                        ctx, m, f"print() inside traced function "
+                        f"{n.name!r} fires at trace time — use "
+                        "jax.debug.print")
+                elif any(c[:len(root)] == root for root in _IMPURE_ROOTS):
+                    yield self.finding(
+                        ctx, m, f"{'.'.join(c)} inside traced function "
+                        f"{n.name!r} is evaluated once at trace time — "
+                        "use jax.random / pass values as arguments")

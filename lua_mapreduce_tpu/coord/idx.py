@@ -4,6 +4,14 @@ The native library (native/jobstore.cpp) is compiled on first use with the
 host toolchain and cached next to the source; if compilation or loading
 fails the pure-Python engine (idx_py.py) takes over — both speak the same
 on-disk format, so the choice is per-process, not per-cluster.
+
+One deliberate exception to the silent fallback: a native library that
+LOADS but whose on-disk layout disagrees with idx_py.py (or that lacks
+the ``jsx_abi`` self-description export — only possible for a
+hand-placed binary, since the build cache is keyed on a source hash)
+RAISES instead of falling back. Both engines write the same index
+files, so an ABI drift is corruption, not a degraded mode; delete the
+cached .so to rebuild, or set LMR_DISABLE_NATIVE=1 to force Python.
 """
 
 from __future__ import annotations
@@ -21,10 +29,42 @@ _SRC = os.path.join(_NATIVE_DIR, "jobstore.cpp")
 _SO = os.path.join(_NATIVE_DIR, "libjobstore.so")
 
 
+def _abi_check(lib: ctypes.CDLL) -> None:
+    """Refuse a native engine whose on-disk layout drifted from
+    idx_py.py — both engines write the SAME index files, so a mismatch
+    would silently corrupt live coordination state. Native builds
+    without the export (a stale cached .so from before the guard) are
+    rejected the same way: unverifiable is as bad as wrong."""
+    from lua_mapreduce_tpu.coord import idx_py
+
+    try:
+        lib.jsx_abi.restype = ctypes.c_int32
+        lib.jsx_abi.argtypes = [ctypes.c_char_p,
+                                ctypes.POINTER(ctypes.c_int64),
+                                ctypes.POINTER(ctypes.c_int32)]
+    except AttributeError:
+        raise RuntimeError(
+            f"native job index {_SO} predates the ABI guard — rebuild it "
+            "(delete the cached .so) or set LMR_DISABLE_NATIVE=1")
+    magic = ctypes.create_string_buffer(8)
+    sizes = (ctypes.c_int64 * 2)()
+    statuses = (ctypes.c_int32 * 6)()
+    lib.jsx_abi(magic, sizes, statuses)
+    native = (magic.raw, sizes[0], sizes[1], list(statuses))
+    python = (idx_py.MAGIC, idx_py.HEADER_SIZE, idx_py.RECORD_SIZE,
+              [int(s) for s in Status])
+    if native != python:
+        raise RuntimeError(
+            "native job index ABI drifted from coord/idx_py.py: native "
+            f"{native} vs python {python} — the engines share index "
+            "files byte-for-byte and must agree exactly")
+
+
 def _load() -> Optional[ctypes.CDLL]:
     lib = load_native(_SRC, _SO)
     if lib is None or getattr(lib, "_jsx_configured", False):
         return lib
+    _abi_check(lib)
     lib._jsx_configured = True
     lib.jsx_insert.restype = ctypes.c_int64
     lib.jsx_insert.argtypes = [ctypes.c_char_p, ctypes.c_int64]

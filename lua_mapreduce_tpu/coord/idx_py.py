@@ -42,6 +42,19 @@ RECORD_SIZE = _REC.size          # 72
 N_TIMES = 5                      # started, finished, written, cpu, real
 _ZERO_TIMES = (0.0,) * N_TIMES
 
+# import-time drift guard: these numbers ARE the v2 wire format shared
+# with native/jobstore.cpp (its static_asserts pin the same values, and
+# idx.py cross-checks both sides via jsx_abi() when the native engine
+# loads). A drifted struct string must fail here, before any index file
+# is touched — as a real raise, not an assert, so python -O cannot
+# strip the guard whose whole point is preventing silent corruption.
+if HEADER_SIZE != 16 or RECORD_SIZE != 72:
+    raise ImportError(f"JSIX0002 layout drifted: header {HEADER_SIZE}B, "
+                      f"record {RECORD_SIZE}B (must be 16/72)")
+if [int(s) for s in Status] != [0, 1, 2, 3, 4, 5]:
+    raise ImportError("Status enum drifted from the JSIX0002 record "
+                      "encoding (native/jobstore.cpp pins 0..5)")
+
 _CLAIM_MASK = (1 << Status.WAITING) | (1 << Status.BROKEN)
 
 

@@ -322,6 +322,7 @@ class MemJobStore(JobStore):
 
     def claim_batch(self, ns, worker, k=1, preferred_ids=None, steal=True):
         self._bump("claim")
+        now = time.time()      # decided before the lock (lease math)
         with self._lock:
             queue = self._jobs.get(ns, [])
             out = []
@@ -330,7 +331,7 @@ class MemJobStore(JobStore):
                 if d["status"] in CLAIMABLE and len(out) < k:
                     d["status"] = Status.RUNNING
                     d["worker"] = worker
-                    d["started_time"] = time.time()
+                    d["started_time"] = now
                     d["hb_time"] = None   # fresh claim, fresh silence clock
                     out.append(dict(d))
 
@@ -366,10 +367,10 @@ class MemJobStore(JobStore):
             return done
 
     def heartbeat_batch(self, ns, job_ids, worker):
+        now = time.time()
         with self._lock:
             queue = self._jobs.get(ns, [])
             n = 0
-            now = time.time()
             for job_id in job_ids:
                 if not (0 <= job_id < len(queue)):
                     continue
@@ -431,9 +432,9 @@ class MemJobStore(JobStore):
             return n
 
     def requeue_stale(self, ns, older_than_s):
+        cutoff = time.time() - older_than_s
         with self._lock:
             n = 0
-            cutoff = time.time() - older_than_s
             for d in self._jobs.get(ns, []):
                 live = max(d["started_time"] or 0.0, d.get("hb_time") or 0.0)
                 if (d["status"] in (Status.RUNNING, Status.FINISHED) and
@@ -444,6 +445,7 @@ class MemJobStore(JobStore):
             return n
 
     def heartbeat(self, ns, job_id, worker):
+        now = time.time()
         with self._lock:
             queue = self._jobs.get(ns, [])
             if not (0 <= job_id < len(queue)):
@@ -452,7 +454,7 @@ class MemJobStore(JobStore):
             if d["status"] not in (Status.RUNNING, Status.FINISHED) \
                     or d["worker"] != worker:
                 return False
-            d["hb_time"] = time.time()
+            d["hb_time"] = now
             return True
 
     def drop_ns(self, ns):
@@ -462,9 +464,9 @@ class MemJobStore(JobStore):
     # -- errors ------------------------------------------------------------
 
     def insert_error(self, worker, msg):
+        doc = {"worker": worker, "msg": msg, "time": time.time()}
         with self._lock:
-            self._errors.append({"worker": worker, "msg": msg,
-                                 "time": time.time()})
+            self._errors.append(doc)
 
     def drain_errors(self):
         with self._lock:

@@ -69,6 +69,13 @@ struct Record {
 
 static_assert(sizeof(Header) == kHeaderSize, "header layout");
 static_assert(sizeof(Record) == kRecordSize, "record layout");
+// the status values ARE the wire format (both engines write them into
+// shared index files); drift against core/constants.py corrupts live
+// coordination state, so they are pinned here and re-checked from the
+// Python side at library load via jsx_abi()
+static_assert(kWaiting == 0 && kRunning == 1 && kBroken == 2 &&
+                  kFinished == 3 && kWritten == 4 && kFailed == 5,
+              "status enum drifted from core/constants.py");
 
 class LockedIndex {
  public:
@@ -139,6 +146,23 @@ int64_t jsx_claim_batch(const char* path, int64_t worker,
                         const int64_t* preferred, int64_t n_preferred,
                         int32_t steal, int64_t* out_ids, int32_t* out_reps,
                         int64_t k);
+
+// ABI self-description: the on-disk layout THIS build writes. The Python
+// loader (coord/idx.py) calls it once per process and refuses the native
+// engine if anything disagrees with idx_py.py — a version skew between
+// the two engines must fail at load time, never as silent corruption of
+// a shared index file. Fills magic_out[8], sizes_out[2] = {header,
+// record}, statuses_out[6] in core/constants.py order; returns 1.
+int32_t jsx_abi(char* magic_out, int64_t* sizes_out,
+                int32_t* statuses_out) {
+  memcpy(magic_out, kMagic, sizeof kMagic);
+  sizes_out[0] = kHeaderSize;
+  sizes_out[1] = kRecordSize;
+  const int32_t statuses[6] = {kWaiting, kRunning, kBroken,
+                               kFinished, kWritten, kFailed};
+  memcpy(statuses_out, statuses, sizeof statuses);
+  return 1;
+}
 
 // Append n WAITING records; returns first new id, or -1 on error.
 int64_t jsx_insert(const char* path, int64_t n) {

@@ -194,6 +194,12 @@ class SegmentWriter:
     def close(self) -> None:
         self._b.close()
 
+    def __enter__(self) -> "SegmentWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
 
 class TextWriter:
     """v1 record writer: one JSON line per record through a plain
@@ -210,6 +216,12 @@ class TextWriter:
 
     def close(self) -> None:
         self._b.close()
+
+    def __enter__(self) -> "TextWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def writer_for(store, segment_format: str = "v1", codec: str = "zlib"):
@@ -375,10 +387,10 @@ def utest() -> None:
     store = MemStore()
     recs = [(f"k{i:04d}", [i, str(i), [i, i + 1]]) for i in range(500)]
 
-    w = writer_for(store, "v2", codec="zlib")
-    for k, v in recs:
-        w.add(k, v)
-    w.build("seg.P0.M1")
+    with writer_for(store, "v2", codec="zlib") as w:
+        for k, v in recs:
+            w.add(k, v)
+        w.build("seg.P0.M1")
 
     r = open_segment(store, "seg.P0.M1")
     assert r is not None and r.records == 500
@@ -388,10 +400,10 @@ def utest() -> None:
     assert r.frames[0][3] == '"k0000"'       # first-key index
 
     # v1 writer + the format-agnostic stream
-    w1 = writer_for(store, "v1")
-    for k, v in recs[:3]:
-        w1.add(k, v)
-    w1.build("txt.P0.M2")
+    with writer_for(store, "v1") as w1:
+        for k, v in recs[:3]:
+            w1.add(k, v)
+        w1.build("txt.P0.M2")
     assert open_segment(store, "txt.P0.M2") is None
     assert list(record_stream(store, "txt.P0.M2")) == recs[:3]
     assert list(record_stream(store, "seg.P0.M1")) == recs
@@ -400,13 +412,13 @@ def utest() -> None:
     # exercises multi-batch ranged reads
     import random
     rng = random.Random(0)
-    w = SegmentWriter(store.builder(), codec="zlib", frame_bytes=512)
     noisy = [("k%04d" % i,
               ["".join(rng.choice("abcdefghijklmnopqrstuvwxyz0123456789")
                        for _ in range(40))]) for i in range(64)]
-    for k, v in noisy:
-        w.add(k, v)
-    w.build("noisy")
+    with SegmentWriter(store.builder(), codec="zlib", frame_bytes=512) as w:
+        for k, v in noisy:
+            w.add(k, v)
+        w.build("noisy")
     r = open_segment(store, "noisy")
     assert len(r.frames) > 1
     assert list(r.iter_records(readahead=600)) == noisy

@@ -334,9 +334,16 @@ class Worker:
 
     def _mark_broken(self, ns: str, jid: int) -> None:
         """Job → BROKEN (+1 repetition) and error → errors stream
-        (reference job.lua:322-342, cnn.lua:62-66). Ownership-checked: if
-        the claim was already requeued and re-claimed, leave it alone."""
+        (reference job.lua:322-342, cnn.lua:62-66). CASed on ownership
+        AND on the job still being RUNNING: if the claim was requeued
+        (already BROKEN — the repetition is already counted) or requeued
+        and re-claimed, leave it alone. The status expectation matters —
+        without it, a worker whose failed job was requeued, retried, and
+        scavenged in the meantime would resurrect a FAILED job back to
+        claimable BROKEN (found by analysis/protocol.py: FAILED must be
+        terminal)."""
         self.store.set_job_status(ns, jid, Status.BROKEN,
+                                  expect=(Status.RUNNING,),
                                   expect_worker=self.name)
         self.store.insert_error(self.name, traceback.format_exc())
 

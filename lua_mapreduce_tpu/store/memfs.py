@@ -89,11 +89,11 @@ def utest() -> None:
     """Self-test (reference fs.lua:213-251 utest role): build / lines /
     list / exists / remove roundtrip with atomic publish semantics."""
     s = MemStore()
-    b = s.builder()
-    b.write("x 1\n")
-    b.write("y 2\n")
-    assert not s.exists("f.P0")          # nothing visible before build
-    b.build("f.P0")
+    with s.builder() as b:
+        b.write("x 1\n")
+        b.write("y 2\n")
+        assert not s.exists("f.P0")      # nothing visible before build
+        b.build("f.P0")
     assert s.exists("f.P0")
     assert list(s.lines("f.P0")) == ["x 1\n", "y 2\n"]
     assert s.list("f.P*") == ["f.P0"]
@@ -105,8 +105,8 @@ def utest() -> None:
     s.remove("f.P0")                     # remove-if-exists, no raise
 
     # raw-bytes builds coexist with text files in one namespace
-    b = s.builder()
-    b.write_bytes(b"\x00\xffbin")
-    b.build("g.bin")
+    with s.builder() as b:
+        b.write_bytes(b"\x00\xffbin")
+        b.build("g.bin")
     assert s.read_range("g.bin", 0, 5) == b"\x00\xffbin"
     assert s.size("g.bin") == 5

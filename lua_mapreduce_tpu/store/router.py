@@ -90,7 +90,7 @@ def utest() -> None:
 
     with tempfile.TemporaryDirectory() as d:
         s = router(f"shared:{d}")
-        bld = s.builder()
-        bld.write("k 1\n")
-        bld.build("r.P0")
+        with s.builder() as bld:
+            bld.write("k 1\n")
+            bld.build("r.P0")
         assert list(s.lines("r.P0")) == ["k 1\n"]

@@ -18,6 +18,7 @@ tempfile; ``read_range``/``size`` are plain seek+read/stat.
 from __future__ import annotations
 
 import glob as _glob
+import logging
 import os
 import queue
 import tempfile
@@ -25,6 +26,8 @@ import threading
 from typing import Iterator, List, Optional, Union
 
 from lua_mapreduce_tpu.store.base import FileBuilder, Store, encode_chunks
+
+_log = logging.getLogger(__name__)
 
 # read/flush granularity: k-way merges used to pay a syscall per ~8KB
 # default buffer; 1MB batches make both sides of the shuffle IO chunky
@@ -150,7 +153,10 @@ class _DirBuilder(FileBuilder):
 def _writer_loop(q: "queue.Queue", f, err_box: List[BaseException]) -> None:
     """Background chunk writer. Keeps consuming after a write error so
     the bounded queue never deadlocks the producer; the first error is
-    parked in ``err_box`` and surfaced by the builder."""
+    parked in ``err_box`` and surfaced by the builder — and logged here
+    with its real context, because a producer that never reaches
+    ``build`` (it raised for its own reasons) would otherwise drop the
+    write failure silently."""
     while True:
         chunk = q.get()
         if chunk is None:
@@ -159,6 +165,8 @@ def _writer_loop(q: "queue.Queue", f, err_box: List[BaseException]) -> None:
             try:
                 f.write(chunk)
             except BaseException as e:
+                _log.warning("sharedfs async writer: deferred write "
+                             "error (surfaced at build): %r", e)
                 err_box.append(e)
 
 

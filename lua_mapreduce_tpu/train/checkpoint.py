@@ -13,10 +13,13 @@ from __future__ import annotations
 import base64
 import io
 import json
+import logging
 from typing import Any
 
 import jax
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 
 def _dtype_by_name(name: str) -> np.dtype:
@@ -50,19 +53,22 @@ def _save_flat(store, name: str, leaves: list, dtypes: list,
     are written, so a caller handing over host snapshots (the async
     path) holds at most snapshot + one serialization buffer, and the
     sync path keeps its one-leaf-at-a-time host-RSS discipline."""
-    b = store.builder()
-    # v2 manifests record each leaf's dtype NAME: numpy serializes
-    # ml_dtypes leaves (bfloat16 and friends) as raw void arrays, and
-    # without the name a loader can only guess the original dtype by
-    # itemsize — bfloat16 vs float16 would silently reinterpret bits.
-    b.write(json.dumps({"v": 2, "n": len(leaves), "dtypes": dtypes,
-                        "treedef": treedef_str}) + "\n")
-    for i in range(len(leaves)):
-        leaf, leaves[i] = leaves[i], None       # eager release
-        buf = io.BytesIO()
-        np.save(buf, np.asarray(leaf), allow_pickle=False)
-        b.write(base64.b64encode(buf.getvalue()).decode() + "\n")
-    b.build(name)
+    # with-block: a failed serialization (an unencodable leaf, a full
+    # disk mid-write) must release the builder's thread/fd/tempfile
+    # deterministically, not at GC time on a long-lived trainer
+    with store.builder() as b:
+        # v2 manifests record each leaf's dtype NAME: numpy serializes
+        # ml_dtypes leaves (bfloat16 and friends) as raw void arrays, and
+        # without the name a loader can only guess the original dtype by
+        # itemsize — bfloat16 vs float16 would silently reinterpret bits.
+        b.write(json.dumps({"v": 2, "n": len(leaves), "dtypes": dtypes,
+                            "treedef": treedef_str}) + "\n")
+        for i in range(len(leaves)):
+            leaf, leaves[i] = leaves[i], None       # eager release
+            buf = io.BytesIO()
+            np.save(buf, np.asarray(leaf), allow_pickle=False)
+            b.write(base64.b64encode(buf.getvalue()).decode() + "\n")
+        b.build(name)
 
 
 def save_pytree(store, name: str, tree: Any) -> None:
@@ -114,6 +120,11 @@ class AsyncCheckpoint:
                 # pinning the full tree until the publish
                 _save_flat(store, name, host, dtypes, str(treedef))
             except BaseException as e:    # surfaced by wait()
+                # logged HERE with the real context too: a run that
+                # crashes before its next wait() must not take the
+                # actual write failure to the grave with it
+                _log.warning("async checkpoint write of %r failed "
+                             "(re-raised at wait()): %r", name, e)
                 self._error = e
 
         self._thread = threading.Thread(target=_write, daemon=True)

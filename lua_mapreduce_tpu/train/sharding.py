@@ -55,13 +55,13 @@ def make_sharded(store, prefix: str, x: np.ndarray, y: np.ndarray,
         name = _shard_name(prefix, i)
         ckpt.save_pytree(store, name, (x[lo:hi], y[lo:hi]))
         names.append(name)
-    b = store.builder()
-    b.write(json.dumps({"v": 1, "n_shards": n_shards, "n": int(len(x)),
-                        "sizes": np.diff(bounds).tolist(),
-                        "x_shape": list(x.shape[1:]),
-                        "x_dtype": str(x.dtype),
-                        "y_dtype": str(y.dtype)}) + "\n")
-    b.build(f"{prefix}.manifest")
+    with store.builder() as b:
+        b.write(json.dumps({"v": 1, "n_shards": n_shards, "n": int(len(x)),
+                            "sizes": np.diff(bounds).tolist(),
+                            "x_shape": list(x.shape[1:]),
+                            "x_dtype": str(x.dtype),
+                            "y_dtype": str(y.dtype)}) + "\n")
+        b.build(f"{prefix}.manifest")
     return names
 
 

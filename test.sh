@@ -20,4 +20,11 @@ echo "collect gate: tests/ collects cleanly"
 python -m pytest tests/test_segment.py -q
 LMR_DISABLE_NATIVE=1 python -m pytest tests/test_segment.py -q
 echo "segment conformance: python + native merge engines agree"
+# lmr-analyze gate: the framework-aware lint pass must be clean against
+# the checked-in suppression baseline (analysis/baseline.json — shipped
+# EMPTY), and the lease-protocol model checker must exhaustively pass
+# the 2-worker lifecycle (worker death included) while re-finding both
+# seeded races. Machine output: add --format json.
+python -m lua_mapreduce_tpu.analysis --fail-on-findings
+echo "lmr-analyze: lint clean + lease protocol model-checked"
 python -m pytest tests/ -q --full

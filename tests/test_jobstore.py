@@ -466,3 +466,43 @@ def test_crash_orphaned_manifest_is_superseded(tmp_path):
     assert got["value"] in ({"n": 0}, {"n": 1})
     names = sorted(f for f in os.listdir(ns_dir) if f.startswith("b"))
     assert names == ["b0_2.json"], names
+
+
+def test_native_python_abi_drift_guard():
+    """The v2 layout constants (JSIX0002, 16B header, 72B records) and
+    the status enum must be asserted equal on both index engines: the
+    Python side pins them at import, and the native build exports
+    jsx_abi() which coord/idx.py verifies at load. Both engines write
+    the same files — drift is corruption, and must fail loudly."""
+    import ctypes
+
+    from lua_mapreduce_tpu.coord import idx_py
+    from lua_mapreduce_tpu.coord.idx import _load
+
+    # python side: the import-time guard already ran; re-assert the
+    # values it pinned
+    assert idx_py.MAGIC == b"JSIX0002"
+    assert idx_py.HEADER_SIZE == 16 and idx_py.RECORD_SIZE == 72
+    assert [int(s) for s in Status] == [0, 1, 2, 3, 4, 5]
+
+    if not native_available():
+        pytest.skip("native engine unavailable in this environment")
+    lib = _load()
+    magic = ctypes.create_string_buffer(8)
+    sizes = (ctypes.c_int64 * 2)()
+    statuses = (ctypes.c_int32 * 6)()
+    assert lib.jsx_abi(magic, sizes, statuses) == 1
+    assert magic.raw == idx_py.MAGIC
+    assert (sizes[0], sizes[1]) == (idx_py.HEADER_SIZE, idx_py.RECORD_SIZE)
+    assert list(statuses) == [int(s) for s in Status]
+
+
+def test_mem_store_claim_timestamps_decided_before_lock():
+    """Lease stamps come from one clock read per batch (hoisted above
+    the lock — lint rule LMR004): every job of one claim_batch carries
+    the identical started_time."""
+    store = MemJobStore()
+    store.insert_jobs("map_jobs", [make_job(i, i) for i in range(4)])
+    docs = store.claim_batch("map_jobs", "w1", k=4)
+    assert len(docs) == 4
+    assert len({d["started_time"] for d in docs}) == 1
