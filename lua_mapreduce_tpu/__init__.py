@@ -31,6 +31,14 @@ _LAZY = {
     "FileJobStore": ("lua_mapreduce_tpu.coord.filestore", "FileJobStore"),
     "PersistentTable": ("lua_mapreduce_tpu.coord.persistent_table",
                         "PersistentTable"),
+    # fault subsystem (DESIGN §19)
+    "StoreError": ("lua_mapreduce_tpu.faults.errors", "StoreError"),
+    "TransientStoreError": ("lua_mapreduce_tpu.faults.errors",
+                            "TransientStoreError"),
+    "PermanentStoreError": ("lua_mapreduce_tpu.faults.errors",
+                            "PermanentStoreError"),
+    "RetryPolicy": ("lua_mapreduce_tpu.faults.retry", "RetryPolicy"),
+    "FaultPlan": ("lua_mapreduce_tpu.faults.plan", "FaultPlan"),
 }
 
 
@@ -53,6 +61,11 @@ __all__ = [
     "MemJobStore",
     "FileJobStore",
     "PersistentTable",
+    "StoreError",
+    "TransientStoreError",
+    "PermanentStoreError",
+    "RetryPolicy",
+    "FaultPlan",
     "tuples",
     "utest",
 ]
@@ -60,7 +73,7 @@ __all__ = [
 
 def utest():
     """Run every module's self-test (reference mapreduce/test.lua:30-39)."""
-    from lua_mapreduce_tpu import analysis
+    from lua_mapreduce_tpu import analysis, faults
     from lua_mapreduce_tpu.core import heap, merge, segment, serialize
     from lua_mapreduce_tpu.coord import jobstore, persistent_table
     from lua_mapreduce_tpu.engine import contract, premerge, server, worker
@@ -73,6 +86,6 @@ def utest():
     # the cpu-pinned pytest conftest instead (tests/test_q8.py etc.)
     for mod in (tuples, heap, serialize, segment, merge, jobstore, memfs,
                 contract, router, persistent_table, stats, premerge, worker,
-                server, analysis):
+                server, analysis, faults):
         if hasattr(mod, "utest"):
             mod.utest()

@@ -480,6 +480,69 @@ class RawBytesContractRule(Rule):
                             "bytes 0-255 losslessly")
 
 
+# --- LMR008: classified raisables across the retry boundary ----------------
+
+# the op surfaces the retry layer wraps (DESIGN §19): store data-plane
+# ops and coord RPCs. Raises inside these methods cross the retry
+# boundary, so the retry layer must be able to classify them.
+_RETRY_BOUNDARY_METHODS = {
+    # Store / FileBuilder surface
+    "lines", "read_range", "size", "list", "exists", "remove", "build",
+    "write", "write_bytes", "_put", "_get", "_drain", "_flush_async",
+    # JobStore RPC surface
+    "claim", "claim_batch", "commit_batch", "release_batch", "heartbeat",
+    "heartbeat_batch", "set_job_status", "set_job_times", "counts",
+    "scavenge", "requeue_stale", "get_task", "put_task", "update_task",
+    "delete_task", "insert_jobs", "insert_error", "drain_errors",
+}
+
+# generic exception types the taxonomy cannot place: raising one of
+# these across the boundary forces the retry layer to guess. (ValueError/
+# KeyError/FileNotFoundError etc. are fine — the central table maps
+# them; StoreError subclasses are the preferred currency.)
+_UNCLASSIFIED_RAISES = {"Exception", "BaseException", "RuntimeError",
+                        "OSError", "IOError", "EnvironmentError",
+                        "SystemError"}
+
+
+class ClassifiedRaiseRule(Rule):
+    id = "LMR008"
+    severity = "error"
+    title = "store/coord op raises must be classified StoreError shapes"
+    rationale = (
+        "Every store op and coord RPC runs under the transient-fault "
+        "retry layer (faults/retry.py). A generic RuntimeError/OSError "
+        "raised across that boundary cannot be classified: the retry "
+        "layer either retries a deterministic failure (wasted backoff, "
+        "masked bug) or gives up on a transient one (spurious job "
+        "release). Raise a StoreError subclass (TransientStoreError / "
+        "PermanentStoreError / NativeIndexError / NoTaskError ...) or a "
+        "builtin the taxonomy maps (FileNotFoundError, TimeoutError, "
+        "ValueError for data errors).")
+    paths = ("store/", "coord/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for scope, body in _scopes(ctx.tree):
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            if scope.name not in _RETRY_BOUNDARY_METHODS:
+                continue
+            for n in _own_walk(body):
+                if not isinstance(n, ast.Raise) or n.exc is None:
+                    continue
+                exc = n.exc
+                if isinstance(exc, ast.Call):
+                    exc = exc.func
+                c = _chain(exc)
+                if c and c[-1] in _UNCLASSIFIED_RAISES:
+                    yield self.finding(
+                        ctx, n,
+                        f"raise {c[-1]} inside retry-boundary op "
+                        f"{scope.name}() — use a classified StoreError "
+                        "subclass so the retry layer can route it")
+
+
 # --- LMR007: purity of jit/shard_map-traced functions ----------------------
 
 _TRACER_NAMES = {"jit", "shard_map", "pjit", "pallas_call", "vmap", "pmap",

@@ -84,6 +84,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "v2 = framed binary segments (block-compressed, "
                         "CRC-guarded, ranged reads; docs/DESIGN.md §17). "
                         "Readers sniff per file, final results stay v1")
+    p.add_argument("--store-retries", type=int, default=None,
+                   help="transient store/coord fault retry budget per op "
+                        "(default 3, or LMR_STORE_RETRIES; 0 disables "
+                        "the retry layer — DESIGN §19)")
+    p.add_argument("--retry-base-ms", type=float, default=None,
+                   help="decorrelated-jitter backoff base in ms "
+                        "(default 25, or LMR_RETRY_BASE_MS)")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -101,6 +108,10 @@ def main(argv=None) -> int:
     from lua_mapreduce_tpu.engine.contract import TaskSpec
     from lua_mapreduce_tpu.engine.server import Server
     from lua_mapreduce_tpu.engine.worker import Worker
+    from lua_mapreduce_tpu.faults.retry import configure_retry
+
+    if args.store_retries is not None or args.retry_base_ms is not None:
+        configure_retry(args.store_retries, args.retry_base_ms)
 
     import os as _os
     storage = args.storage or (

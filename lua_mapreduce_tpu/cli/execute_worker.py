@@ -36,6 +36,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma list of phases this worker claims "
                         "(heterogeneous pools: dedicated mapper hosts "
                         "pass 'map', reducer hosts 'reduce')")
+    p.add_argument("--store-retries", type=int, default=None,
+                   help="transient store/coord fault retry budget per op "
+                        "(default 3, or LMR_STORE_RETRIES; 0 disables "
+                        "the retry layer — DESIGN §19)")
+    p.add_argument("--retry-base-ms", type=float, default=None,
+                   help="decorrelated-jitter backoff base in ms "
+                        "(default 25, or LMR_RETRY_BASE_MS)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -50,7 +57,10 @@ def main(argv=None) -> int:
 
     from lua_mapreduce_tpu.coord.filestore import FileJobStore
     from lua_mapreduce_tpu.engine.worker import Worker
+    from lua_mapreduce_tpu.faults.retry import configure_retry
 
+    if args.store_retries is not None or args.retry_base_ms is not None:
+        configure_retry(args.store_retries, args.retry_base_ms)
     phases = tuple(s.strip() for s in args.phases.split(",") if s.strip())
     for ph in phases:
         if ph not in ("map", "reduce"):

@@ -173,7 +173,8 @@ class FileJobStore(JobStore):
             path = os.path.join(self.root, "task.json")
             doc = _read_json(path)
             if doc is None:
-                raise RuntimeError("no task document")
+                from lua_mapreduce_tpu.faults.errors import NoTaskError
+                raise NoTaskError("no task document")
             doc.update(fields)
             _atomic_write_json(path, doc)
 
@@ -231,7 +232,8 @@ class FileJobStore(JobStore):
         os.replace(tmp, self._gen_path(ns))
         got = idx.insert(len(docs))
         if got != base:
-            raise RuntimeError(
+            from lua_mapreduce_tpu.faults.errors import ConcurrentInsertError
+            raise ConcurrentInsertError(
                 f"concurrent insert into {ns!r}: expected base {base}, got "
                 f"{got} — a namespace has exactly one inserter (the server)")
         return list(range(base, base + len(docs)))
@@ -445,8 +447,11 @@ class FileJobStore(JobStore):
 
     # -- errors ------------------------------------------------------------
 
-    def insert_error(self, worker, msg):
-        line = json.dumps({"worker": worker, "msg": msg, "time": time.time()})
+    def insert_error(self, worker, msg, info=None):
+        doc = {"worker": worker, "msg": msg, "time": time.time()}
+        if info:
+            doc.update(info)
+        line = json.dumps(doc)
         with _FLock(self._lockfile("errors")):
             with open(os.path.join(self.root, "errors.jsonl"), "a") as f:
                 f.write(line + "\n")

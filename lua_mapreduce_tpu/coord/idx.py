@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES, Status
 from lua_mapreduce_tpu.core.native_build import load_native
 from lua_mapreduce_tpu.coord.idx_py import PyJobIndex
+from lua_mapreduce_tpu.faults.errors import NativeIndexError
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
 _SRC = os.path.join(_NATIVE_DIR, "jobstore.cpp")
@@ -144,13 +145,13 @@ class NativeJobIndex:
     def insert(self, n: int) -> int:
         r = self._lib.jsx_insert(self._p, n)
         if r < 0:
-            raise OSError(f"jsx_insert failed on {self.path}")
+            raise NativeIndexError(f"jsx_insert failed on {self.path}")
         return r
 
     def count(self) -> int:
         r = self._lib.jsx_count(self._p)
         if r < 0:
-            raise OSError(f"jsx_count failed on {self.path}")
+            raise NativeIndexError(f"jsx_count failed on {self.path}")
         return r
 
     def claim(self, worker: int, now: float,
@@ -175,7 +176,7 @@ class NativeJobIndex:
         n = self._lib.jsx_claim_batch(self._p, worker, arr, len(pref),
                                       1 if steal else 0, out_ids, out_reps, k)
         if n < 0:
-            raise OSError(f"jsx_claim_batch failed on {self.path}")
+            raise NativeIndexError(f"jsx_claim_batch failed on {self.path}")
         return [(out_ids[i], out_reps[i]) for i in range(n)]
 
     def cas_status_batch(self, ids: Sequence[int], to: Status,
@@ -188,7 +189,7 @@ class NativeJobIndex:
         n = self._lib.jsx_cas_status_batch(self._p, arr, len(ids), int(to),
                                            expect_mask, expect_worker, ok)
         if n < 0:
-            raise OSError(f"jsx_cas_status_batch failed on {self.path}")
+            raise NativeIndexError(f"jsx_cas_status_batch failed on {self.path}")
         return [bool(ok[i]) for i in range(len(ids))]
 
     def commit_batch(self, entries: Sequence[tuple],
@@ -205,14 +206,14 @@ class NativeJobIndex:
         r = self._lib.jsx_commit_batch(self._p, ids, n, worker, times_arr,
                                        ok)
         if r < 0:
-            raise OSError(f"jsx_commit_batch failed on {self.path}")
+            raise NativeIndexError(f"jsx_commit_batch failed on {self.path}")
         return [bool(ok[i]) for i in range(n)]
 
     def set_times(self, job_id: int, times: Sequence[float]) -> bool:
         arr = (ctypes.c_double * 5)(*times)
         r = self._lib.jsx_set_times(self._p, job_id, arr)
         if r < 0:
-            raise OSError(f"jsx_set_times failed on {self.path}")
+            raise NativeIndexError(f"jsx_set_times failed on {self.path}")
         return bool(r)
 
     def heartbeat_batch(self, ids: Sequence[int], worker: int,
@@ -222,7 +223,7 @@ class NativeJobIndex:
         arr = (ctypes.c_int64 * len(ids))(*ids)
         n = self._lib.jsx_heartbeat_batch(self._p, arr, len(ids), worker, now)
         if n < 0:
-            raise OSError(f"jsx_heartbeat_batch failed on {self.path}")
+            raise NativeIndexError(f"jsx_heartbeat_batch failed on {self.path}")
         return n
 
     def cas_status(self, job_id: int, to: Status, expect_mask: int = 0,
@@ -230,7 +231,7 @@ class NativeJobIndex:
         r = self._lib.jsx_cas_status(self._p, job_id, int(to), expect_mask,
                                      expect_worker)
         if r < 0:
-            raise OSError(f"jsx_cas_status failed on {self.path}")
+            raise NativeIndexError(f"jsx_cas_status failed on {self.path}")
         return bool(r)
 
     def get(self, job_id: int) -> Optional[tuple]:
@@ -243,7 +244,7 @@ class NativeJobIndex:
                               ctypes.byref(reps), ctypes.byref(worker),
                               ctypes.byref(started), times)
         if r < 0:
-            raise OSError(f"jsx_get failed on {self.path}")
+            raise NativeIndexError(f"jsx_get failed on {self.path}")
         if r == 0:
             return None
         t = tuple(times)
@@ -254,25 +255,25 @@ class NativeJobIndex:
         out = (ctypes.c_int64 * 6)()
         r = self._lib.jsx_counts(self._p, out)
         if r < 0:
-            raise OSError(f"jsx_counts failed on {self.path}")
+            raise NativeIndexError(f"jsx_counts failed on {self.path}")
         return {Status(i): out[i] for i in range(6)}
 
     def scavenge(self, max_retries: int = MAX_JOB_RETRIES) -> int:
         r = self._lib.jsx_scavenge(self._p, max_retries)
         if r < 0:
-            raise OSError(f"jsx_scavenge failed on {self.path}")
+            raise NativeIndexError(f"jsx_scavenge failed on {self.path}")
         return r
 
     def requeue_stale(self, cutoff: float) -> int:
         r = self._lib.jsx_requeue_stale(self._p, cutoff)
         if r < 0:
-            raise OSError(f"jsx_requeue_stale failed on {self.path}")
+            raise NativeIndexError(f"jsx_requeue_stale failed on {self.path}")
         return r
 
     def heartbeat(self, job_id: int, worker: int, now: float) -> bool:
         r = self._lib.jsx_heartbeat(self._p, job_id, worker, now)
         if r < 0:
-            raise OSError(f"jsx_heartbeat failed on {self.path}")
+            raise NativeIndexError(f"jsx_heartbeat failed on {self.path}")
         return bool(r)
 
     def snapshot(self):
@@ -287,7 +288,7 @@ class NativeJobIndex:
         n = self._lib.jsx_snapshot(self._p, statuses, reps, workers,
                                    started, times, cap)
         if n < 0:
-            raise OSError(f"jsx_snapshot failed on {self.path}")
+            raise NativeIndexError(f"jsx_snapshot failed on {self.path}")
         out = []
         zero = (0.0,) * 5
         for i in range(n):

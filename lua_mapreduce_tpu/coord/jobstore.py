@@ -246,10 +246,29 @@ class JobStore(abc.ABC):
     @abc.abstractmethod
     def drop_ns(self, ns: str) -> None: ...
 
+    # -- fault classification (DESIGN §19) ---------------------------------
+
+    def classify(self, exc: BaseException):
+        """Transient/permanent verdict for exceptions this store's RPCs
+        can raise — the coord-plane twin of ``Store.classify``, consumed
+        by the RetryingJobStore wrapper. The central taxonomy already
+        maps the index engines' raisables (bare OSError from a failed
+        jsx op → transient; ENOENT/EACCES → permanent; NoTaskError /
+        ConcurrentInsertError are classified by type)."""
+        from lua_mapreduce_tpu.faults.errors import classify_exception
+        return classify_exception(exc)
+
     # -- errors stream (cnn.lua:62-78) -------------------------------------
 
     @abc.abstractmethod
-    def insert_error(self, worker: str, msg: str) -> None: ...
+    def insert_error(self, worker: str, msg: str,
+                     info: Optional[dict] = None) -> None:
+        """Append to the errors stream. ``info`` (optional) carries the
+        structured post-mortem fields — ``exc_class``, ``classification``
+        ('user-code' | 'infra-transient' | 'infra-permanent'), job
+        context — merged into the entry next to the traceback ``msg``,
+        so drained errors can distinguish infra from user-code failures
+        without parsing text."""
 
     @abc.abstractmethod
     def drain_errors(self) -> List[dict]: ...
@@ -293,7 +312,8 @@ class MemJobStore(JobStore):
     def update_task(self, fields: dict) -> None:
         with self._lock:
             if self._task is None:
-                raise RuntimeError("no task document")
+                from lua_mapreduce_tpu.faults.errors import NoTaskError
+                raise NoTaskError("no task document")
             self._task.update(fields)
 
     def delete_task(self) -> None:
@@ -463,8 +483,10 @@ class MemJobStore(JobStore):
 
     # -- errors ------------------------------------------------------------
 
-    def insert_error(self, worker, msg):
+    def insert_error(self, worker, msg, info=None):
         doc = {"worker": worker, "msg": msg, "time": time.time()}
+        if info:
+            doc.update(info)
         with self._lock:
             self._errors.append(doc)
 

@@ -44,6 +44,7 @@ results stay v1 text always, keeping every golden byte-compare intact.
 from __future__ import annotations
 
 import json
+import logging
 import struct
 import zlib
 from typing import Any, Iterator, List, Optional, Tuple
@@ -51,6 +52,8 @@ from typing import Any, Iterator, List, Optional, Tuple
 from lua_mapreduce_tpu.core import tuples
 from lua_mapreduce_tpu.core.serialize import (dump_key, dump_record,
                                               load_record)
+
+_log = logging.getLogger(__name__)
 
 MAGIC = b"JSEG0001"
 FRAME_BYTES = 1 << 18          # ~256KB decoded payload per frame
@@ -246,19 +249,20 @@ class SegmentReader:
     def __init__(self, store, name: str, head: Optional[bytes] = None):
         self._store = store
         self._name = name
-        size = store.size(name)
+        self._whole: Optional[bytes] = None   # degradation cache, see _ranged
+        size = self._size = store.size(name)
         if size < len(MAGIC) + _TRAILER.size:
             raise ValueError(f"{name}: segment too short ({size} bytes)")
         if head is None:
             head = store.read_range(name, 0, len(MAGIC))
         if head[:len(MAGIC)] != MAGIC:
             raise ValueError(f"{name}: not a JSEG0001 segment")
-        trailer = store.read_range(name, size - _TRAILER.size, _TRAILER.size)
+        trailer = self._ranged(size - _TRAILER.size, _TRAILER.size)
         foot_off, foot_len, foot_crc, magic = _TRAILER.unpack(trailer)
         if magic != MAGIC:
             raise ValueError(f"{name}: segment trailer magic mismatch "
                              "(truncated or corrupt)")
-        footer = store.read_range(name, foot_off, foot_len)
+        footer = self._ranged(foot_off, foot_len)
         if zlib.crc32(footer) & 0xFFFFFFFF != foot_crc:
             raise ValueError(f"{name}: segment footer CRC mismatch")
         meta = json.loads(footer)
@@ -271,14 +275,40 @@ class SegmentReader:
 
     # -- frame access -------------------------------------------------------
 
+    def _ranged(self, off: int, length: int) -> bytes:
+        """A ranged read with the degradation rung of DESIGN §19: when a
+        ranged read fails with a TRANSIENT store fault that outlived the
+        retry layer's budget, fall back to ONE whole-file read and serve
+        every remaining range from memory — the same shape as the native
+        merge's Python fallback and the premerge poison-to-raw-runs
+        ladder. Permanent and non-storage errors propagate untouched."""
+        if self._whole is not None:
+            return self._whole[off:off + length]
+        try:
+            return self._store.read_range(self._name, off, length)
+        except Exception as exc:
+            from lua_mapreduce_tpu.faults.errors import classify_exception
+            # the backend's own classify hook when it has one (it knows
+            # its SDK's error shapes); the central table for duck-typed
+            # third-party stores
+            classify = getattr(self._store, "classify", classify_exception)
+            if classify(exc) is not True:
+                raise
+            from lua_mapreduce_tpu.faults.retry import COUNTERS
+            self._whole = self._store.read_range(self._name, 0, self._size)
+            COUNTERS.bump("degraded_reads")
+            _log.warning("%s: ranged reads failing (%s) — degraded to a "
+                         "whole-file read (%d bytes)", self._name,
+                         type(exc).__name__, self._size)
+            return self._whole[off:off + length]
+
     def frame_payload(self, idx: int, blob: Optional[bytes] = None,
                       blob_off: int = 0) -> bytes:
         """Decoded text payload of frame ``idx`` (from ``blob`` when the
         caller already holds a read batch covering it)."""
         off, enc, dec, _ = self.frames[idx]
         if blob is None:
-            blob = self._store.read_range(self._name, off,
-                                          _FRAME_HDR.size + enc)
+            blob = self._ranged(off, _FRAME_HDR.size + enc)
             blob_off = off
         base = off - blob_off
         enc_len, dec_len, codec, crc = _FRAME_HDR.unpack_from(blob, base)
@@ -301,7 +331,7 @@ class SegmentReader:
                 total += _FRAME_HDR.size + self.frames[j][1]
                 j += 1
             off = self.frames[i][0]
-            yield i, j - i, self._store.read_range(self._name, off, total)
+            yield i, j - i, self._ranged(off, total)
             i = j
 
     # -- record access ------------------------------------------------------

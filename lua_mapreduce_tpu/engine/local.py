@@ -155,6 +155,8 @@ class LocalExecutor:
         spec = self.spec
         it_stats = IterationStats(iteration=iteration)
         t0 = time.time()
+        from lua_mapreduce_tpu.faults.retry import COUNTERS
+        faults0 = COUNTERS.snapshot()
 
         # fresh result namespace per iteration — partitions that receive no
         # data this iteration must not leak last iteration's results
@@ -190,6 +192,13 @@ class LocalExecutor:
         if spec.finalfn is not None:
             verdict = spec.finalfn(iter_results(self.result_store,
                                                 spec.result_ns))
+        # fault-plane traffic this iteration (DESIGN §19), same fold as
+        # the distributed server's
+        fd = COUNTERS.delta(faults0, COUNTERS.snapshot())
+        it_stats.store_retries = fd.get("retries", 0)
+        it_stats.store_faults = (fd.get("retry_exhausted", 0)
+                                 + fd.get("faults_injected", 0))
+        it_stats.degraded_reads = fd.get("degraded_reads", 0)
         it_stats.wall_time = time.time() - t0
         self.stats.iterations.append(it_stats)
         return verdict

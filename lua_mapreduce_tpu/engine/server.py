@@ -31,6 +31,8 @@ from lua_mapreduce_tpu.engine.premerge import (SPILL_TAG, PremergeTracker,
                                                discover_pipelined,
                                                parse_spill_name, run_name_re)
 from lua_mapreduce_tpu.engine.worker import MAP_NS, PRE_NS, RED_NS
+from lua_mapreduce_tpu.faults.retry import COUNTERS
+from lua_mapreduce_tpu.faults.wrappers import unwrap, wrap_jobstore
 from lua_mapreduce_tpu.store.router import get_storage_from
 from lua_mapreduce_tpu.utils.stats import (IterationStats, TaskStats,
                                            overlap_fraction)
@@ -86,7 +88,10 @@ class Server:
                  pipeline: bool = False, premerge_min_runs: int = 4,
                  premerge_max_runs: int = 8, batch_k: int = 1,
                  segment_format: str = "v1"):
-        self.store = store
+        # coord RPCs ride the transient-fault retry layer (DESIGN §19);
+        # the scavenge/requeue/drain housekeeping must not abort an
+        # iteration over one store blip
+        self.store = wrap_jobstore(store)
         self.poll_interval = poll_interval
         self.stale_timeout_s = stale_timeout_s
         self.verbose = verbose
@@ -144,7 +149,7 @@ class Server:
                     f"storage {spec_str!r}: bare 'mem' is private per "
                     "process — use 'mem:TAG' for in-process pools or "
                     "'shared:DIR' / 'object:DIR' for multi-process pools")
-            if isinstance(self.store, FileJobStore):
+            if isinstance(unwrap(self.store), FileJobStore):
                 raise ValueError(
                     f"storage {spec_str!r} is in-process memory, but the "
                     "job store is a FileJobStore (multi-process pool) — "
@@ -229,6 +234,7 @@ class Server:
             it_stats = IterationStats(iteration=iteration)
             it_t0 = time.time()
             rounds0 = self.store.round_counts()
+            faults0 = COUNTERS.snapshot()
 
             if not skip_map:
                 delete_results(result_store, self.spec.result_ns)
@@ -265,6 +271,16 @@ class Server:
             rounds1 = self.store.round_counts()
             it_stats.claim_rounds = rounds1["claim"] - rounds0["claim"]
             it_stats.commit_rounds = rounds1["commit"] - rounds0["commit"]
+            # fault-plane traffic this iteration (process-global counter
+            # deltas — same visibility contract as round_counts: an
+            # in-process pool's whole retry/degradation story, a
+            # multi-process pool's server-side share)
+            fd = COUNTERS.delta(faults0, COUNTERS.snapshot())
+            it_stats.store_retries = fd.get("retries", 0)
+            it_stats.store_faults = (fd.get("retry_exhausted", 0)
+                                     + fd.get("faults_injected", 0))
+            it_stats.infra_releases = fd.get("infra_releases", 0)
+            it_stats.degraded_reads = fd.get("degraded_reads", 0)
             it_stats.wall_time = time.time() - it_t0
             self.stats.iterations.append(it_stats)
             self.store.update_task({"stats": it_stats.as_dict()})

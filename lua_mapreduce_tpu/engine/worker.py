@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import os
 import threading
 import time
@@ -21,18 +22,30 @@ import uuid
 from typing import Dict, List, Optional
 
 from lua_mapreduce_tpu.core.constants import (DEFAULT_SLEEP, MAX_IDLE_COUNT,
+                                              MAX_JOB_RETRIES,
                                               MAX_WORKER_RETRIES, Status,
                                               TaskStatus)
 from lua_mapreduce_tpu.coord.jobstore import JobStore
 from lua_mapreduce_tpu.engine.contract import TaskSpec
 from lua_mapreduce_tpu.engine.job import (run_map_job, run_premerge_job,
                                           run_reduce_job)
+from lua_mapreduce_tpu.faults.errors import (classify_job_fault,
+                                             is_transient_job_fault)
+from lua_mapreduce_tpu.faults.wrappers import wrap_jobstore
 from lua_mapreduce_tpu.store.router import get_storage_from
+
+_log = logging.getLogger(__name__)
 
 MAP_NS = "map_jobs"
 RED_NS = "red_jobs"
 PRE_NS = "pre_jobs"     # eager pre-merge jobs, published DURING the map
                         # phase by a pipelined server (engine/premerge.py)
+
+# consecutive transient-infra poll failures a worker tolerates (with
+# exponential backoff to 2s) before giving up — far above the 3-strike
+# user-code budget: storage weather must not kill the fleet, but a
+# permanently unreachable coord store must not livelock it either
+MAX_INFRA_POLL_FAILURES = 10
 
 _CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases",
                 "heartbeat_s", "batch_k", "batch_lease_s", "segment_format")
@@ -48,7 +61,9 @@ class Worker:
 
     def __init__(self, store: JobStore, name: Optional[str] = None,
                  verbose: bool = False):
-        self.store = store
+        # coord RPCs ride the transient-fault retry layer (and, in chaos
+        # runs, the installed FaultPlan's injection) — DESIGN §19
+        self.store = wrap_jobstore(store)
         self.name = name or f"worker-{uuid.uuid4().hex[:8]}-{os.getpid()}"
         self.verbose = verbose
         self.max_iter = 20
@@ -89,6 +104,9 @@ class Worker:
         self._task_segment_format = None        # last task doc's value
         self._dur_ewma: Dict[str, float] = {}   # ns -> smoothed real secs
         self._spec_cache: Dict[str, TaskSpec] = {}
+        self._infra_released: Dict[tuple, int] = {}  # (ns, jid) -> count
+        self._release_gen = None        # (task spec, iteration) the
+                                        # release budget belongs to
         self._affinity: list = []       # map-job ids this worker ran before
         self._idle_count = 0
         self.jobs_executed = 0
@@ -117,12 +135,23 @@ class Worker:
         "executed", or "finished" (task is done)."""
         task = self.store.get_task()
         if task is None or task.get("status") == TaskStatus.WAIT.value:
+            self._infra_released.clear()
             return "wait"
         if task.get("status") == TaskStatus.FINISHED.value:
+            self._infra_released.clear()
             return "finished"
 
         spec = self._get_spec(task["spec"])
         iteration = int(task.get("iteration", 1))
+        # the per-job infra-release budget is scoped to ONE iteration of
+        # ONE task: namespaces are dropped and re-inserted per iteration,
+        # so job ids restart at 0 — a stale budget would wrongly charge a
+        # NEW job for a previous iteration's releases (and the dict would
+        # grow without bound on a long-lived worker)
+        gen = (task["spec"], iteration)
+        if gen != self._release_gen:
+            self._release_gen = gen
+            self._infra_released.clear()
         self._task_segment_format = task.get("segment_format")
 
         if task["status"] == TaskStatus.MAP.value:
@@ -217,11 +246,29 @@ class Worker:
         stop = threading.Event()
 
         def beat():
-            while not stop.wait(self.heartbeat_s):
+            # the beat thread must survive ANY store exception: dying
+            # silently stops liveness beats, and the server then
+            # stale-requeues the job out from under a LIVE worker. A
+            # failed beat logs (first failure and each escalation) and
+            # resumes with exponential backoff — capped at the beat
+            # interval so a recovered store is re-beaten promptly.
+            failures = 0
+            delay = self.heartbeat_s
+            while not stop.wait(delay):
                 try:
                     self.store.heartbeat_batch(ns, jids, self.name)
-                except Exception:
-                    pass
+                    if failures:
+                        self._log(f"heartbeat recovered after "
+                                  f"{failures} failure(s)")
+                    failures = 0
+                    delay = self.heartbeat_s
+                except Exception as e:
+                    failures += 1
+                    delay = min(self.heartbeat_s,
+                                0.05 * (2.0 ** min(failures, 10)))
+                    _log.warning("[%s] heartbeat failed (%dx: %s: %s); "
+                                 "retrying in %.2fs", self.name, failures,
+                                 type(e).__name__, e, delay)
 
         t = threading.Thread(target=beat, daemon=True,
                              name=f"{self.name}-hb-{ns}")
@@ -275,6 +322,23 @@ class Worker:
             f"{spec.result_ns}.P{v['part']}.*"))
         missing = [f for f in v["files"] if f not in visible]
         if missing:
+            if result_store.exists(v["result"]):
+                # duplicate execution after a stale requeue: the first
+                # claimant already PUBLISHED this partition's result
+                # (atomic build — it can only exist if a reduce of this
+                # job ran to completion this iteration) and then began
+                # deleting the consumed runs. The work is done — finish
+                # the claim and sweep leftovers, exactly like
+                # run_premerge_job's spill-exists short-circuit. Failing
+                # instead livelocks the job: the runs are gone forever,
+                # so every re-execution fails until the scavenger marks
+                # a COMPLETED partition FAILED.
+                from lua_mapreduce_tpu.engine.job import JobTimes
+                times = JobTimes(started=time.time())
+                for name in v["files"]:
+                    store.remove(name)
+                times.finished = times.written = time.time()
+                return times
             raise RuntimeError(
                 f"reduce {v['part']}: {len(missing)} run file(s) not "
                 f"visible in storage (producers: "
@@ -306,11 +370,26 @@ class Worker:
             for pos, job in enumerate(jobs):
                 try:
                     times = body(self, spec, job)
-                except Exception:
+                except Exception as exc:
                     committed = self.store.commit_batch(ns, self.name, done)
                     self._settle_committed(ns, committed)
                     self.store.release_batch(ns, self.name, jids[pos + 1:])
-                    self._mark_broken(ns, job["_id"])
+                    if (is_transient_job_fault(exc)
+                            and self._release_budget_ok(ns, job["_id"])):
+                        # transient INFRA fault (a store burst that
+                        # outlived the retry budget — only classified
+                        # StoreErrors qualify; raw builtins from user
+                        # code never do): the job never failed on its
+                        # own inputs — release it back to WAITING with
+                        # NO repetition charge, so storage hiccups can
+                        # never march a good job to FAILED (DESIGN §19).
+                        # Deterministic faults (and transient bursts
+                        # past this worker's per-job release budget —
+                        # the liveness backstop) mark BROKEN below and
+                        # count toward the scavenger.
+                        self._release_infra(ns, job["_id"], exc)
+                    else:
+                        self._mark_broken(ns, job["_id"], exc)
                     raise
                 self._note_duration(ns, times.real)
                 done.append((job["_id"], _times_dict(times)))
@@ -332,7 +411,60 @@ class Worker:
                 if jid not in self._affinity:
                     self._affinity.append(jid)
 
-    def _mark_broken(self, ns: str, jid: int) -> None:
+    def _error_info(self, ns: str, jid: int, exc: Exception) -> dict:
+        """Structured post-mortem fields for an errors-stream entry:
+        exception class, provenance-aware infra/user classification,
+        and job context — so drained errors distinguish infra from
+        user-code failures without parsing tracebacks (DESIGN §19)."""
+        return {"exc_class": type(exc).__name__,
+                "exc_msg": str(exc)[:500],
+                "classification": classify_job_fault(exc),
+                "ns": ns, "job_id": jid}
+
+    def _release_budget_ok(self, ns: str, jid: int) -> bool:
+        """Liveness backstop for the release-not-broken path: THIS
+        worker releases any one job at most MAX_JOB_RETRIES times;
+        past that, the 'transient' fault is evidently pinned to the job
+        (a corrupt object only its reads hit, a permanently failing
+        range) and must march through BROKEN→FAILED like any
+        deterministic failure, or the task would livelock retrying it
+        forever. Per-worker budgets bound the global cycle count at
+        ~(workers × budget) even when claims rotate across the pool."""
+        key = (ns, jid)
+        n = self._infra_released.get(key, 0) + 1
+        self._infra_released[key] = n
+        return n <= MAX_JOB_RETRIES
+
+    def _release_infra(self, ns: str, jid: int, exc: Exception) -> None:
+        """Transient-infra failure path: job → WAITING (no repetition
+        bump — it never ran to a deterministic failure), error → errors
+        stream tagged 'infra-transient'. Same ownership/status CAS
+        discipline as _mark_broken: a requeued/re-claimed job is left
+        alone."""
+        from lua_mapreduce_tpu.faults.retry import COUNTERS
+        self.store.set_job_status(ns, jid, Status.WAITING,
+                                  expect=(Status.RUNNING,),
+                                  expect_worker=self.name)
+        COUNTERS.bump("infra_releases")
+        self.store.insert_error(self.name, self._abbrev_tb(),
+                                info=self._error_info(ns, jid, exc))
+        self._log(f"job {jid}: transient infra fault "
+                  f"({type(exc).__name__}) — released to WAITING, "
+                  "no repetition charged")
+
+    @staticmethod
+    def _abbrev_tb(max_lines: int = 30) -> str:
+        """The current exception's traceback, abbreviated to its tail —
+        deep retry/merge stacks would otherwise bloat the errors stream
+        past usefulness; the failing frames are always at the bottom."""
+        lines = traceback.format_exc().splitlines()
+        if len(lines) > max_lines:
+            lines = [f"... ({len(lines) - max_lines} traceback lines "
+                     "elided) ..."] + lines[-max_lines:]
+        return "\n".join(lines)
+
+    def _mark_broken(self, ns: str, jid: int,
+                     exc: Optional[Exception] = None) -> None:
         """Job → BROKEN (+1 repetition) and error → errors stream
         (reference job.lua:322-342, cnn.lua:62-66). CASed on ownership
         AND on the job still being RUNNING: if the claim was requeued
@@ -345,7 +477,8 @@ class Worker:
         self.store.set_job_status(ns, jid, Status.BROKEN,
                                   expect=(Status.RUNNING,),
                                   expect_worker=self.name)
-        self.store.insert_error(self.name, traceback.format_exc())
+        info = self._error_info(ns, jid, exc) if exc is not None else None
+        self.store.insert_error(self.name, self._abbrev_tb(), info=info)
 
     # -- main loop ----------------------------------------------------------
 
@@ -353,8 +486,12 @@ class Worker:
         """Run until max_iter idle polls or max_tasks tasks completed
         (reference worker.lua:42-138). Returns jobs executed. User-code
         errors mark the job BROKEN and count against MAX_WORKER_RETRIES;
-        the worker dies after 3 consecutive failures (worker.lua:133-137)."""
+        the worker dies after 3 consecutive failures (worker.lua:133-137).
+        Classified transient INFRA faults don't count toward that budget
+        — they back off and re-poll (up to MAX_INFRA_POLL_FAILURES), so
+        a coord-store brownout can't kill the fleet (DESIGN §19)."""
         retries = 0
+        infra_fails = 0
         idle_iters = 0
         tasks_done = 0
         sleep = DEFAULT_SLEEP
@@ -368,7 +505,30 @@ class Worker:
                 break
             try:
                 outcome = self.poll_once()
-            except Exception:
+            except Exception as exc:
+                if is_transient_job_fault(exc):
+                    # classified transient infra (a coord-store brownout
+                    # surfacing through the un-retried claim path, or a
+                    # job body's exhausted burst after its release): the
+                    # worker must OUTLIVE storage weather — back off and
+                    # re-poll instead of burning the 3-strike user-code
+                    # budget, which a sub-second blip would exhaust in
+                    # ~0.3s of fast polls and kill the whole fleet.
+                    # MAX_INFRA_POLL_FAILURES bounds a permanently dead
+                    # coord store (liveness, same shape as the beat
+                    # thread's log-and-backoff).
+                    infra_fails += 1
+                    if infra_fails >= MAX_INFRA_POLL_FAILURES:
+                        self._log(f"coord/store still failing after "
+                                  f"{infra_fails} backoffs; giving up")
+                        raise
+                    delay = min(2.0, 0.05 * (2.0 ** min(infra_fails, 10)))
+                    _log.warning("[%s] poll failed on transient infra "
+                                 "fault (%dx: %s: %s); retrying in %.2fs",
+                                 self.name, infra_fails,
+                                 type(exc).__name__, exc, delay)
+                    time.sleep(delay)
+                    continue
                 retries += 1
                 if retries >= MAX_WORKER_RETRIES:
                     self._log(f"giving up after {retries} failures")
@@ -376,6 +536,7 @@ class Worker:
                 time.sleep(DEFAULT_SLEEP)
                 continue
             retries = 0
+            infra_fails = 0
             if outcome == "executed":
                 saw_work = True
                 idle_iters = 0

@@ -1,0 +1,57 @@
+"""Deterministic fault injection + transient-fault-aware retry/degradation.
+
+The robustness subsystem (DESIGN §19). Four pieces:
+
+- ``errors``   — the :class:`StoreError` taxonomy and the central
+  transient/permanent classification table;
+- ``retry``    — :class:`RetryPolicy` (capped decorrelated-jitter
+  backoff, injectable clock/sleep) and the process-global
+  :class:`FaultCounters`;
+- ``plan``     — :class:`FaultPlan`, a seeded deterministic fault
+  schedule (transient / permanent / latency / torn write /
+  error-after-write / RPC faults);
+- ``wrappers`` — :class:`FaultyStore` / :class:`FaultyJobStore`
+  (injection) and :class:`RetryingStore` / :class:`RetryingJobStore`
+  (transparent retry with build readback-verify), plus the router and
+  engine wiring points.
+"""
+
+from lua_mapreduce_tpu.faults.errors import (ConcurrentInsertError,
+                                             InjectedFault,
+                                             InjectedPermanentFault,
+                                             NoTaskError,
+                                             PermanentStoreError, StoreError,
+                                             TransientStoreError,
+                                             classify_exception,
+                                             describe_classification,
+                                             is_transient_fault)
+from lua_mapreduce_tpu.faults.plan import FaultPlan
+from lua_mapreduce_tpu.faults.retry import (COUNTERS, FaultCounters,
+                                            RetryPolicy, configure_retry,
+                                            default_policy, retry_settings)
+from lua_mapreduce_tpu.faults.wrappers import (FaultyJobStore, FaultyStore,
+                                               RetryingJobStore,
+                                               RetryingStore, active_plan,
+                                               install_fault_plan, unwrap,
+                                               wiring_token, wrap_jobstore,
+                                               wrap_store)
+
+__all__ = [
+    "StoreError", "TransientStoreError", "PermanentStoreError",
+    "InjectedFault", "InjectedPermanentFault", "NoTaskError",
+    "ConcurrentInsertError", "classify_exception", "is_transient_fault",
+    "describe_classification",
+    "RetryPolicy", "FaultCounters", "COUNTERS", "configure_retry",
+    "retry_settings", "default_policy",
+    "FaultPlan",
+    "FaultyStore", "FaultyJobStore", "RetryingStore", "RetryingJobStore",
+    "install_fault_plan", "active_plan", "wrap_store", "wrap_jobstore",
+    "unwrap", "wiring_token",
+]
+
+
+def utest() -> None:
+    """Run the subsystem's module self-tests."""
+    from lua_mapreduce_tpu.faults import errors, plan, retry, wrappers
+    for mod in (errors, retry, plan, wrappers):
+        mod.utest()

@@ -1,0 +1,217 @@
+"""FaultPlan — deterministic, seeded fault schedules for chaos testing.
+
+None of the robustness machinery is testable against luck: the chaos
+suite needs to PROVOKE a 503 on exactly the third ranged read of one
+spill file, on every run, on every machine. A :class:`FaultPlan` is that
+schedule: the decision for an operation depends only on
+``(seed, op, name, occurrence_index)`` — hashed, never drawn from a
+shared RNG stream — so concurrent workers interleaving their ops cannot
+perturb each other's schedules, and a re-executed job (whose occurrence
+indices advance past the faulted ones) makes progress instead of
+re-faulting forever.
+
+Fault kinds (the failure modes the store/coord planes must survive):
+
+- ``transient``          — raise :class:`InjectedFault` (retryable)
+- ``permanent``          — raise :class:`InjectedPermanentFault`
+- ``latency``            — sleep ``latency_ms`` before the op
+- ``torn``               — build publishes a truncated file, then raises
+                           transient (readback-verify must detect the
+                           short object and rebuild)
+- ``error_after_write``  — build lands COMPLETELY, then raises transient
+                           (readback-verify must accept it and never
+                           publish a duplicate)
+- ``rpc_transient``      — transient faults on jobstore RPCs (claim /
+                           commit / heartbeat / counts ...)
+
+``max_per_key`` bounds the faults charged to one ``(op, name)`` stream,
+guaranteeing liveness under any retry budget. Plans serialize to a
+compact ``k=v;k=v`` spec so subprocess fleets inherit one through the
+``LMR_FAULT_PLAN`` environment variable (parsed by the router at
+store-wrap time).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import threading
+import time
+from typing import Dict, Optional
+
+_KINDS = ("transient", "permanent", "latency", "torn", "error_after_write",
+          "rpc_transient")
+
+# jobstore RPC op names (rate 'rpc_transient' applies; 'pattern' does not).
+# put_task/delete_task/drop_ns are idempotent on replay (overwrite /
+# tolerate-missing) — the server's inter-phase housekeeping must not
+# abort a whole task over one store blip any more than scavenge may
+RPC_OPS = frozenset({
+    "get_task", "put_task", "update_task", "delete_task", "drop_ns",
+    "claim_batch", "commit_batch", "release_batch", "heartbeat",
+    "heartbeat_batch", "set_job_status", "set_job_times", "counts",
+    "scavenge", "requeue_stale", "insert_error", "drain_errors",
+})
+
+# build-only kinds never apply to read ops and vice versa
+_BUILD_KINDS = ("torn", "error_after_write")
+
+
+class FaultPlan:
+    """Seeded deterministic fault schedule over store/coord operations."""
+
+    def __init__(self, seed: int = 0, *,
+                 transient: float = 0.0, permanent: float = 0.0,
+                 latency: float = 0.0, torn: float = 0.0,
+                 error_after_write: float = 0.0, rpc_transient: float = 0.0,
+                 latency_ms: float = 2.0, pattern: str = "*",
+                 max_per_key: int = 2,
+                 sleep=time.sleep):
+        self.seed = int(seed)
+        self.rates: Dict[str, float] = {
+            "transient": transient, "permanent": permanent,
+            "latency": latency, "torn": torn,
+            "error_after_write": error_after_write,
+            "rpc_transient": rpc_transient,
+        }
+        self.latency_ms = float(latency_ms)
+        self.pattern = pattern
+        self.max_per_key = int(max_per_key)
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._occ: Dict[tuple, int] = {}      # (op, name) -> occurrences
+        self._charged: Dict[tuple, int] = {}  # (op, name) -> faults fired
+        self.fired: Dict[str, int] = {}       # kind -> count (telemetry)
+
+    # -- decision ----------------------------------------------------------
+
+    def _uniform(self, op: str, name: str, k: int) -> float:
+        h = hashlib.blake2b(f"{self.seed}:{op}:{name}:{k}".encode(),
+                            digest_size=8).digest()
+        return int.from_bytes(h, "little") / 2.0 ** 64
+
+    def decide(self, op: str, name: str) -> Optional[str]:
+        """The fault kind for THIS occurrence of ``(op, name)``, or None.
+
+        Deterministic in (seed, op, name, occurrence index); the index
+        advances per call under a lock, so each logical op stream sees
+        its own reproducible schedule regardless of thread interleaving
+        across different keys.
+        """
+        key = (op, name)
+        is_rpc = op in RPC_OPS
+        # one lock hold for check + decide + charge: a split
+        # check-then-act would let two threads racing the same stream
+        # both pass the cap check and overshoot max_per_key — the
+        # liveness guarantee the chaos suites' zero-repetition
+        # assertions lean on (cap < retry budget must stay true)
+        with self._lock:
+            k = self._occ[key] = self._occ.get(key, 0) + 1
+            if self._charged.get(key, 0) >= self.max_per_key:
+                return None
+            if not is_rpc and not fnmatch.fnmatchcase(name, self.pattern):
+                return None
+            u = self._uniform(op, name, k)
+            acc = 0.0
+            for kind in _KINDS:
+                if is_rpc != (kind == "rpc_transient"):
+                    continue
+                if kind in _BUILD_KINDS and op != "build":
+                    continue
+                if not is_rpc and kind not in _BUILD_KINDS and op == "build":
+                    # builds only tear / error-after-write / lag — a
+                    # plain pre-op transient on build is
+                    # indistinguishable from error_after_write=never,
+                    # so keep the kinds orthogonal
+                    if kind != "latency":
+                        continue
+                acc += self.rates[kind]
+                if u < acc:
+                    self._charged[key] = self._charged.get(key, 0) + 1
+                    self.fired[kind] = self.fired.get(kind, 0) + 1
+                    return kind
+        return None
+
+    def apply_latency(self) -> None:
+        if self.latency_ms > 0:
+            self._sleep(self.latency_ms / 1000.0)
+
+    def total_fired(self) -> int:
+        with self._lock:
+            return sum(self.fired.values())
+
+    # -- spec round-trip (subprocess inheritance) --------------------------
+
+    def to_spec(self) -> str:
+        parts = [f"seed={self.seed}"]
+        parts += [f"{k}={v:g}" for k, v in self.rates.items() if v > 0]
+        if self.latency_ms != 2.0:
+            parts.append(f"latency_ms={self.latency_ms:g}")
+        if self.pattern != "*":
+            parts.append(f"pattern={self.pattern}")
+        if self.max_per_key != 2:
+            parts.append(f"max_per_key={self.max_per_key}")
+        return ";".join(parts)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """Parse ``seed=7;transient=0.05;latency=0.02;pattern=*.SPILL-*``.
+        Unknown keys are rejected loudly — a typo in a chaos-test spec
+        must not silently run fault-free."""
+        kw: Dict[str, object] = {}
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            k, sep, v = part.partition("=")
+            if not sep:
+                raise ValueError(f"bad fault-plan entry {part!r}")
+            k = k.strip()
+            if k == "pattern":
+                kw[k] = v.strip()
+            elif k in ("seed", "max_per_key"):
+                kw[k] = int(v)
+            elif k in _KINDS or k == "latency_ms":
+                kw[k] = float(v)
+            else:
+                raise ValueError(f"unknown fault-plan key {k!r}")
+        seed = int(kw.pop("seed", 0))
+        return cls(seed, **kw)  # type: ignore[arg-type]
+
+
+def utest() -> None:
+    """Self-test: determinism, occurrence advance, caps, spec round-trip."""
+    mk = lambda: FaultPlan(7, transient=0.5, latency=0.2, max_per_key=3,
+                           sleep=lambda s: None)
+    a, b = mk(), mk()
+    seq_a = [a.decide("read_range", "f.P0.M1") for _ in range(40)]
+    seq_b = [b.decide("read_range", "f.P0.M1") for _ in range(40)]
+    assert seq_a == seq_b                      # identical schedules
+    assert any(k == "transient" for k in seq_a)
+    assert sum(k is not None for k in seq_a) <= 3   # max_per_key cap
+
+    # independent (op, name) streams don't perturb each other
+    c = mk()
+    for _ in range(5):
+        c.decide("size", "other")
+    assert [c.decide("read_range", "f.P0.M1") for _ in range(40)] == seq_a
+
+    # build-only kinds fire only on build; rpc rate only on RPC ops
+    p = FaultPlan(1, torn=1.0, max_per_key=100)
+    assert all(p.decide("read_range", "x") is None for _ in range(10))
+    assert p.decide("build", "x") == "torn"
+    r = FaultPlan(2, rpc_transient=1.0, max_per_key=100)
+    assert r.decide("claim_batch", "map_jobs") == "rpc_transient"
+    assert r.decide("read_range", "map_jobs") is None
+
+    spec = FaultPlan(9, transient=0.25, error_after_write=0.5,
+                     pattern="*.SPILL-*", max_per_key=1).to_spec()
+    q = FaultPlan.from_spec(spec)
+    assert (q.seed, q.pattern, q.max_per_key) == (9, "*.SPILL-*", 1)
+    assert q.rates["error_after_write"] == 0.5
+    try:
+        FaultPlan.from_spec("seed=1;bogus=2")
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("unknown plan key must be rejected")

@@ -82,6 +82,17 @@ class FileBuilder(abc.ABC):
 class Store(abc.ABC):
     """A named-file store with streaming line reads and glob listing."""
 
+    # True when a FAILED ``build`` may nonetheless have published (a
+    # network PUT that errored after the object landed) or published
+    # TORN — the retry layer then retains written chunks for readback-
+    # verify + rebuild (DESIGN §19). Backends whose publish is a local
+    # atomic tempfile+rename (memfs, sharedfs, the objectfs local
+    # emulation) override to False: a failed build provably did not
+    # publish, so retaining replay chunks would only duplicate the
+    # spill in memory. The conservative default covers third-party
+    # stores the taxonomy knows nothing about.
+    publish_ambiguous = True
+
     @abc.abstractmethod
     def builder(self) -> FileBuilder:
         ...
@@ -129,6 +140,19 @@ class Store(abc.ABC):
             # code points >255 ⇒ genuine text (v1 JSON with raw unicode,
             # ensure_ascii=False), never shim-written segment bytes
             return data.encode("utf-8")
+
+    # -- fault classification (DESIGN §19) ---------------------------------
+
+    def classify(self, exc: BaseException):
+        """Transient/permanent verdict for an exception THIS backend's
+        ops can raise: True = transient (the retry layer may re-attempt
+        the op), False = permanent (it must not), None = not a storage
+        fault (user/data/logic errors propagate untouched). The base
+        implementation is the central taxonomy
+        (faults/errors.classify_exception); backends refine it for their
+        own error shapes (objectfs adds GCS API errors)."""
+        from lua_mapreduce_tpu.faults.errors import classify_exception
+        return classify_exception(exc)
 
     # -- shared helpers ----------------------------------------------------
 

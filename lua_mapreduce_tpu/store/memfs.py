@@ -46,6 +46,8 @@ class _MemBuilder(FileBuilder):
 class MemStore(Store):
     """Dict-of-files store; ``build`` swaps content in atomically."""
 
+    publish_ambiguous = False   # a failed build provably published nothing
+
     def __init__(self):
         self._files: Dict[str, Union[str, bytes]] = {}
         self._lock = threading.Lock()
@@ -83,6 +85,13 @@ class MemStore(Store):
     def remove(self, name: str) -> None:
         with self._lock:
             self._files.pop(name, None)
+
+    def classify(self, exc: BaseException):
+        """Host DRAM cannot fail transiently: a missing name (KeyError —
+        the in-memory FileNotFoundError) is permanent, a rule the
+        central taxonomy already carries — declared explicitly so the
+        backend's contract is visible at the class, per DESIGN §19."""
+        return super().classify(exc)
 
 
 def utest() -> None:

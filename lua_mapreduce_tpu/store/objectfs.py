@@ -109,6 +109,10 @@ class ObjectStore(Store):
             self._gcs = None
             self._dir = uri
             os.makedirs(uri, exist_ok=True)
+            # local emulation publishes via atomic os.replace; only the
+            # real-GCS network PUT can error after landing (class
+            # default True stands for the gs:// branch)
+            self.publish_ambiguous = False
 
     # -- object primitives (PUT/GET/ranged GET/LIST/DELETE — no rename or
     # append) ---------------------------------------------------------------
@@ -194,6 +198,19 @@ class ObjectStore(Store):
         if self._gcs is not None:
             return self._gcs.blob(self._key(name)).exists()
         return os.path.exists(os.path.join(self._dir, _encode(name)))
+
+    def classify(self, exc: BaseException):
+        """Object-store error shapes on top of the central taxonomy:
+        google-api-core exceptions carry a numeric ``code`` (503/429/5xx
+        → transient; 404 NotFound → permanent) and requests transport
+        errors match by class name — both handled WITHOUT importing the
+        optional SDKs (faults/errors.py), plus NotFound-by-name here."""
+        if type(exc).__name__ in ("NotFound", "Forbidden"):
+            return False
+        code = getattr(exc, "code", None)
+        if isinstance(code, int) and code in (403, 404, 410):
+            return False
+        return super().classify(exc)
 
     def remove(self, name: str) -> None:
         if self._gcs is not None:

@@ -27,6 +27,9 @@ _ALIASES = {
 
 # process-wide mem stores by tag so server + in-process workers share one
 _mem_stores: dict = {}
+# wrapped mem:tag instances, memoized per fault/retry wiring generation:
+# callers rely on `get_storage_from("mem:t") is get_storage_from("mem:t")`
+_mem_wrapped: dict = {}
 
 
 def parse_storage(spec: str) -> Tuple[str, Optional[str]]:
@@ -48,17 +51,32 @@ def get_storage_from(spec: str) -> Store:
     not clobber each other's namespaces); ``mem:tag`` returns the
     process-wide shared store for that tag (how a server and in-process
     workers share intermediate data).
+
+    Every returned store passes through the fault wiring
+    (faults.wrap_store, DESIGN §19): a retry layer whenever the
+    process's retry budget is > 0 (the default), and deterministic
+    fault injection when a FaultPlan is installed (chaos suites /
+    ``LMR_FAULT_PLAN``). ``mem:tag`` wrappers are memoized per wiring
+    generation so the shared-instance identity contract holds.
     """
+    from lua_mapreduce_tpu.faults.wrappers import wiring_token, wrap_store
     backend, path = parse_storage(spec)
     if backend == "mem":
         if path is None:
-            return MemStore()
-        if path not in _mem_stores:
-            _mem_stores[path] = MemStore()
-        return _mem_stores[path]
+            return wrap_store(MemStore())
+        token = wiring_token()
+        cached = _mem_wrapped.get(path)
+        if cached is not None and cached[0] == token:
+            return cached[1]
+        raw = _mem_stores.get(path)
+        if raw is None:
+            raw = _mem_stores[path] = MemStore()
+        wrapped = wrap_store(raw)
+        _mem_wrapped[path] = (token, wrapped)
+        return wrapped
     if backend == "shared":
-        return SharedStore(path)
-    return ObjectStore(path)
+        return wrap_store(SharedStore(path))
+    return wrap_store(ObjectStore(path))
 
 
 def router(spec: str) -> Store:

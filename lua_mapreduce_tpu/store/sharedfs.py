@@ -171,6 +171,9 @@ def _writer_loop(q: "queue.Queue", f, err_box: List[BaseException]) -> None:
 
 
 class SharedStore(Store):
+    # tempfile + fsync + atomic os.replace: a failed build did not publish
+    publish_ambiguous = False
+
     def __init__(self, path: str):
         self.path = path
         os.makedirs(path, exist_ok=True)  # fs.lua sharedfs mkdir -p
@@ -216,3 +219,10 @@ class SharedStore(Store):
             os.remove(os.path.join(self.path, _encode(name)))
         except FileNotFoundError:
             pass
+
+    def classify(self, exc: BaseException):
+        """POSIX/NFS error shapes: the central errno taxonomy already
+        covers them (EIO/ESTALE/EAGAIN transient; ENOENT/EACCES
+        permanent) — declared explicitly so the backend's contract is
+        visible at the class, per DESIGN §19."""
+        return super().classify(exc)

@@ -79,6 +79,21 @@ class IterationStats:
     # coord bench aggregates them explicitly.
     claim_rounds: int = 0
     commit_rounds: int = 0
+    # fault-plane accounting (DESIGN §19), folded from the process-global
+    # FaultCounters deltas exactly like the round counters above:
+    #   store_retries  — transient store/coord faults absorbed by a
+    #                    backoff-retry (the op eventually succeeded)
+    #   store_faults   — faults that were NOT absorbed silently: retry
+    #                    budgets exhausted + injected FaultPlan events
+    #   infra_releases — jobs released back to WAITING on transient
+    #                    infra faults (no repetition charged)
+    #   degraded_reads — ranged segment reads that fell back to a
+    #                    whole-file read (the degradation ladder's
+    #                    read-side rung)
+    store_retries: int = 0
+    store_faults: int = 0
+    infra_releases: int = 0
+    degraded_reads: int = 0
 
     @property
     def cluster_time(self) -> float:
@@ -97,6 +112,10 @@ class IterationStats:
             "overlap_fraction": self.overlap_fraction,
             "claim_rounds": self.claim_rounds,
             "commit_rounds": self.commit_rounds,
+            "store_retries": self.store_retries,
+            "store_faults": self.store_faults,
+            "infra_releases": self.infra_releases,
+            "degraded_reads": self.degraded_reads,
             "cluster_time": self.cluster_time,
             "wall_time": self.wall_time,
         }

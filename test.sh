@@ -20,6 +20,11 @@ echo "collect gate: tests/ collects cleanly"
 python -m pytest tests/test_segment.py -q
 LMR_DISABLE_NATIVE=1 python -m pytest tests/test_segment.py -q
 echo "segment conformance: python + native merge engines agree"
+# chaos-smoke gate (DESIGN §19): one seeded FaultPlan wordcount leg per
+# storage backend, byte-compared against its fault-free twin — the
+# retry/degradation layer must make injected transient faults invisible
+python -m pytest tests/test_chaos.py -q -k "smoke"
+echo "chaos smoke: injected faults invisible on all three backends"
 # lmr-analyze gate: the framework-aware lint pass must be clean against
 # the checked-in suppression baseline (analysis/baseline.json — shipped
 # EMPTY), and the lease-protocol model checker must exhaustively pass

@@ -265,6 +265,52 @@ def test_lmr006_utf8_shim_flagged_latin1_passes(tmp_path):
     assert [(f.rule, f.line) for f in got] == [("LMR006", 3)]
 
 
+# --- LMR008 classified raisables across the retry boundary ------------------
+
+def test_lmr008_generic_raise_in_store_op_flagged(tmp_path):
+    got = _lint_snippet(tmp_path, "store/fx.py", """\
+        class MyStore:
+            def read_range(self, name, offset, length):
+                raise RuntimeError("backend hiccup")
+
+            def build(self, name):
+                raise OSError("publish failed")
+        """)
+    assert [f.rule for f in got] == ["LMR008", "LMR008"]
+    assert got[0].line == 3 and got[1].line == 6
+
+
+def test_lmr008_classified_and_out_of_scope_raises_pass(tmp_path):
+    got = _lint_snippet(tmp_path, "coord/fx.py", """\
+        class MyJobStore:
+            def update_task(self, fields):
+                raise NoTaskError("no task document")
+
+            def commit_batch(self, entries, worker):
+                raise NativeIndexError("jsx_commit_batch failed")
+
+            def lines(self, name):
+                raise FileNotFoundError(name)      # taxonomy maps it
+
+            def helper_not_an_op(self):
+                raise RuntimeError("not a retry-boundary method")
+
+            def claim(self, worker):
+                raise self._err_box[0]             # re-raise: unknowable
+        """)
+    assert got == []
+
+
+def test_lmr008_scoped_to_store_and_coord(tmp_path):
+    # the same generic raise in engine/ is out of the rule's paths
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        class Runner:
+            def build(self, name):
+                raise RuntimeError("engine-side, different contract")
+        """)
+    assert all(f.rule != "LMR008" for f in got)
+
+
 # --- LMR007 jax purity -----------------------------------------------------
 
 def test_lmr007_impure_traced_functions_flagged(tmp_path):
@@ -345,7 +391,7 @@ def test_shipped_baseline_is_empty():
 
 def test_rule_catalog_complete():
     rules = lint_mod.all_rules()
-    assert [r.id for r in rules] == [f"LMR00{i}" for i in range(1, 8)]
+    assert [r.id for r in rules] == [f"LMR00{i}" for i in range(1, 9)]
     for r in rules:
         assert r.title and r.rationale and r.severity in ("error", "warning")
 

@@ -1,0 +1,215 @@
+"""Chaos suite (DESIGN §19): the wordcount matrix under seeded
+FaultPlans.
+
+Each leg runs the same wordcount task twice — fault-free, then under a
+deterministic FaultPlan injecting transient errors + latency +
+error-after-write (and torn writes on the heavier legs) — across
+{mem, shared, object} storage × {barrier, pipelined} shuffle × both
+executors (LocalExecutor and the distributed Server + in-process
+Worker pool), and asserts:
+
+1. byte-identical outputs: the injected faults are invisible in the
+   results;
+2. ZERO repetition bumps attributable to injected transient faults
+   (the distributed legs check every job's repetitions == 0 — the
+   tentpole's release-not-broken contract);
+3. the plan actually fired (a chaos run that injected nothing proves
+   nothing).
+
+The smoke legs (`-k smoke`) are the test.sh chaos gate: one seeded
+plan per backend, fast. The full matrix is the tier-1 chaos suite.
+"""
+
+import threading
+from typing import Dict
+
+import pytest
+
+from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+from lua_mapreduce_tpu.core.constants import Status
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.local import LocalExecutor, iter_results
+from lua_mapreduce_tpu.engine.server import Server
+from lua_mapreduce_tpu.engine.worker import MAP_NS, PRE_NS, RED_NS, Worker
+from lua_mapreduce_tpu.faults import FaultPlan, install_fault_plan
+from lua_mapreduce_tpu.store.router import get_storage_from
+
+CORPUS = {
+    f"doc{i}": " ".join(f"w{(i * 7 + j) % 23}" for j in range(40))
+    for i in range(8)
+}
+GOLDEN: Dict[str, int] = {}
+for _text in CORPUS.values():
+    for _w in _text.split():
+        GOLDEN[_w] = GOLDEN.get(_w, 0) + 1
+
+_MOD = "tests._chaos_wc"
+
+
+def _install_module():
+    """The wordcount program as an importable module (the distributed
+    engine round-trips specs through module paths)."""
+    import sys
+    import types
+
+    mod = sys.modules.get(_MOD)
+    if mod is None:
+        mod = types.ModuleType(_MOD)
+
+        def taskfn(emit):
+            for k, v in sorted(CORPUS.items()):
+                emit(k, v)
+
+        def mapfn(key, value, emit):
+            for w in value.split():
+                emit(w, 1)
+
+        mod.taskfn = taskfn
+        mod.mapfn = mapfn
+        mod.partitionfn = lambda key: sum(key.encode()) % 4
+        mod.reducefn = lambda key, values: sum(values)
+        sys.modules[_MOD] = mod
+    return mod
+
+
+def _storage(tmp_path, backend, tag):
+    return {"mem": f"mem:{tag}",
+            "shared": f"shared:{tmp_path}/shared-{tag}",
+            "object": f"object:{tmp_path}/object-{tag}"}[backend]
+
+
+def _result_bytes(storage_spec, ns="result"):
+    """The result namespace's exact bytes, partition by partition — the
+    byte-compare oracle."""
+    store = get_storage_from(storage_spec)
+    out = {}
+    for name in store.list(f"{ns}.P*"):
+        out[name] = "".join(store.lines(name))
+    return out
+
+
+def _plan(seed, heavy=False):
+    """The acceptance-criteria mix: transient + latency +
+    error-after-write (+ torn on heavy legs); latency_ms kept tiny so
+    the suite stays fast. max_per_key=2 < the default retry budget of
+    3, so every injected burst is absorbable — zero repetition bumps is
+    therefore a hard assertion, not a hope."""
+    return FaultPlan(seed, transient=0.08, latency=0.05,
+                     error_after_write=0.3,
+                     torn=0.2 if heavy else 0.0,
+                     latency_ms=1.0, max_per_key=2)
+
+
+def _run_local(tmp_path, backend, pipeline, tag, plan=None):
+    _install_module()
+    spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
+                    reducefn=_MOD,
+                    storage=_storage(tmp_path, backend, tag))
+    install_fault_plan(plan)
+    try:
+        ex = LocalExecutor(spec, map_parallelism=3, pipeline=pipeline,
+                           premerge_min_runs=2,
+                           segment_format="v2" if pipeline else "v1")
+        stats = ex.run()
+    finally:
+        install_fault_plan(None)
+    got = {k: v[0] for k, v in ex.results()}
+    assert got == GOLDEN
+    return _result_bytes(spec.storage), stats
+
+
+def _run_distributed(tmp_path, backend, pipeline, tag, plan=None,
+                     n_workers=2):
+    _install_module()
+    spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
+                    reducefn=_MOD,
+                    storage=_storage(tmp_path, backend, tag))
+    store = MemJobStore()
+    install_fault_plan(plan)
+    try:
+        server = Server(store, poll_interval=0.01, pipeline=pipeline,
+                        premerge_min_runs=2, batch_k=2,
+                        segment_format="v2" if pipeline else "v1",
+                        ).configure(spec)
+        workers = [Worker(store).configure(max_iter=800, max_sleep=0.02)
+                   for _ in range(n_workers)]
+        threads = [threading.Thread(target=w.execute, daemon=True)
+                   for w in workers]
+        for t in threads:
+            t.start()
+        stats = server.loop()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        install_fault_plan(None)
+
+    # the release-not-broken contract: NO repetition bump from any
+    # injected transient fault, in any namespace
+    for ns in (MAP_NS, PRE_NS, RED_NS):
+        for d in store.jobs(ns):
+            assert d["repetitions"] == 0, \
+                (f"injected transient faults bumped repetitions: "
+                 f"{ns} job {d['_id']} -> {d['repetitions']}")
+        counts = store.counts(ns)
+        assert counts[Status.FAILED] == 0
+    got = {k: v[0]
+           for k, v in iter_results(get_storage_from(spec.storage),
+                                    "result")}
+    assert got == GOLDEN
+    return _result_bytes(spec.storage), stats
+
+
+# --- smoke legs: the test.sh chaos gate (one seeded plan per backend) -------
+
+@pytest.mark.parametrize("backend", ["mem", "shared", "object"])
+def test_chaos_smoke_backend(tmp_path, backend):
+    clean, _ = _run_local(tmp_path, backend, False, f"smoke-{backend}-c")
+    plan = _plan(seed=100 + len(backend))
+    chaotic, stats = _run_local(tmp_path, backend, False,
+                                f"smoke-{backend}-f", plan=plan)
+    assert chaotic == clean, "fault leg output differs from fault-free"
+    assert plan.total_fired() > 0, "plan injected nothing — seed too weak"
+    assert stats.iterations[-1].store_faults > 0
+
+
+# --- the full matrix ---------------------------------------------------------
+
+@pytest.mark.parametrize("pipeline", [False, True],
+                         ids=["barrier", "pipelined"])
+@pytest.mark.parametrize("backend", ["mem", "shared", "object"])
+def test_chaos_local_matrix(tmp_path, backend, pipeline):
+    tag = f"loc-{backend}-{int(pipeline)}"
+    clean, _ = _run_local(tmp_path, backend, pipeline, tag + "-c")
+    plan = _plan(seed=7)
+    chaotic, _ = _run_local(tmp_path, backend, pipeline, tag + "-f",
+                            plan=plan)
+    assert chaotic == clean
+    assert plan.total_fired() > 0
+
+
+@pytest.mark.parametrize("pipeline", [False, True],
+                         ids=["barrier", "pipelined"])
+@pytest.mark.parametrize("backend", ["mem", "shared", "object"])
+def test_chaos_distributed_matrix(tmp_path, backend, pipeline):
+    tag = f"dist-{backend}-{int(pipeline)}"
+    clean, _ = _run_distributed(tmp_path, backend, pipeline, tag + "-c")
+    plan = _plan(seed=13, heavy=True)
+    chaotic, stats = _run_distributed(tmp_path, backend, pipeline,
+                                      tag + "-f", plan=plan)
+    assert chaotic == clean
+    assert plan.total_fired() > 0
+    it = stats.iterations[-1]
+    assert it.map.failed == 0 and it.reduce.failed == 0
+
+
+def test_chaos_rpc_faults_on_coord_plane(tmp_path):
+    """Transient faults injected on the JOBSTORE RPCs (claims, commits,
+    heartbeats) — the control-plane half of the tentpole — are absorbed
+    with identical results and zero repetition bumps."""
+    tag = "rpc-leg"
+    clean, _ = _run_distributed(tmp_path, "mem", False, tag + "-c")
+    plan = FaultPlan(17, rpc_transient=0.1, max_per_key=2)
+    chaotic, _ = _run_distributed(tmp_path, "mem", False, tag + "-f",
+                                  plan=plan)
+    assert chaotic == clean
+    assert plan.fired.get("rpc_transient", 0) > 0

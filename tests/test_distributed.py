@@ -577,8 +577,14 @@ def test_sigkilled_worker_job_is_requeued(tmp_path):
     killed = {}
     # a healthy worker thread completes everything the victim abandons; it
     # must NOT start until the victim has claimed a job, or (on a 1-core
-    # box) it drains every map while the victim is still booting Python
-    healthy = Worker(store).configure(max_iter=800, max_sleep=0.05)
+    # box) it drains every map while the victim is still booting Python.
+    # Fast heartbeats: under machine load a job body can outlive the 1.0s
+    # stale timeout, and a beat-less LIVE worker's lease would be requeued
+    # with a repetition charge — three of those march a good job to FAILED
+    # and flake the failed==0 assert. Beating pins repetition bumps to the
+    # SIGKILLed victim, which is what the test is about.
+    healthy = Worker(store).configure(max_iter=800, max_sleep=0.05,
+                                      heartbeat_s=0.25)
     ht = threading.Thread(target=healthy.execute, daemon=True)
     once = threading.Lock()
 

@@ -1,0 +1,508 @@
+"""Fault subsystem tests (DESIGN §19): taxonomy, virtual-clock retry,
+deterministic injection, build readback-verify, worker fault
+discrimination (release-not-broken), heartbeat-thread resilience, the
+errors-stream classification fields, and the ranged-read degradation."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from lua_mapreduce_tpu.coord.filestore import FileJobStore
+from lua_mapreduce_tpu.coord.jobstore import MemJobStore, make_job
+from lua_mapreduce_tpu.core.constants import Status
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.worker import Worker
+from lua_mapreduce_tpu.faults import (COUNTERS, FaultPlan, FaultyStore,
+                                      PermanentStoreError, RetryingStore,
+                                      RetryPolicy, TransientStoreError,
+                                      install_fault_plan, unwrap)
+from lua_mapreduce_tpu.store.memfs import MemStore
+from lua_mapreduce_tpu.store.router import get_storage_from
+
+
+def _policy(retries=3):
+    return RetryPolicy(retries=retries, base_ms=1, sleep=lambda s: None,
+                       rng=random.Random(0))
+
+
+# --- retry schedule on a virtual clock --------------------------------------
+
+def test_backoff_is_decorrelated_jitter_and_capped():
+    sleeps = []
+    p = RetryPolicy(retries=6, base_ms=20, cap_ms=100,
+                    sleep=sleeps.append, rng=random.Random(3))
+    with pytest.raises(TransientStoreError):
+        p.call(lambda: (_ for _ in ()).throw(TimeoutError("x")),
+               op="size", name="f")
+    assert len(sleeps) == 6
+    assert all(0.02 <= s <= 0.1 for s in sleeps)
+    # decorrelated: the window widens with the previous draw
+    assert sleeps != sorted(sleeps, reverse=True)
+
+
+def test_retry_layer_never_retries_permanent_or_user_errors():
+    calls = [0]
+
+    def boom():
+        calls[0] += 1
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        _policy().call(boom, op="lines", name="f")
+    assert calls[0] == 1          # no second attempt, raw type preserved
+
+
+# --- deterministic injection through a routed store -------------------------
+
+def test_env_plan_activates_and_deactivates(monkeypatch):
+    monkeypatch.setenv("LMR_FAULT_PLAN",
+                       "seed=11;transient=0.4;max_per_key=2")
+    s1 = get_storage_from("mem:_fault_env_t")
+    assert isinstance(s1, RetryingStore)
+    assert isinstance(s1._inner, FaultyStore)
+    monkeypatch.delenv("LMR_FAULT_PLAN")
+    s2 = get_storage_from("mem:_fault_env_t")
+    assert isinstance(s2, RetryingStore)
+    assert not isinstance(s2._inner, FaultyStore)
+    assert unwrap(s1) is unwrap(s2)   # same underlying tagged store
+
+
+def test_injected_bursts_are_absorbed_end_to_end():
+    plan = FaultPlan(21, transient=0.3, latency=0.1, latency_ms=0.0,
+                     max_per_key=2, sleep=lambda s: None)
+    install_fault_plan(plan)
+    try:
+        store = get_storage_from("mem:_fault_burst_t")
+        with store.builder() as b:
+            b.write("x 1\n")
+            b.build("runs.P0.M1")
+        for _ in range(30):
+            assert list(store.lines("runs.P0.M1")) == ["x 1\n"]
+            assert store.exists("runs.P0.M1")
+            assert store.list("runs.*") == ["runs.P0.M1"]
+    finally:
+        install_fault_plan(None)
+    assert plan.total_fired() > 0     # the schedule really fired
+
+
+# --- build ambiguity (readback-verify) --------------------------------------
+
+def test_error_after_write_never_duplicates_published_segment():
+    raw = MemStore()
+    plan = FaultPlan(31, error_after_write=1.0, max_per_key=1,
+                     sleep=lambda s: None)
+    store = RetryingStore(FaultyStore(raw, plan), _policy())
+    with store.builder() as b:
+        b.write("v1 line\n")
+        b.build("seg")
+    # landed exactly once, whole, despite the post-publish error
+    assert list(raw.lines("seg")) == ["v1 line\n"]
+    assert plan.fired == {"error_after_write": 1}
+
+
+def test_torn_write_detected_and_rebuilt_whole():
+    plan = FaultPlan(32, torn=1.0, max_per_key=1, sleep=lambda s: None)
+    raw = MemStore()
+    store = RetryingStore(FaultyStore(raw, plan), _policy())
+    with store.builder() as b:
+        for i in range(50):
+            b.write(f"record {i:04d}\n")
+        b.build("spill")
+    assert len(list(raw.lines("spill"))) == 50
+    assert raw.size("spill") == 50 * len("record 0000\n")
+
+
+# --- worker fault discrimination --------------------------------------------
+
+def _spec(mapfn, tag):
+    return TaskSpec(taskfn=lambda emit: emit("k", 1), mapfn=mapfn,
+                    partitionfn=lambda key: 0,
+                    reducefn=lambda key, values: sum(values),
+                    storage=f"mem:{tag}")
+
+
+def _one_claimed_job(store, worker):
+    store.insert_jobs("map_jobs", [make_job("k", 1)])
+    jobs = worker.store.claim_batch("map_jobs", worker.name, 1)
+    assert len(jobs) == 1
+    return jobs
+
+
+@pytest.mark.parametrize("exc,status,reps,classification", [
+    (TransientStoreError("503 burst"), Status.WAITING, 0,
+     "infra-transient"),
+    (PermanentStoreError("bucket gone"), Status.BROKEN, 1,
+     "infra-permanent"),
+    (ValueError("user bug"), Status.BROKEN, 1, "user-code"),
+    # provenance matters: a RAW TimeoutError out of a job body is USER
+    # code (an http call in a mapfn), not a releasable infra fault —
+    # only StoreError subclasses provably crossed the store boundary
+    (TimeoutError("user timeout"), Status.BROKEN, 1, "user-code"),
+], ids=["transient-releases", "permanent-breaks", "user-code-breaks",
+        "raw-builtin-is-user-code"])
+def test_worker_discriminates_infra_from_user_faults(exc, status, reps,
+                                                     classification):
+    """The tentpole contract: transient infra faults release the job
+    back to WAITING with NO repetition charge; deterministic faults
+    (user code, permanent infra) mark BROKEN exactly as before."""
+    store = MemJobStore()
+    w = Worker(store, name="wdisc")
+    w.heartbeat_s = 0          # keep the test single-threaded
+
+    def mapfn(key, value, emit):
+        raise exc
+
+    jobs = _one_claimed_job(store, w)
+    with pytest.raises(type(exc)):
+        w._execute_batch(_spec(mapfn, f"wdisc-{classification}"),
+                         "map_jobs", jobs)
+    d = store.get_job("map_jobs", 0)
+    assert d["status"] == status
+    assert d["repetitions"] == reps
+    (err,) = store.drain_errors()
+    assert err["classification"] == classification
+    assert err["exc_class"] == type(exc).__name__
+    assert err["ns"] == "map_jobs" and err["job_id"] == 0
+    assert err["msg"]            # abbreviated traceback present
+
+
+def test_release_budget_bounds_pinned_transient_faults():
+    """Liveness backstop: a job whose every execution raises a
+    'transient' StoreError (a fault pinned to the job — corrupt object
+    only its reads hit) is released at most MAX_JOB_RETRIES times per
+    worker, then marches through BROKEN like a deterministic failure —
+    no infinite release/re-claim livelock."""
+    from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES
+
+    store = MemJobStore()
+    store.insert_jobs("map_jobs", [make_job("k", 1)])
+    w = Worker(store, name="wbudget")
+    w.heartbeat_s = 0
+
+    def mapfn(key, value, emit):
+        raise TransientStoreError("pinned fault")
+
+    spec = _spec(mapfn, "wbudget")
+    for attempt in range(MAX_JOB_RETRIES + 1):
+        jobs = w.store.claim_batch("map_jobs", "wbudget", 1)
+        assert jobs, f"job not claimable on attempt {attempt}"
+        with pytest.raises(TransientStoreError):
+            w._execute_batch(spec, "map_jobs", jobs)
+        d = store.get_job("map_jobs", 0)
+        if attempt < MAX_JOB_RETRIES:
+            assert d["status"] == Status.WAITING and d["repetitions"] == 0
+        else:
+            assert d["status"] == Status.BROKEN and d["repetitions"] == 1
+
+
+def test_release_budget_resets_per_task_iteration():
+    """The per-job release budget is scoped to ONE (task, iteration):
+    namespaces are dropped and re-inserted per iteration, so job ids
+    restart at 0 — a budget carried across iterations would wrongly
+    charge iteration N+1's job 0 for iteration N's releases, and after
+    a few iterations every transient infra fault on a recurring id
+    would take the BROKEN path (the exact repetition charge the
+    release mechanism exists to prevent)."""
+    from lua_mapreduce_tpu.core.constants import TaskStatus
+
+    store = MemJobStore()
+    w = Worker(store, name="wgen")
+    spec = TaskSpec(taskfn="examples.wordcount.taskfn",
+                    mapfn="examples.wordcount.mapfn",
+                    partitionfn="examples.wordcount.partitionfn",
+                    reducefn="examples.wordcount.reducefn",
+                    storage="mem:wgen")
+    store.put_task({"_id": "unique", "status": TaskStatus.MAP.value,
+                    "iteration": 1, "spec": spec.describe(),
+                    "pipeline": False, "batch_k": 1,
+                    "segment_format": "v1"})
+
+    assert w.poll_once() == "idle"          # no claimable jobs
+    w._infra_released[("map_jobs", 0)] = 3  # budget consumed this iter
+    assert w.poll_once() == "idle"          # same iteration: retained
+    assert w._infra_released == {("map_jobs", 0): 3}
+
+    store.update_task({"iteration": 2})     # namespaces restart at id 0
+    assert w.poll_once() == "idle"
+    assert w._infra_released == {}
+
+    w._infra_released[("map_jobs", 0)] = 3
+    store.update_task({"status": TaskStatus.FINISHED.value})
+    assert w.poll_once() == "finished"      # task over: budget dropped
+    assert w._infra_released == {}
+
+
+def test_worker_poll_loop_survives_coord_brownout(monkeypatch):
+    """A transient coord-store burst on the UN-retried claim path must
+    not kill the worker: classified infra faults back off and re-poll
+    instead of burning the 3-strike user-code budget (a sub-second
+    brownout would exhaust it in ~0.3s of fast polls and take down the
+    whole fleet), while still giving up past MAX_INFRA_POLL_FAILURES."""
+    store = MemJobStore()
+    store.insert_jobs("map_jobs", [make_job("k", 1)])
+    w = Worker(store, name="wpoll")
+    w.heartbeat_s = 0
+    monkeypatch.setattr(time, "sleep", lambda s: None)  # virtual clock
+
+    outcomes = {"n": 0}
+    real_poll = w.poll_once
+
+    def flaky_poll():
+        outcomes["n"] += 1
+        if outcomes["n"] <= 5:          # > MAX_WORKER_RETRIES=3 bursts
+            raise TransientStoreError("claim brownout")
+        return real_poll()
+
+    monkeypatch.setattr(w, "poll_once", flaky_poll)
+    w.configure(max_iter=3, max_sleep=0.01)
+    w.execute()                         # must NOT raise
+    assert outcomes["n"] > 5            # polled through the brownout
+
+    # liveness: a permanently failing coord store still kills the worker
+    monkeypatch.setattr(
+        w, "poll_once",
+        lambda: (_ for _ in ()).throw(TransientStoreError("dead store")))
+    with pytest.raises(TransientStoreError):
+        w.execute()
+    # and a user-code failure storm still dies at MAX_WORKER_RETRIES
+    calls = {"n": 0}
+
+    def user_fail():
+        calls["n"] += 1
+        raise ValueError("user bug")
+
+    monkeypatch.setattr(w, "poll_once", user_fail)
+    with pytest.raises(ValueError):
+        w.execute()
+    assert calls["n"] == 3
+
+
+def test_no_replay_retention_on_atomic_publish_backends(tmp_path):
+    """Atomic tempfile+rename backends (mem/shared/local-object) never
+    pay the replay-chunk memory: a failed build there provably did not
+    publish. Ambiguous backends (and FaultyStore, which tears builds on
+    purpose) retain."""
+    from lua_mapreduce_tpu.store.objectfs import ObjectStore
+    from lua_mapreduce_tpu.store.sharedfs import SharedStore
+
+    policy = _policy()
+    for raw in (MemStore(), SharedStore(str(tmp_path / "s")),
+                ObjectStore(str(tmp_path / "o"))):
+        b = RetryingStore(raw, policy).builder()
+        b.write("x\n")
+        assert b._chunks is None, type(raw).__name__
+        b.close()
+    plan = FaultPlan(1, sleep=lambda s: None)
+    b = RetryingStore(FaultyStore(MemStore(), plan), policy).builder()
+    b.write("x\n")
+    assert b._chunks == ["x\n"]
+    b.close()
+
+
+def test_release_preserves_batch_commit_prefix():
+    """A transient fault on job i of a lease still commits the done
+    prefix and releases the unstarted tail — the batch-lease failure
+    discipline is unchanged by the discrimination."""
+    store = MemJobStore()
+    store.insert_jobs("map_jobs", [make_job(f"k{i}", i) for i in range(3)])
+    w = Worker(store, name="wbatch")
+    w.heartbeat_s = 0
+    jobs = w.store.claim_batch("map_jobs", "wbatch", 3)
+    calls = [0]
+
+    def mapfn(key, value, emit):
+        calls[0] += 1
+        if calls[0] == 2:
+            raise TransientStoreError("mid-lease blip")
+        emit("n", value)
+
+    with pytest.raises(TransientStoreError):
+        w._execute_batch(_spec(mapfn, "wbatch"), "map_jobs", jobs)
+    sts = [store.get_job("map_jobs", i)["status"] for i in range(3)]
+    assert sts == [Status.WRITTEN, Status.WAITING, Status.WAITING]
+    assert all(store.get_job("map_jobs", i)["repetitions"] == 0
+               for i in range(3))
+
+
+def test_duplicate_reduce_execution_short_circuits_on_published_result():
+    """Degradation-ladder regression: a stale-requeued reduce job whose
+    FIRST claimant already published the partition result (and deleted
+    the consumed runs) must short-circuit as DONE on re-execution — the
+    premerge spill-exists pattern. Failing instead livelocks the job:
+    the runs are gone forever, every retry fails missing-runs, and a
+    COMPLETED partition marches to FAILED (observed wedging the churn
+    suite's batch-lease leg)."""
+    from lua_mapreduce_tpu.coord.jobstore import make_job
+    from lua_mapreduce_tpu.store.router import get_storage_from
+
+    storage = "mem:_dup_reduce_t"
+    store = get_storage_from(storage)
+    with store.builder() as b:
+        b.write('["n", [4]]\n')
+        b.build("result.P0")            # the first claimant's publish
+    # the consumed runs are already deleted; one stale leftover remains
+    with store.builder() as b:
+        b.write('["n", [1]]\n')
+        b.build("result.P0.M00000001")
+
+    js = MemJobStore()
+    js.insert_jobs("red_jobs", [make_job(0, {
+        "part": 0,
+        "files": ["result.P0.M00000000", "result.P0.M00000001"],
+        "result": "result.P0", "mappers": ["w-old"]})])
+    w = Worker(js, name="wdup")
+    w.heartbeat_s = 0
+    jobs = w.store.claim_batch("red_jobs", "wdup", 1)
+    spec = TaskSpec(taskfn=lambda emit: emit("k", 1),
+                    mapfn=lambda key, value, emit: emit("n", value),
+                    partitionfn=lambda key: 0,
+                    reducefn=lambda key, values: sum(values),
+                    storage=storage)
+    w._execute_batch(spec, "red_jobs", jobs)        # must NOT raise
+    d = js.get_job("red_jobs", 0)
+    assert d["status"] == Status.WRITTEN and d["repetitions"] == 0
+    assert list(store.lines("result.P0")) == ['["n", [4]]\n']  # untouched
+    assert not store.exists("result.P0.M00000001")  # leftovers swept
+
+
+# --- heartbeat thread resilience (satellite regression) ----------------------
+
+class _FlakyHeartbeatStore:
+    """JobStore facade whose heartbeat_batch raises (an UNCLASSIFIED
+    error, so the retry layer passes it through) the first N calls."""
+
+    def __init__(self, inner, fail_first):
+        self._inner = inner
+        self.fail_first = fail_first
+        self.hb_calls = 0
+
+    def heartbeat_batch(self, ns, jids, worker):
+        self.hb_calls += 1
+        if self.hb_calls <= self.fail_first:
+            raise ValueError(f"flaky store (call {self.hb_calls})")
+        return self._inner.heartbeat_batch(ns, jids, worker)
+
+    def classify(self, exc):
+        return self._inner.classify(exc)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+def test_heartbeat_thread_survives_store_exceptions():
+    """Regression (ISSUE 5 satellite): the beat thread used to be able
+    to die with its exception unlogged, silently stopping liveness
+    beats — the server then stale-requeues a LIVE worker's job. It must
+    log, back off, and RESUME beating once the store recovers."""
+    inner = MemJobStore()
+    inner.insert_jobs("map_jobs", [make_job("k", 1)])
+    flaky = _FlakyHeartbeatStore(inner, fail_first=3)
+    w = Worker(flaky, name="whb")
+    w.heartbeat_s = 0.01
+    jobs = w.store.claim_batch("map_jobs", "whb", 1)
+    assert jobs
+    with w._beating("map_jobs", [0]):
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if flaky.hb_calls > 3 and \
+                    inner.get_job("map_jobs", 0)["hb_time"] is not None:
+                break
+            time.sleep(0.01)
+    assert flaky.hb_calls > 3, "beat thread died after the failures"
+    assert inner.get_job("map_jobs", 0)["hb_time"] is not None, \
+        "no beat landed after the store recovered"
+
+
+# --- errors-stream structured fields over FileJobStore ----------------------
+
+def test_filestore_errors_carry_classification_fields(tmp_path):
+    fs = FileJobStore(str(tmp_path / "coord"))
+    fs.insert_error("w1", "Traceback ...",
+                    info={"exc_class": "TimeoutError",
+                          "classification": "infra-transient",
+                          "ns": "map_jobs", "job_id": 7})
+    (err,) = fs.drain_errors()
+    assert err["exc_class"] == "TimeoutError"
+    assert err["classification"] == "infra-transient"
+    assert err["job_id"] == 7 and err["worker"] == "w1"
+    # info-less inserts (third-party callers) keep working
+    fs.insert_error("w2", "plain")
+    (err2,) = fs.drain_errors()
+    assert err2["msg"] == "plain" and "exc_class" not in err2
+
+
+# --- ranged-read degradation (segment reader) --------------------------------
+
+class _RangedFlakyStore:
+    """read_range fails with a transient fault for any offset > 0; the
+    offset-0 whole-file read succeeds — the 'ranged GETs broken, plain
+    GET fine' object-store failure shape."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.ranged_attempts = 0
+
+    def read_range(self, name, offset, length):
+        if offset > 0:
+            self.ranged_attempts += 1
+            raise TransientStoreError(f"ranged read {offset}+{length}")
+        return self._inner.read_range(name, offset, length)
+
+    def classify(self, exc):
+        return self._inner.classify(exc)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+def test_segment_reader_degrades_to_whole_file_read():
+    from lua_mapreduce_tpu.core.segment import record_stream, writer_for
+
+    raw = MemStore()
+    recs = [(f"k{i:03d}", [i]) for i in range(200)]
+    with writer_for(raw, "v2") as wtr:
+        for k, v in recs:
+            wtr.add(k, v)
+        wtr.build("seg")
+
+    before = COUNTERS.snapshot().get("degraded_reads", 0)
+    flaky = _RangedFlakyStore(raw)
+    assert list(record_stream(flaky, "seg")) == recs
+    assert flaky.ranged_attempts == 1     # first ranged miss, then whole
+    assert COUNTERS.snapshot().get("degraded_reads", 0) == before + 1
+
+
+def test_stats_fold_fault_counters():
+    """LocalExecutor folds the fault counters into IterationStats, so a
+    chaos run's telemetry survives into the stats surface."""
+    from lua_mapreduce_tpu.engine.local import LocalExecutor
+
+    plan = FaultPlan(41, transient=0.25, max_per_key=1, sleep=lambda s: None)
+    install_fault_plan(plan)
+    try:
+        corpus = {"d1": "a b a", "d2": "b"}
+
+        def taskfn(emit):
+            for k, v in corpus.items():
+                emit(k, v)
+
+        def mapfn(key, value, emit):
+            for word in value.split():
+                emit(word, 1)
+
+        spec = TaskSpec(taskfn=taskfn, mapfn=mapfn,
+                        partitionfn=lambda key: 0,
+                        reducefn=lambda key, values: sum(values),
+                        storage="mem:_fault_stats_t")
+        ex = LocalExecutor(spec)
+        stats = ex.run()
+        assert {k: v[0] for k, v in ex.results()} == {"a": 2, "b": 2}
+    finally:
+        install_fault_plan(None)
+    it = stats.iterations[-1]
+    assert it.store_faults >= 1           # injections were counted
+    d = it.as_dict()
+    assert {"store_retries", "store_faults", "infra_releases",
+            "degraded_reads"} <= set(d)

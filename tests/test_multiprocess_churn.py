@@ -202,6 +202,118 @@ def test_nine_process_pool_survives_map_and_reduce_sigkill(tmp_path,
 
 
 @pytest.mark.heavy
+def test_sigkill_churn_with_active_fault_plan_on_shared_store(tmp_path,
+                                                              monkeypatch):
+    """SIGKILL churn AND deterministic storage faults at once (ISSUE 5
+    satellite): a seeded FaultPlan rides the SHARED store in every
+    process (workers inherit it through LMR_FAULT_PLAN; the server's
+    router reads the same env), injecting transient errors + latency +
+    error-after-write while a stalled map victim is SIGKILLed. The
+    stale requeue recovers the victim's lease, the retry layer absorbs
+    the injected bursts, and the result must still equal the golden
+    count with zero FAILED jobs — the two recovery mechanisms must not
+    interfere."""
+    from examples.wordcount_big import corpus
+
+    corpus_dir = str(tmp_path / "corpus")
+    corpus.build(corpus_dir, n_splits=N_SPLITS)
+    golden = Counter()
+    for i in range(N_SPLITS):
+        with open(corpus.split_path(corpus_dir, i)) as f:
+            golden.update(f.read().split())
+
+    # max_per_key=2 < the default retry budget of 3: injected bursts
+    # are always absorbable, so FAILED==0 is a hard assertion
+    monkeypatch.setenv(
+        "LMR_FAULT_PLAN",
+        "seed=19;transient=0.04;latency=0.03;error_after_write=0.2;"
+        "latency_ms=1;max_per_key=2")
+
+    coord = str(tmp_path / "coord")
+    storage = f"shared:{tmp_path}/spill"
+    store = FileJobStore(coord)
+    mod = "examples.wordcount_big.bigtask"
+    spec = TaskSpec(taskfn=mod, mapfn=mod, partitionfn=mod, reducefn=mod,
+                    init_args={"corpus_dir": corpus_dir,
+                               "n_splits": N_SPLITS, "build": False},
+                    storage=storage)
+
+    env = _env()
+    env["LMR_FAULT_PLAN"] = os.environ["LMR_FAULT_PLAN"]
+    procs = []
+
+    def spawn(code, capture=False):
+        p = subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE if capture else subprocess.DEVNULL,
+            text=capture)
+        procs.append(p)
+        return p
+
+    victim = spawn(_worker_code(coord, extra=_STALL_MAP), capture=True)
+
+    started = {"b": False}
+    lock = threading.Lock()
+
+    def wave_b():
+        with lock:
+            if started["b"]:
+                return
+            started["b"] = True
+        if victim.poll() is None:
+            victim.kill()
+        for _ in range(3):
+            # fast heartbeats: injected latency + retry backoff stretch
+            # job bodies, and under machine load a beat-less job can
+            # outlive the stale timeout — the server would then requeue
+            # a LIVE worker's lease and charge repetitions the test
+            # attributes to the SIGKILL. Beating keeps healthy leases
+            # fresh (the product mechanism for long jobs), so the dead
+            # victim stays the only stale-requeue source.
+            spawn(_worker_code(
+                coord, configure="max_iter=2000, max_sleep=0.05, "
+                                 "heartbeat_s=0.25"))
+
+    def chaos():
+        victim.stdout.readline()        # CLAIMED
+        time.sleep(0.2)
+        wave_b()
+
+    t = threading.Thread(target=chaos, daemon=True)
+    t.start()
+    watchdog = threading.Timer(120, wave_b)
+    watchdog.daemon = True
+    watchdog.start()
+
+    try:
+        server = Server(store, poll_interval=0.05,
+                        stale_timeout_s=2.5).configure(spec)
+        stats = server.loop()
+    finally:
+        watchdog.cancel()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+    it = stats.iterations[-1]
+    assert it.map.failed == 0 and it.reduce.failed == 0
+    assert it.map.count == N_SPLITS
+    # the victim's SIGKILLed claim really was requeued (repetitions
+    # come from the stale requeue, never from injected transients —
+    # which the retry budget absorbs entirely)
+    assert any(d["repetitions"] > 0 for d in store.jobs("map_jobs"))
+
+    result_store = get_storage_from(storage)
+    got = {k: vs[0] for k, vs in iter_results(result_store, "result")}
+    assert got == dict(golden)
+
+
+@pytest.mark.heavy
 def test_sigkill_mid_batch_lease_requeues_whole_lease(tmp_path):
     """Batch leases under churn (ISSUE 2 satellite): a worker running
     with batch_k=8 claims a LEASE of map jobs, completes the lease's

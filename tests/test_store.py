@@ -74,78 +74,22 @@ def test_router_spec_parsing(tmp_path):
     assert s1 is s2  # process-wide shared instance per tag
 
 
-class _FakeBlob:
-    def __init__(self, bucket, name):
-        self._bucket, self._name = bucket, name
-
-    def upload_from_string(self, data):
-        if isinstance(data, str):
-            data = data.encode()
-        self._bucket._objects[self._name] = bytes(data)
-
-    def download_as_bytes(self, start=None, end=None):
-        data = self._bucket._objects[self._name]
-        if start is None:
-            return data
-        if start >= len(data):
-            raise ValueError("RequestRangeNotSatisfiable")  # GCS 416
-        return data[start:(end + 1) if end is not None else None]
-
-    @property
-    def size(self):
-        return len(self._bucket._objects[self._name])
-
-    def exists(self):
-        return self._name in self._bucket._objects
-
-    def delete(self):
-        del self._bucket._objects[self._name]
-
-
-class _FakeBucket:
-    def __init__(self):
-        self._objects = {}
-
-    def blob(self, key):
-        return _FakeBlob(self, key)
-
-    def get_blob(self, key):
-        return _FakeBlob(self, key) if key in self._objects else None
-
-    def list_blobs(self, prefix=None):
-        import types as _t
-        names = sorted(self._objects)
-        if prefix:
-            names = [n for n in names if n.startswith(prefix)]
-        return [_t.SimpleNamespace(name=n) for n in names]
-
-
-class _FakeClient:
-    _buckets = {}
-
-    def bucket(self, name):
-        return _FakeClient._buckets.setdefault(name, _FakeBucket())
-
-
 @pytest.fixture
 def fake_gcs(monkeypatch):
-    """Inject a google.cloud.storage lookalike so ObjectStore's gs://
-    branch (whole-object PUT/GET over a Client().bucket()) runs without
-    network (VERDICT r1 item 6: the real-GCS path had zero tests)."""
+    """Inject the packaged google.cloud.storage lookalike
+    (lua_mapreduce_tpu.store.fake_gcs — public for user tests, with
+    configurable injected 503/timeout schedules) so ObjectStore's gs://
+    branch runs without network (VERDICT r1 item 6: the real-GCS path
+    had zero tests)."""
     import sys
-    import types
 
-    _FakeClient._buckets = {}
-    storage_mod = types.ModuleType("google.cloud.storage")
-    storage_mod.Client = _FakeClient
-    cloud_mod = types.ModuleType("google.cloud")
-    cloud_mod.storage = storage_mod
-    google_mod = types.ModuleType("google")
-    google_mod.cloud = cloud_mod
-    monkeypatch.setitem(sys.modules, "google", google_mod)
-    monkeypatch.setitem(sys.modules, "google.cloud", cloud_mod)
-    monkeypatch.setitem(sys.modules, "google.cloud.storage", storage_mod)
-    return _FakeClient
+    from lua_mapreduce_tpu.store.fake_gcs import (FakeGcsClient,
+                                                  fake_module_tree)
+
+    FakeGcsClient.reset()
+    for name, mod in fake_module_tree():
+        monkeypatch.setitem(sys.modules, name, mod)
+    return FakeGcsClient
 
 
 def test_gcs_branch_roundtrip(fake_gcs):
@@ -237,6 +181,58 @@ def test_gcs_ranged_reads_and_segments(fake_gcs):
     w.build("runs.P0.M1")
     assert open_segment(store, "runs.P0.M1") is not None
     assert list(record_stream(store, "runs.P0.M1")) == recs
+
+
+def test_gcs_injected_503_classified_and_retried(fake_gcs):
+    """The harness's configurable 503 schedule (DESIGN §19): ObjectStore
+    classifies the injected ServiceUnavailable transient, and the retry
+    layer absorbs a bounded burst — the read succeeds with no caller-
+    visible failure."""
+    import random
+
+    from lua_mapreduce_tpu.faults import RetryingStore, RetryPolicy
+    from lua_mapreduce_tpu.store.fake_gcs import (FakeGcsClient,
+                                                  ServiceUnavailable)
+    from lua_mapreduce_tpu.store.objectfs import ObjectStore
+
+    FakeGcsClient.reset(faults={"download": [2, 3]})
+    raw = ObjectStore("gs://fltbkt/x")
+    with raw.builder() as b:      # upload (download calls 0 so far)
+        b.write("payload\n")
+        b.build("obj")
+    assert raw.classify(ServiceUnavailable("x")) is True
+
+    store = RetryingStore(raw, RetryPolicy(retries=3, base_ms=1,
+                                           sleep=lambda s: None,
+                                           rng=random.Random(0)))
+    assert raw._get("obj") == b"payload\n"           # download #1 clean
+    assert store.read_range("obj", 0, 7) == b"payload"   # #2,#3 injected
+    assert FakeGcsClient.faults.fired == {"download": 2}
+
+
+def test_gcs_injected_timeout_exhausts_to_transient_error(fake_gcs):
+    """A burst longer than the retry budget surfaces as a classified
+    TransientStoreError chaining the timeout — the worker's release-
+    not-broken discrimination keys off exactly this."""
+    import random
+
+    from lua_mapreduce_tpu.faults import (RetryingStore, RetryPolicy,
+                                          TransientStoreError)
+    from lua_mapreduce_tpu.store.fake_gcs import FakeGcsClient, FakeGcsTimeout
+    from lua_mapreduce_tpu.store.objectfs import ObjectStore
+
+    FakeGcsClient.reset(faults={"download": 10}, fault_kind="timeout")
+    raw = ObjectStore("gs://tobkt/x")
+    with raw.builder() as b:
+        b.write("v\n")
+        b.build("obj")
+    store = RetryingStore(raw, RetryPolicy(retries=2, base_ms=1,
+                                           sleep=lambda s: None,
+                                           rng=random.Random(0)))
+    with pytest.raises(TransientStoreError) as ei:
+        store.read_range("obj", 0, 2)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, FakeGcsTimeout)
 
 
 def test_gcs_missing_dependency_error_message(monkeypatch):
