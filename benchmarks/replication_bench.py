@@ -1,0 +1,326 @@
+"""Replica-aware shuffle bench (DESIGN §20): overhead vs recovery.
+
+Coded MapReduce's trade is extra shuffle bytes for recovery latency;
+this bench prices both sides of it, sweeping r ∈ {1, 2, 3}:
+
+1. **Overhead** — the fault-free cost of replication: each r > 1 leg
+   runs PAIRED with an r=1 leg (order alternated inside the pair,
+   median paired wall ratio headlined — the established protocol: this
+   box's effective core count drifts 2-3x between rounds), outputs
+   byte-compared, and the write amplification reported honestly from
+   the spill-byte counters (replica bytes ÷ primary bytes + 1 — the
+   fan-out is exactly r by construction; the wall ratio says what
+   those bytes actually cost end to end). Native layer disabled both
+   halves: the failover view routes through the portable plane, so an
+   r=1 leg on the native fast path would conflate the format plane's
+   speedup with the replication plane's cost.
+
+2. **Recovery** — the latency of losing shuffle data, on the
+   distributed engine (Server + in-process workers — the scavenger
+   lives there), r=2, same topology per mode, destruction at the
+   reduce barrier:
+
+   - ``failover``:  every run file's PRIMARY copy destroyed → reducers
+     fail over to the surviving replica (DESIGN §20 ladder rung 2);
+   - ``map_rerun``: EVERY copy of one partition's runs destroyed → the
+     scavenger requeues the producers, maps re-run during the reduce
+     phase (the last-resort rung — exactly what r=1 deployments pay).
+
+   ``recovery_s`` per mode = that mode's wall − the same round's clean
+   wall (paired, median); ``reduce_tail_s`` is the reduce phase's
+   cluster time (max written − min started) — the tail-latency figure
+   the failover path shrinks. Headline: ``recovery_speedup`` =
+   map-rerun recovery ÷ failover recovery.
+
+3. **Reconstruction** — the scavenger's repair primitive timed
+   directly: median milliseconds to rebuild a destroyed copy from a
+   survivor (the cost of healing under-replication without touching
+   job state).
+
+Usage: python benchmarks/replication_bench.py [rounds] [n_jobs]
+Artifact: benchmarks/results/replication.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RESULTS = os.path.join(REPO, "benchmarks", "results", "replication.json")
+TASK_MOD = "benchmarks.segment_task"
+
+
+def _spec(storage: str, task_args: dict):
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    return TaskSpec(taskfn=TASK_MOD, mapfn=TASK_MOD, partitionfn=TASK_MOD,
+                    reducefn=TASK_MOD, init_args=task_args, storage=storage)
+
+
+# --------------------------------------------------------------------------
+# leg 1: fault-free overhead, r vs 1, paired rounds
+# --------------------------------------------------------------------------
+
+
+def _overhead_leg(replication: int, storage: str, task_args: dict) -> dict:
+    from lua_mapreduce_tpu.engine.local import LocalExecutor
+    from lua_mapreduce_tpu.faults.retry import COUNTERS
+    from lua_mapreduce_tpu.store.router import get_storage_from
+
+    before = COUNTERS.snapshot()
+    ex = LocalExecutor(_spec(storage, task_args), map_parallelism=2,
+                       segment_format="v2", replication=replication)
+    os.sync()               # writeback lands outside the timed window
+    t0 = time.perf_counter()
+    c0 = time.process_time()
+    ex.run()
+    cpu = time.process_time() - c0
+    wall = time.perf_counter() - t0
+    fd = COUNTERS.delta(before, COUNTERS.snapshot())
+    store = get_storage_from(storage)
+    result = {n: "".join(store.lines(n)) for n in store.list("result.P*")
+              if n.count(".") == 1}
+    return {"wall_s": wall, "cpu_s": cpu, "result": result,
+            "spill_bytes_primary": fd.get("spill_bytes_primary", 0),
+            "spill_bytes_replica": fd.get("spill_bytes_replica", 0)}
+
+
+def _overhead_sweep(rounds: int, n_jobs: int, vocab: int) -> dict:
+    out = {}
+    for r in (2, 3):
+        ratios, cpu_ratios = [], []
+        identical = True
+        primary = replica = 0
+        for rnd in range(rounds):
+            pair = {}
+            order = (r, 1) if rnd % 2 == 0 else (1, r)
+            for repl in order:
+                d = tempfile.mkdtemp(prefix=f"repbench-r{repl}-")
+                try:
+                    pair[repl] = _overhead_leg(
+                        repl, f"shared:{d}/spill",
+                        {"n_jobs": n_jobs, "vocab": vocab})
+                finally:
+                    shutil.rmtree(d, ignore_errors=True)
+            identical = identical and (pair[r]["result"]
+                                       == pair[1]["result"])
+            ratios.append(pair[r]["wall_s"] / pair[1]["wall_s"])
+            cpu_ratios.append(pair[r]["cpu_s"] / pair[1]["cpu_s"])
+            primary += pair[r]["spill_bytes_primary"]
+            replica += pair[r]["spill_bytes_replica"]
+        out[f"r{r}"] = {
+            # >1.0 = what r-way publish costs end to end (the honest
+            # price of the extra bytes; ≈1.0 when shuffle IO is not
+            # the bottleneck, → r when it is)
+            "wall_ratio_vs_r1": round(statistics.median(ratios), 4),
+            "wall_ratio_pairs": [round(x, 4) for x in ratios],
+            "cpu_ratio_vs_r1": round(statistics.median(cpu_ratios), 4),
+            # replica bytes ÷ primary bytes + 1 == r by construction;
+            # reported from the measured counters, not assumed
+            "write_amplification": round(1 + replica / primary, 4)
+            if primary else None,
+            "spill_bytes_primary": primary,
+            "spill_bytes_replica": replica,
+            "identical_output_vs_r1": identical,
+        }
+    return out
+
+
+# --------------------------------------------------------------------------
+# leg 2: recovery latency on the distributed engine (the scavenger's home)
+# --------------------------------------------------------------------------
+
+
+def _recovery_leg(mode: str, tag: str, task_args: dict) -> dict:
+    """One distributed run (mem store + MemJobStore, r=2, barrier),
+    identical topology per mode — map-only worker to the reduce
+    barrier, mode-specific destruction, then a full worker — so the
+    clean twin subtracts every fixed cost."""
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+    from lua_mapreduce_tpu.core.constants import Status
+    from lua_mapreduce_tpu.engine.placement import replica_names
+    from lua_mapreduce_tpu.engine.server import Server
+    from lua_mapreduce_tpu.engine.worker import RED_NS, Worker
+    from lua_mapreduce_tpu.store.router import get_storage_from
+
+    spec = _spec(f"mem:{tag}", task_args)
+    store = MemJobStore()
+    raw = get_storage_from(spec.storage)
+    t0 = time.perf_counter()
+    server = Server(store, poll_interval=0.01, batch_k=2,
+                    replication=2).configure(spec)
+    final = {}
+    st = threading.Thread(
+        target=lambda: final.setdefault("stats", server.loop()),
+        daemon=True)
+    mapper = Worker(store).configure(max_iter=8000, max_sleep=0.02,
+                                     phases=("map",))
+    mt = threading.Thread(target=mapper.execute, daemon=True)
+    st.start()
+    mt.start()
+
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            if store.counts(RED_NS)[Status.WAITING] > 0:
+                break
+        except Exception:
+            pass
+        time.sleep(0.005)
+    else:
+        raise RuntimeError(f"{mode}: never reached the reduce barrier")
+
+    if mode == "failover":
+        # r-1 of r copies of EVERY file gone: pure failover reads
+        for name in raw.list("result.P[0-9]*.M*"):
+            raw.remove(name)
+    elif mode == "map_rerun":
+        # EVERY copy of one partition's runs gone: the last-resort rung
+        for name in raw.list("result.P0.M*"):
+            for copy in replica_names(name, 2):
+                try:
+                    raw.remove(copy)
+                except Exception:
+                    pass
+
+    reducer = Worker(store).configure(max_iter=8000, max_sleep=0.05)
+    rt = threading.Thread(target=reducer.execute, daemon=True)
+    rt.start()
+    st.join(timeout=120)
+    if st.is_alive():
+        raise RuntimeError(f"{mode}: server wedged")
+    mt.join(timeout=10)
+    rt.join(timeout=10)
+    wall = time.perf_counter() - t0
+
+    it = final["stats"].iterations[-1]
+    result = {n: "".join(raw.lines(n)) for n in raw.list("result.P*")
+              if n.count(".") == 1}
+    return {"wall_s": wall, "reduce_tail_s": it.reduce.cluster_time,
+            "failover_reads": it.failover_reads,
+            "map_reruns": it.map_reruns,
+            "map_reruns_avoided": it.map_reruns_avoided,
+            "result": result}
+
+
+def _recovery_rounds(rounds: int, n_jobs: int, vocab: int) -> dict:
+    task_args = {"n_jobs": n_jobs, "vocab": vocab}
+    modes = ("clean", "failover", "map_rerun")
+    acc = {m: [] for m in modes}
+    for rnd in range(rounds):
+        legs = {m: _recovery_leg(m, f"repbench-{m}-{rnd}", task_args)
+                for m in modes}
+        for m in ("failover", "map_rerun"):
+            assert legs[m]["result"] == legs["clean"]["result"], \
+                f"{m} leg output differs from clean"
+        assert legs["failover"]["map_reruns"] == 0, \
+            "failover leg fell through to a map re-run"
+        assert legs["map_rerun"]["map_reruns"] > 0, \
+            "map_rerun leg never re-ran a producer"
+        for m in modes:
+            legs[m]["recovery_s"] = (legs[m]["wall_s"]
+                                     - legs["clean"]["wall_s"])
+            acc[m].append(legs[m])
+    out = {"clean_wall_s": round(statistics.median(
+        [x["wall_s"] for x in acc["clean"]]), 4)}
+    for m in ("failover", "map_rerun"):
+        rec = [x["recovery_s"] for x in acc[m]]
+        out[m] = {
+            # extra wall vs the SAME round's clean twin (≥0 up to
+            # scheduler noise; the paired subtraction removes the
+            # fixed topology cost)
+            "recovery_s": round(statistics.median(rec), 4),
+            "recovery_s_pairs": [round(x, 4) for x in rec],
+            "reduce_tail_s": round(statistics.median(
+                [x["reduce_tail_s"] for x in acc[m]]), 4),
+            "failover_reads": acc[m][-1]["failover_reads"],
+            "map_reruns": acc[m][-1]["map_reruns"],
+        }
+    out["reduce_tail_clean_s"] = round(statistics.median(
+        [x["reduce_tail_s"] for x in acc["clean"]]), 4)
+    fo = max(out["failover"]["recovery_s"], 1e-4)
+    out["recovery_speedup"] = round(
+        max(out["map_rerun"]["recovery_s"], 1e-4) / fo, 2)
+    return out
+
+
+# --------------------------------------------------------------------------
+# leg 3: the repair primitive, timed directly
+# --------------------------------------------------------------------------
+
+
+def _reconstruct_micro(n_files: int = 32, payload_kb: int = 256) -> dict:
+    from lua_mapreduce_tpu.engine.placement import replica_names
+    from lua_mapreduce_tpu.faults.replicate import repair, spill_writer
+    from lua_mapreduce_tpu.store.memfs import MemStore
+
+    store = MemStore()
+    chunk = "x" * 1024
+    names = [f"rec.P0.M{i:08d}" for i in range(n_files)]
+    for name in names:
+        with spill_writer(store, "v1", 2) as w:
+            for j in range(payload_kb):
+                w.add(f"k{j:06d}", [chunk])
+            w.build(name)
+        store.remove(name)          # primary destroyed, replica survives
+    ms = []
+    for name in names:
+        t0 = time.perf_counter()
+        verdict = repair(store, name, 2)
+        ms.append((time.perf_counter() - t0) * 1e3)
+        assert verdict == "repaired", verdict
+        assert store.exists(name)
+        assert all(store.exists(c) for c in replica_names(name, 2))
+    return {"files": n_files, "payload_kb_per_file": payload_kb,
+            "reconstruct_ms_per_file": round(statistics.median(ms), 3),
+            "reconstruct_ms_p99": round(
+                sorted(ms)[max(0, int(len(ms) * 0.99) - 1)], 3)}
+
+
+def run(rounds: int = 5, n_jobs: int = 12, vocab: int = 8000,
+        with_recovery: bool = True) -> dict:
+    # native layer off for every leg: the failover view exposes only
+    # the portable Store surface (local_path hidden), so r=1-with-
+    # native vs r>1-without would mix two unrelated costs
+    prev = os.environ.get("LMR_DISABLE_NATIVE")
+    os.environ["LMR_DISABLE_NATIVE"] = "1"
+    try:
+        out = {"rounds": rounds, "n_jobs": n_jobs, "vocab": vocab,
+               "protocol": ("paired rounds, order alternated per pair, "
+                            "median paired ratios headlined; outputs "
+                            "byte-compared per pair; recovery legs "
+                            "subtract the same round's clean twin; "
+                            "native layer disabled everywhere")}
+        out["overhead"] = _overhead_sweep(rounds, n_jobs, vocab)
+        if with_recovery:
+            out["recovery"] = _recovery_rounds(rounds, max(4, n_jobs // 2),
+                                               max(2000, vocab // 2))
+        out["reconstruct"] = _reconstruct_micro()
+    finally:
+        if prev is None:
+            os.environ.pop("LMR_DISABLE_NATIVE", None)
+        else:
+            os.environ["LMR_DISABLE_NATIVE"] = prev
+    return out
+
+
+def main() -> None:
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+    n_jobs = int(sys.argv[2]) if len(sys.argv) > 2 else 12
+    out = run(rounds=rounds, n_jobs=n_jobs)
+    os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+    with open(RESULTS, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -76,7 +76,8 @@ def utest():
     from lua_mapreduce_tpu import analysis, faults
     from lua_mapreduce_tpu.core import heap, merge, segment, serialize
     from lua_mapreduce_tpu.coord import jobstore, persistent_table
-    from lua_mapreduce_tpu.engine import contract, premerge, server, worker
+    from lua_mapreduce_tpu.engine import (contract, placement, premerge,
+                                          server, worker)
     from lua_mapreduce_tpu.store import memfs, router
     from lua_mapreduce_tpu.utils import stats
 
@@ -85,7 +86,7 @@ def utest():
     # accelerator tunnel; jax-computing modules (ops/*) self-test under
     # the cpu-pinned pytest conftest instead (tests/test_q8.py etc.)
     for mod in (tuples, heap, serialize, segment, merge, jobstore, memfs,
-                contract, router, persistent_table, stats, premerge, worker,
-                server, analysis, faults):
+                contract, router, persistent_table, stats, placement,
+                premerge, worker, server, analysis, faults):
         if hasattr(mod, "utest"):
             mod.utest()

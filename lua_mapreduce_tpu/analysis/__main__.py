@@ -39,6 +39,12 @@ def _protocol_suite(args):
     runs.append(("failure-path", dataclasses.replace(
         base, n_jobs=2, batch_k=min(args.batch_k, 2), allow_fail=True,
         allow_death=False)))
+    # the reconstruct-vs-requeue scavenge edge (DESIGN §20): budgeted
+    # data-loss events + repair + lost-data requeue, exhaustively — on
+    # a 2-job box so loss×death interleavings stay tractable
+    runs.append(("replica-recovery", dataclasses.replace(
+        base, n_jobs=2, batch_k=min(args.batch_k, 2),
+        data_loss_budget=2)))
     if args.seed_bug:
         bugs = [args.seed_bug]
     else:
@@ -57,7 +63,13 @@ def _protocol_suite(args):
             failed = True
         out.append(entry)
     for bug in bugs:
-        cfg = dataclasses.replace(base, bug=bug)
+        cfg = dataclasses.replace(
+            base, bug=bug,
+            # loss-edge bugs are unreachable without loss events; the
+            # smaller box keeps the seeded sweep fast
+            **(dict(n_jobs=2, batch_k=min(args.batch_k, 2),
+                    data_loss_budget=2)
+               if bug in proto_mod.LOSS_BUGS else {}))
         res = proto_mod.check_protocol(cfg)
         entry = {"run": f"seeded:{bug}", "states": res.states,
                  "wall_s": round(res.wall_s, 3),

@@ -46,6 +46,21 @@ bug model DIVERGES at the exact store operation whose CAS closes the
 race — which is the confirmation that the real protocol is guarded
 where the model says it must be.
 
+**Replica-aware recovery (DESIGN §20).** With
+``ModelConfig(data_loss_budget=N)`` each job record carries the state
+of its published output's replica set (intact / under-replicated /
+every-copy-lost; environment loss events are budget-bounded so the
+space stays finite), and the scavenger gains the reconstruct-vs-requeue
+edge: ``repair`` heals an under-replicated output WITHOUT touching job
+state, and ``rerun_requeue`` CASes a WRITTEN producer whose output is
+wholly lost back to WAITING — the one legal WRITTEN→WAITING edge, it
+must charge NO repetition (the loss is not the job's fault) and it
+opens a new commit generation (the re-run's commit is not a double
+commit). Two new invariants ride the existing set: the no-stranded-data
+rule (quiescent with a live worker ⇒ no WRITTEN job whose output is
+wholly lost — the reduce phase would wedge on it) and the
+zero-charge rule on the requeue edge itself.
+
 Seedable bugs (``ModelConfig(bug=...)``):
 
 - ``"commit_skips_owner_cas"`` — commit checks status but not
@@ -54,7 +69,15 @@ Seedable bugs (``ModelConfig(bug=...)``):
   else);
 - ``"requeue_ignores_finished"`` — the scavenger skips FINISHED
   leases: a worker killed between its FINISHED and WRITTEN transitions
-  wedges the barrier forever.
+  wedges the barrier forever;
+- ``"scavenge_skips_lost_data"`` — the scavenger repairs
+  under-replicated outputs but never requeues wholly-lost ones: the
+  reduce phase waits forever on data nobody will regenerate
+  (requires ``data_loss_budget > 0``);
+- ``"lost_requeue_skips_written_cas"`` — the lost-data requeue fires
+  without the expect=(WRITTEN,) status CAS: it can yank a job another
+  worker is mid-commit on (the real ``Server._requeue_maps`` carries
+  exactly that CAS; requires ``data_loss_budget > 0``).
 """
 
 from __future__ import annotations
@@ -81,7 +104,19 @@ _ALLOWED_EDGES = {
     _FAI: set(),
 }
 
-KNOWN_BUGS = ("commit_skips_owner_cas", "requeue_ignores_finished")
+KNOWN_BUGS = ("commit_skips_owner_cas", "requeue_ignores_finished",
+              "scavenge_skips_lost_data", "lost_requeue_skips_written_cas")
+
+# bugs living on the replica-recovery edge need loss events to surface
+LOSS_BUGS = ("scavenge_skips_lost_data", "lost_requeue_skips_written_cas")
+
+# replica-set state of a job's published output
+_D_LOST = 0      # every copy gone — only a producer re-run regenerates
+_D_UNDER = 1     # readable, but below full r-way redundancy
+_D_INTACT = 2    # full redundancy
+
+# environment events: enumerable, but never count as protocol progress
+_ENV_OPS = frozenset({"die", "lose_replica", "lose_all"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +128,7 @@ class ModelConfig:
     stale_age: int = 1
     allow_death: bool = True
     allow_fail: bool = False
+    data_loss_budget: int = 0
     bug: Optional[str] = None
 
     def __post_init__(self):
@@ -105,14 +141,25 @@ class ModelConfig:
                 "WAITING and would read as a fake lost-job violation")
         if self.max_retries < 1 or self.stale_age < 1:
             raise ValueError("max_retries and stale_age must be ≥ 1")
+        if not (0 <= self.data_loss_budget <= 3):
+            raise ValueError("data_loss_budget must be in [0, 3] "
+                             "(small-scope: each loss event multiplies "
+                             "the space)")
         if self.bug is not None and self.bug not in KNOWN_BUGS:
             raise ValueError(f"unknown bug {self.bug!r}; known: "
                              f"{KNOWN_BUGS}")
+        if self.bug in LOSS_BUGS and self.data_loss_budget < 1:
+            raise ValueError(f"bug {self.bug!r} lives on the "
+                             "replica-recovery edge: it needs "
+                             "data_loss_budget ≥ 1 to be reachable")
 
 
-# Job record: (status, reps, owner, age).  owner is 0 (none) or
+# Job record: (status, reps, owner, age, data).  owner is 0 (none) or
 # worker-index+1; age counts virtual ticks since the last liveness
-# signal and saturates at stale_age.  Worker modes:
+# signal and saturates at stale_age; data is the replica-set state of
+# the job's published output (_D_INTACT until a budgeted loss event,
+# restored by repair or by the re-run's commit).  State:
+# (jobs, workers, commits, loss_budget).  Worker modes:
 #   ("I",)                                       idle (polling)
 #   ("D",)                                       dead
 #   ("R", leased, pos, done)                     executing job bodies
@@ -154,10 +201,11 @@ class LeaseModel:
         self._rep_cap = config.max_retries + 1   # saturate: finite space
 
     def initial(self) -> tuple:
-        jobs = tuple((_WAIT, 0, 0, 0) for _ in range(self.cfg.n_jobs))
+        jobs = tuple((_WAIT, 0, 0, 0, _D_INTACT)
+                     for _ in range(self.cfg.n_jobs))
         workers = tuple(_IDLE for _ in range(self.cfg.n_workers))
         commits = (0,) * self.cfg.n_jobs
-        return (jobs, workers, commits)
+        return (jobs, workers, commits, self.cfg.data_loss_budget)
 
     # -- per-transition effects (each is ONE atomic store op or one
     # worker-local step, which is exactly the interleaving granularity
@@ -168,7 +216,7 @@ class LeaseModel:
 
     def transitions(self, state: tuple) -> List[Tuple[tuple, tuple]]:
         """[(label, next_state), ...] — every enabled step."""
-        jobs, workers, commits = state
+        jobs, workers, commits, budget = state
         out: List[Tuple[tuple, tuple]] = []
         cfg = self.cfg
 
@@ -178,7 +226,7 @@ class LeaseModel:
         def repl_w(w, mode, njobs=None, ncommits=None):
             nw = tuple(mode if i == w else m for i, m in enumerate(workers))
             return ((jobs if njobs is None else njobs), nw,
-                    (commits if ncommits is None else ncommits))
+                    (commits if ncommits is None else ncommits), budget)
 
         for w, mode in enumerate(workers):
             kind = mode[0]
@@ -187,14 +235,14 @@ class LeaseModel:
             if cfg.allow_death:
                 out.append((("die", w), repl_w(w, _DEAD)))
             if kind == "I":
-                claimable = [j for j, (s, _, _, _) in enumerate(jobs)
-                             if s in (_WAIT, _BRK)]
+                claimable = [j for j, rec in enumerate(jobs)
+                             if rec[0] in (_WAIT, _BRK)]
                 take = tuple(claimable[:cfg.batch_k])
                 if take:
                     nj = list(jobs)
                     for j in take:
-                        s, r, _, _ = nj[j]
-                        nj[j] = (_RUN, r, w + 1, 0)
+                        s, r, _, _, d = nj[j]
+                        nj[j] = (_RUN, r, w + 1, 0, d)
                     out.append((("claim", w, take),
                                 repl_w(w, ("R", take, 0, ()),
                                        tuple(nj))))
@@ -212,19 +260,22 @@ class LeaseModel:
             elif kind == "C":
                 _, leased, entries, i, phase, tail, brk = mode
                 j = entries[i]
-                s, r, o, a = jobs[j]
+                s, r, o, a, d = jobs[j]
                 owner_ok = (o == w + 1) or \
                     (cfg.bug == "commit_skips_owner_cas")
                 if phase == 0:
                     ok = (s == _RUN) and owner_ok
-                    nj = repl_job(j, (_FIN, r, o, a)) if ok else jobs
+                    nj = repl_job(j, (_FIN, r, o, a, d)) if ok else jobs
                     nmode = ("C", leased, entries, i, 1, tail, brk) if ok \
                         else ("C", leased, entries, i + 1, 0, tail, brk)
                     out.append((("commit_a", w, j, ok),
                                 repl_w(w, self._norm(nmode), nj)))
                 else:
                     ok = (s == _FIN) and owner_ok
-                    nj = repl_job(j, (_WRI, r, o, a)) if ok else jobs
+                    # a landed commit means the (re-)run's output was
+                    # published whole at full redundancy
+                    nj = repl_job(j, (_WRI, r, o, a, _D_INTACT)) \
+                        if ok else jobs
                     nc = tuple(min(c + 1, 2) if ok and i2 == j else c
                                for i2, c in enumerate(commits))
                     nmode = ("C", leased, entries, i + 1, 0, tail, brk)
@@ -235,22 +286,22 @@ class LeaseModel:
                 nj = list(jobs)
                 released = []
                 for t in tail:
-                    s, r, o, a = nj[t]
+                    s, r, o, a, d = nj[t]
                     if s == _RUN and o == w + 1:
-                        nj[t] = (_WAIT, r, o, 0)   # no repetition bump
+                        nj[t] = (_WAIT, r, o, 0, d)  # no repetition bump
                         released.append(t)
                 out.append((("release", w, tail, tuple(released)),
                             repl_w(w, self._norm(("K", leased, brk)),
                                    tuple(nj))))
             elif kind == "K":
                 _, leased, brk = mode
-                s, r, o, a = jobs[brk]
+                s, r, o, a, d = jobs[brk]
                 # ownership AND still-RUNNING: a job the scavenger
                 # already requeued (BROKEN) or failed (FAILED) must not
                 # be touched — Worker._mark_broken carries the matching
                 # expect=(RUNNING,) CAS
                 ok = (o == w + 1) and s == _RUN
-                nj = repl_job(brk, (_BRK, self._sat(r + 1), o, 0)) \
+                nj = repl_job(brk, (_BRK, self._sat(r + 1), o, 0, d)) \
                     if ok else jobs
                 out.append((("mark_broken", w, brk, ok),
                             repl_w(w, _IDLE, nj)))
@@ -268,40 +319,96 @@ class LeaseModel:
                 if any(jobs[t][3] > 0 for t in beaten):
                     nj = list(jobs)
                     for t in beaten:
-                        s, r, o, _ = nj[t]
-                        nj[t] = (s, r, o, 0)
+                        s, r, o, _, d = nj[t]
+                        nj[t] = (s, r, o, 0, d)
                     out.append((("beat", w, beaten),
-                                (tuple(nj), workers, commits)))
+                                (tuple(nj), workers, commits, budget)))
 
         # -- global (server/scavenger/clock) steps -----------------------
-        aged = [j for j, (s, _, _, a) in enumerate(jobs)
-                if s in (_RUN, _FIN) and a < self.cfg.stale_age]
+        aged = [j for j, rec in enumerate(jobs)
+                if rec[0] in (_RUN, _FIN) and rec[3] < self.cfg.stale_age]
         if aged:
             nj = list(jobs)
             for j in aged:
-                s, r, o, a = nj[j]
-                nj[j] = (s, r, o, a + 1)
-            out.append((("tick",), (tuple(nj), workers, commits)))
+                s, r, o, a, d = nj[j]
+                nj[j] = (s, r, o, a + 1, d)
+            out.append((("tick",), (tuple(nj), workers, commits, budget)))
 
         requeue_from = (_RUN,) if self.cfg.bug == "requeue_ignores_finished" \
             else (_RUN, _FIN)
-        stale = tuple(j for j, (s, _, _, a) in enumerate(jobs)
-                      if s in requeue_from and a >= self.cfg.stale_age)
+        stale = tuple(j for j, rec in enumerate(jobs)
+                      if rec[0] in requeue_from
+                      and rec[3] >= self.cfg.stale_age)
         if stale:
             nj = list(jobs)
             for j in stale:
-                s, r, o, a = nj[j]
-                nj[j] = (_BRK, self._sat(r + 1), o, 0)
-            out.append((("requeue", stale), (tuple(nj), workers, commits)))
+                s, r, o, a, d = nj[j]
+                nj[j] = (_BRK, self._sat(r + 1), o, 0, d)
+            out.append((("requeue", stale),
+                        (tuple(nj), workers, commits, budget)))
 
-        failed = tuple(j for j, (s, r, _, _) in enumerate(jobs)
-                       if s == _BRK and r >= self.cfg.max_retries)
+        failed = tuple(j for j, rec in enumerate(jobs)
+                       if rec[0] == _BRK and rec[1] >= self.cfg.max_retries)
         if failed:
             nj = list(jobs)
             for j in failed:
-                s, r, o, a = nj[j]
-                nj[j] = (_FAI, r, o, a)
-            out.append((("scavenge", failed), (tuple(nj), workers, commits)))
+                s, r, o, a, d = nj[j]
+                nj[j] = (_FAI, r, o, a, d)
+            out.append((("scavenge", failed),
+                        (tuple(nj), workers, commits, budget)))
+
+        # -- replica-aware data plane (DESIGN §20) -----------------------
+        # environment loss events, budget-bounded: a published output
+        # loses one replica, or every copy at once (the blackout /
+        # dead-backend shape). Only WRITTEN jobs hold published output.
+        if budget > 0:
+            for j, (s, r, o, a, d) in enumerate(jobs):
+                if s != _WRI:
+                    continue
+                if d == _D_INTACT:
+                    out.append((
+                        ("lose_replica", j),
+                        (repl_job(j, (s, r, o, a, _D_UNDER)), workers,
+                         commits, budget - 1)))
+                if d != _D_LOST:
+                    out.append((
+                        ("lose_all", j),
+                        (repl_job(j, (s, r, o, a, _D_LOST)), workers,
+                         commits, budget - 1)))
+        # scavenger pass, reconstruct rung: every under-replicated
+        # output is healed from a survivor — job state UNTOUCHED (the
+        # whole point of the trade)
+        under = tuple(j for j, rec in enumerate(jobs)
+                      if rec[0] == _WRI and rec[4] == _D_UNDER)
+        if under:
+            nj = list(jobs)
+            for j in under:
+                s, r, o, a, _ = nj[j]
+                nj[j] = (s, r, o, a, _D_INTACT)
+            out.append((("repair", under),
+                        (tuple(nj), workers, commits, budget)))
+        # scavenger pass, requeue rung (last resort): producers of
+        # wholly-lost output go back to WAITING via a status CAS on
+        # WRITTEN, with NO repetition charge, opening a fresh commit
+        # generation. The seeded bugs delete the rung entirely or drop
+        # the WRITTEN expectation from the CAS.
+        if self.cfg.bug != "scavenge_skips_lost_data":
+            if self.cfg.bug == "lost_requeue_skips_written_cas":
+                lost = tuple(j for j, rec in enumerate(jobs)
+                             if rec[4] == _D_LOST
+                             and rec[0] in (_WRI, _FIN, _RUN))
+            else:
+                lost = tuple(j for j, rec in enumerate(jobs)
+                             if rec[0] == _WRI and rec[4] == _D_LOST)
+            if lost:
+                nj = list(jobs)
+                nc = list(commits)
+                for j in lost:
+                    _, r, _, _, d = nj[j]
+                    nj[j] = (_WAIT, r, 0, 0, d)
+                    nc[j] = 0
+                out.append((("rerun_requeue", lost),
+                            (tuple(nj), workers, tuple(nc), budget)))
         return out
 
     @staticmethod
@@ -326,14 +433,24 @@ class LeaseModel:
 
     def step_violation(self, old: tuple, new: tuple,
                        label: tuple) -> Optional[str]:
-        ojobs, _, ocommits = old
-        njobs, _, ncommits = new
-        for j, ((os_, or_, oo, _), (ns_, nr, no, _)) in enumerate(
+        ojobs, _, ocommits, _ = old
+        njobs, _, ncommits, _ = new
+        for j, ((os_, or_, oo, _, _), (ns_, nr, no, _, _)) in enumerate(
                 zip(ojobs, njobs)):
             if nr < or_:
                 return (f"repetitions of job {j} decreased {or_}→{nr} "
                         f"on {label}")
             if ns_ != os_ and ns_ not in _ALLOWED_EDGES[os_]:
+                # the ONE legal WRITTEN→WAITING edge: the scavenger's
+                # lost-data requeue — and it must charge no repetition
+                # (the loss is not the job's fault; DESIGN §20)
+                if (label[0] == "rerun_requeue" and os_ == _WRI
+                        and ns_ == _WAIT):
+                    if nr != or_:
+                        return (f"lost-data requeue charged a repetition "
+                                f"to job {j} ({or_}→{nr}) — storage loss "
+                                "must never march a job toward FAILED")
+                    continue
                 return (f"illegal status edge job {j}: "
                         f"{Status(os_).name}→{Status(ns_).name} on {label}")
         if label[0] == "commit_b" and label[3]:
@@ -349,16 +466,24 @@ class LeaseModel:
         return None
 
     def quiescent_violation(self, state: tuple) -> Optional[str]:
-        jobs, workers, _ = state
+        jobs, workers, _, _ = state
         if all(m[0] == "D" for m in workers):
             return None              # a fully dead pool may strand work
-        bad = {j: Status(s).name for j, (s, _, _, _) in enumerate(jobs)
+        bad = {j: Status(s).name for j, (s, _, _, _, _) in enumerate(jobs)
                if s not in (_WRI, _FAI)}
         if bad:
             return (f"lost/stuck jobs at quiescence with a live worker: "
                     f"{bad} (every job must end WRITTEN or FAILED; a "
                     "FINISHED entry here is the stuck-FINISHED+unclaimed "
                     "gap)")
+        stranded = [j for j, (s, _, _, _, d) in enumerate(jobs)
+                    if s == _WRI and d == _D_LOST]
+        if stranded:
+            return (f"stranded lost shuffle data at quiescence with a "
+                    f"live worker: jobs {stranded} are WRITTEN but every "
+                    "replica of their output is gone and nobody will "
+                    "regenerate it — the reduce phase wedges (the "
+                    "scavenger must requeue the producer, DESIGN §20)")
         return None
 
 
@@ -402,11 +527,12 @@ def check_protocol(config: ModelConfig = ModelConfig(),
         next_frontier = []
         for state in frontier:
             trans = model.transitions(state)
-            # quiescence means no PROGRESS is possible; a worker death
-            # is an environment event, not progress — a state whose
-            # only enabled step is "somebody could still die" is
-            # already stuck, and must pass the lost-job invariant
-            if all(label[0] == "die" for label, _ in trans):
+            # quiescence means no PROGRESS is possible; worker death
+            # and data-loss events are environment events, not progress
+            # — a state whose only enabled steps are "somebody could
+            # still die / more data could be lost" is already stuck,
+            # and must pass the lost-job + stranded-data invariants
+            if all(label[0] in _ENV_OPS for label, _ in trans):
                 n_quiescent += 1
                 msg = model.quiescent_violation(state)
                 if msg:
@@ -415,7 +541,10 @@ def check_protocol(config: ModelConfig = ModelConfig(),
                                        _time.perf_counter() - t0,
                                        Violation(msg, trace_to(state),
                                                  state))
-                continue
+                if all(label[0] == "die" for label, _ in trans):
+                    continue     # only deaths left: nothing new to learn
+                # loss events still pending: a lost output must wake the
+                # scavenger back up — keep exploring those branches
             for label, new in trans:
                 n_trans += 1
                 msg = model.step_violation(state, new, label)
@@ -473,7 +602,10 @@ def replay_trace(store, trace: Sequence[tuple], config: ModelConfig,
 
     for i, label in enumerate(trace):
         op = label[0]
-        if op in ("exec", "exec_fail", "die", "tick"):
+        if op in ("exec", "exec_fail", "die", "tick",
+                  "lose_replica", "lose_all", "repair"):
+            # loss events and replica repair live on the data plane
+            # (store files, faults/replicate.py) — no jobstore op
             continue
         if op == "claim":
             _, w, take = label
@@ -547,14 +679,29 @@ def replay_trace(store, trace: Sequence[tuple], config: ModelConfig,
                 return diverged(i, label,
                                 f"scavenged {n}, model scavenged "
                                 f"{len(failed)}")
+        elif op == "rerun_requeue":
+            # the reconstruct-vs-requeue edge's last rung: exactly the
+            # WRITTEN→WAITING CAS Server._requeue_maps performs per
+            # producer of a wholly-lost file — the real store refuses it
+            # for any job not currently WRITTEN, which is where a
+            # skips-the-CAS bug trace diverges
+            (_, lost) = label
+            for j in lost:
+                if not store.set_job_status(ns, j, Status.WAITING,
+                                            expect=(Status.WRITTEN,)):
+                    return diverged(
+                        i, label,
+                        f"lost-data requeue CAS refused job {j} — the "
+                        "real store's WRITTEN expectation blocks the "
+                        "requeue the buggy model allowed")
         else:
             return diverged(i, label, f"unknown trace op {op!r}")
 
     result = {"ok": True, "steps": len(trace)}
     if final_state is not None:
-        jobs, _, _ = final_state
+        jobs, _, _, _ = final_state
         cap = config.max_retries + 1
-        for j, (s, r, _, _) in enumerate(jobs):
+        for j, (s, r, _, _, _) in enumerate(jobs):
             doc = store.get_job(ns, j)
             if int(doc["status"]) != s or min(int(doc["repetitions"]),
                                               cap) != r:
@@ -568,9 +715,10 @@ def replay_trace(store, trace: Sequence[tuple], config: ModelConfig,
 
 
 def utest() -> None:
-    """Self-test: a 1×2 exhaustive pass holds every invariant; both
-    seeded bugs are re-found; a violation trace replayed against the
-    real MemJobStore diverges exactly at the guarding CAS."""
+    """Self-test: a 1×2 exhaustive pass holds every invariant (with and
+    without the replica-recovery edge); every seeded bug is re-found; a
+    violation trace replayed against the real MemJobStore diverges
+    exactly at the guarding CAS."""
     from lua_mapreduce_tpu.coord.jobstore import MemJobStore
 
     small = ModelConfig(n_workers=1, n_jobs=2, batch_k=2)
@@ -587,3 +735,22 @@ def utest() -> None:
     stuck = check_protocol(dataclasses.replace(
         small, n_workers=2, bug="requeue_ignores_finished"))
     assert not stuck.ok and "FINISHED" in stuck.violation.message
+
+    # replica-recovery edge (DESIGN §20): loss events + repair +
+    # lost-data requeue keep the full invariant set, including the
+    # zero-repetition-charge and no-stranded-data rules
+    lossy = dataclasses.replace(small, data_loss_budget=2)
+    res2 = check_protocol(lossy)
+    assert res2.ok and res2.states > res.states
+
+    strand = check_protocol(dataclasses.replace(
+        lossy, bug="scavenge_skips_lost_data"))
+    assert not strand.ok and "stranded" in strand.violation.message
+
+    yank = check_protocol(dataclasses.replace(
+        lossy, n_workers=2, bug="lost_requeue_skips_written_cas"))
+    assert not yank.ok and "illegal status edge" in yank.violation.message
+    rep2 = replay_trace(MemJobStore(), yank.violation.trace, yank.config)
+    assert not rep2["ok"]
+    assert rep2["label"][0] in ("rerun_requeue", "commit_a", "commit_b",
+                                "claim")

@@ -480,6 +480,45 @@ class RawBytesContractRule(Rule):
                             "bytes 0-255 losslessly")
 
 
+# --- LMR009: spill publishes go through the replication helper -------------
+
+# the unreplicated record-writer factories (core/segment.py). A spill
+# producer constructing one of these directly publishes exactly ONE
+# copy, whatever the negotiated replication factor says.
+_PLAIN_SPILL_FACTORIES = {"writer_for", "SegmentWriter", "TextWriter"}
+
+
+class ReplicatedSpillRule(Rule):
+    id = "LMR009"
+    severity = "error"
+    title = "engine spill publishes must use the replication helper"
+    rationale = (
+        "Every run/spill publish in engine/ must go through "
+        "faults.replicate.spill_writer(store, format, replication): it "
+        "is the one place the negotiated replication factor turns into "
+        "an r-way fanout at the placement function's addresses "
+        "(DESIGN §20). A raw writer_for()/SegmentWriter()/TextWriter() "
+        "in a producer publishes a single copy — silently "
+        "under-replicated, invisible until the one copy is lost and a "
+        "map re-run pays for it. (Result-file publishes use the plain "
+        "store builder and are exempt: final results are deliberately "
+        "not replicated.)")
+    paths = ("engine/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            c = _chain(n.func)
+            if c and c[-1] in _PLAIN_SPILL_FACTORIES:
+                yield self.finding(
+                    ctx, n,
+                    f"{c[-1]}(...) in engine/ publishes a single "
+                    "unreplicated copy — route the spill through "
+                    "faults.replicate.spill_writer so the negotiated "
+                    "replication factor applies")
+
+
 # --- LMR008: classified raisables across the retry boundary ----------------
 
 # the op surfaces the retry layer wraps (DESIGN §19): store data-plane

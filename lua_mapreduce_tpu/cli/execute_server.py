@@ -91,6 +91,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--retry-base-ms", type=float, default=None,
                    help="decorrelated-jitter backoff base in ms "
                         "(default 25, or LMR_RETRY_BASE_MS)")
+    p.add_argument("--replication", type=int, default=None,
+                   help="shuffle replication factor r, written to the "
+                        "task doc as the fleet default (default 1, or "
+                        "LMR_REPLICATION): each spill publishes r copies "
+                        "on distinct placement targets, readers fail over "
+                        "to any survivor, and the scavenger reconstructs "
+                        "lost copies instead of re-running map jobs — "
+                        "docs/DESIGN.md §20. r=1 is byte-identical to "
+                        "the unreplicated path")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -139,7 +148,8 @@ def main(argv=None) -> int:
                     premerge_min_runs=args.premerge_min_runs,
                     premerge_max_runs=args.premerge_max_runs,
                     batch_k=args.batch_k,
-                    segment_format=args.segment_format).configure(spec)
+                    segment_format=args.segment_format,
+                    replication=args.replication).configure(spec)
 
     for _ in range(args.inline_workers):
         w = Worker(store).configure(max_iter=10_000)

@@ -32,6 +32,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "follow the task document's fleet default); pin "
                         "v1 on hosts that must stay text-only during a "
                         "rollout — readers sniff per file either way")
+    p.add_argument("--replication", type=int, default=None,
+                   help="shuffle replication factor THIS worker publishes "
+                        "and reads with (default: follow the task "
+                        "document's fleet default — DESIGN §20)")
     p.add_argument("--phases", default="map,reduce",
                    help="comma list of phases this worker claims "
                         "(heterogeneous pools: dedicated mapper hosts "
@@ -73,6 +77,8 @@ def main(argv=None) -> int:
         worker.configure(batch_k=args.batch_k)
     if args.segment_format is not None:
         worker.configure(segment_format=args.segment_format)
+    if args.replication is not None:
+        worker.configure(replication=args.replication)
     worker.execute()
     return 0
 

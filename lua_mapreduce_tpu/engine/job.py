@@ -20,10 +20,11 @@ from lua_mapreduce_tpu.core.merge import merge_iterator
 from lua_mapreduce_tpu.core.native_merge import (native_merge_records,
                                                  native_merge_reduce_sum,
                                                  native_premerge)
-from lua_mapreduce_tpu.core.segment import check_format, writer_for
+from lua_mapreduce_tpu.core.segment import check_format
 from lua_mapreduce_tpu.core.serialize import (assert_serializable, dump_record,
                                               sorted_keys)
 from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.faults.replicate import reading_view, spill_writer
 from lua_mapreduce_tpu.store.base import Store
 
 
@@ -92,7 +93,8 @@ def map_output_name(result_ns: str, part: int, map_key: Any) -> str:
 
 def run_map_job(spec: TaskSpec, store: Store, job_id: str,
                 map_key: Any, map_value: Any,
-                segment_format: str = "v1") -> JobTimes:
+                segment_format: str = "v1",
+                replication: int = 1) -> JobTimes:
     """Execute one map job and write per-partition sorted run files.
 
     Mirrors job.lua:154-228: run user mapfn with the grouping emit; sort
@@ -103,11 +105,18 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
     ``segment_format`` picks the run-file encoding — ``"v1"`` text lines
     or ``"v2"`` framed binary segments (core/segment.py) — negotiated via
     the task document; readers sniff per file, so mixed formats in one
-    namespace are always valid.
+    namespace are always valid. ``replication`` (DESIGN §20, negotiated
+    the same way) fans each run file out to r placement copies; r=1 is
+    byte-identical to the unreplicated path.
     """
     check_format(segment_format)
     times = JobTimes(started=time.time())
     cpu0 = time.process_time()
+    # replication routes through the portable plane: the view hides
+    # local_path (a native kernel writing only the primary would
+    # silently under-replicate) and fans stale-file removal out to
+    # every copy. r=1 leaves the store — and the native path — as-is.
+    store = reading_view(store, replication)
 
     # declared-intent native fast path: a mapfn tagged ``native_map``
     # promises the C++ kernel computes exactly what mapfn+partitionfn
@@ -142,7 +151,8 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
                     f"partitionfn({key!r}) returned negative {part}")
             w = writers.get(part)
             if w is None:
-                w = writers[part] = writer_for(store, segment_format)
+                w = writers[part] = spill_writer(store, segment_format,
+                                                 replication)
             w.add(key, values)
 
         for part, w in writers.items():
@@ -163,7 +173,8 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
 
 def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
                      spill_file: str,
-                     segment_format: str = "v1") -> JobTimes:
+                     segment_format: str = "v1",
+                     replication: int = 1) -> JobTimes:
     """Eagerly consolidate committed sorted runs into one spill run —
     the pipelined-shuffle work unit (engine/premerge.py).
 
@@ -173,11 +184,14 @@ def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
     not its runs were pre-merged. Consumed inputs are deleted only after
     the spill publishes atomically; idempotent under duplicate execution
     (claim lost to a stale requeue): an existing spill short-circuits to
-    a sweep of any leftover inputs.
+    a sweep of any leftover inputs. Under ``replication`` the input
+    reads fail over across run-file copies, the spill publish fans out
+    r-way, and consumed-input removal sweeps every copy (DESIGN §20).
     """
     check_format(segment_format)
     times = JobTimes(started=time.time())
     cpu0 = time.process_time()
+    store = reading_view(store, replication)
     if store.exists(spill_file):
         # duplicate/restarted execution: the spill is already published
         # (atomic build, deterministic content) — sweep leftovers only
@@ -193,9 +207,11 @@ def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
             f"with no spill published: {missing[:3]}")
     # the native single-pass merge publishes a TEXT spill regardless of
     # the negotiated format (readers sniff per file, so that is always
-    # valid); the Python path emits the negotiated format
+    # valid); the Python path emits the negotiated format. Under
+    # replication the view hides local_path, so this resolves to the
+    # portable plane and the spill publish fans out.
     if not native_premerge(store, run_files, spill_file):
-        writer = writer_for(store, segment_format)
+        writer = spill_writer(store, segment_format, replication)
         try:
             merged = native_merge_records(store, run_files)
             if merged is None:
@@ -216,7 +232,7 @@ def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
 
 def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
                    part_key: str, run_files: List[str],
-                   result_file: str) -> JobTimes:
+                   result_file: str, replication: int = 1) -> JobTimes:
     """Execute one reduce job: k-way merge a partition's runs — raw
     mapper runs and/or pre-merged spills, in the caller-given canonical
     order (the merge concatenates equal-key values in file-list order,
@@ -226,10 +242,15 @@ def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
     Mirrors job.lua:230-296: the fast path for flagged reducers skips
     reducefn on singleton groups (264-275); results always land in the
     *result* store regardless of the intermediate backend (249-251, 287);
-    consumed run files are deleted after success (293).
+    consumed run files are deleted after success (293). Under
+    ``replication`` every input read fails over across copies and the
+    consumed-run sweep removes every copy; the RESULT file is never
+    replicated — final results are the engine's format- and
+    replication-invariant surface (DESIGN §20).
     """
     times = JobTimes(started=time.time())
     cpu0 = time.process_time()
+    store = reading_view(store, replication)
 
     fast = spec.fast_path
     reducefn = spec.reducefn

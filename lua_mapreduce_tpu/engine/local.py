@@ -115,7 +115,8 @@ class LocalExecutor:
     def __init__(self, spec: TaskSpec, map_parallelism: int = 1,
                  max_iterations: int = 1000, pipeline: bool = False,
                  premerge_min_runs: int = 4, premerge_max_runs: int = 8,
-                 batch_k: int = 1, segment_format: str = "v1"):
+                 batch_k: int = 1, segment_format: str = "v1",
+                 replication: Optional[int] = None):
         self.spec = spec
         self.map_parallelism = max(1, map_parallelism)
         self.max_iterations = max_iterations
@@ -133,7 +134,16 @@ class LocalExecutor:
         # framed binary segments; results stay v1 text either way
         from lua_mapreduce_tpu.core.segment import check_format
         self.segment_format = check_format(segment_format)
+        # shuffle replication factor (DESIGN §20): spills fan out to r
+        # placement copies and every read fails over to any survivor.
+        # r=1 (the default) is byte-identical to the unreplicated path.
+        from lua_mapreduce_tpu.engine.placement import resolve_replication
+        self.replication = resolve_replication(replication)
+        from lua_mapreduce_tpu.faults.replicate import reading_view
         self.store = get_storage_from(spec.storage)
+        # discovery/cleanup address LOGICAL files through the failover
+        # view (identity when replication is off)
+        self._view = reading_view(self.store, self.replication)
         self.result_store = (get_storage_from(spec.result_storage)
                              if spec.result_storage else self.store)
         self.stats = TaskStats()
@@ -175,15 +185,17 @@ class LocalExecutor:
             map_times = self._run_jobs([
                 (lambda k=k, v=v, i=i: run_map_job(
                     spec, self.store, str(i), k, v,
-                    segment_format=self.segment_format))
+                    segment_format=self.segment_format,
+                    replication=self.replication))
                 for i, (k, v) in enumerate(jobs)])
             it_stats.map.fold(map_times)
 
-            parts = discover_partitions(self.store, spec.result_ns)
+            parts = discover_partitions(self._view, spec.result_ns)
             reduce_times = self._run_jobs([
                 (lambda p=p, files=files: run_reduce_job(
                     spec, self.store, self.result_store, str(p), files,
-                    result_file_name(spec.result_ns, p)))
+                    result_file_name(spec.result_ns, p),
+                    replication=self.replication))
                 for p, files in sorted(parts.items())])
             it_stats.reduce.fold(reduce_times)
 
@@ -199,6 +211,10 @@ class LocalExecutor:
         it_stats.store_faults = (fd.get("retry_exhausted", 0)
                                  + fd.get("faults_injected", 0))
         it_stats.degraded_reads = fd.get("degraded_reads", 0)
+        it_stats.failover_reads = fd.get("failover_reads", 0)
+        it_stats.replica_repairs = fd.get("replica_repairs", 0)
+        it_stats.map_reruns_avoided = fd.get("map_reruns_avoided", 0)
+        it_stats.map_reruns = fd.get("map_reruns", 0)
         it_stats.wall_time = time.time() - t0
         self.stats.iterations.append(it_stats)
         return verdict
@@ -234,13 +250,14 @@ class LocalExecutor:
         def premerge_one(sp):
             try:
                 t = run_premerge_job(spec, self.store, sp.files, sp.name,
-                                     segment_format=self.segment_format)
+                                     segment_format=self.segment_format,
+                                     replication=self.replication)
             except Exception as e:
                 with lock:
                     pre_failed[0] += 1
                     tracker.spill_failed(
                         sp.part, sp.seq,
-                        spill_exists=self.store.exists(sp.name))
+                        spill_exists=self._view.exists(sp.name))
                 print(f"[local] pre_merge {sp.name} failed; reduce falls "
                       f"back to raw runs: {type(e).__name__}: {e}",
                       file=sys.stderr)
@@ -251,7 +268,8 @@ class LocalExecutor:
 
         def map_one(i, k, v):
             t = run_map_job(spec, self.store, str(i), k, v,
-                            segment_format=self.segment_format)
+                            segment_format=self.segment_format,
+                            replication=self.replication)
             produced = {}
             for name in self.store.list(
                     f"{spec.result_ns}.P*.M{map_keys[i]}"):
@@ -276,10 +294,11 @@ class LocalExecutor:
                 f.result()
             for f in list(pre_futs):
                 f.result()
-            parts = discover_pipelined(self.store, spec.result_ns, map_keys)
+            parts = discover_pipelined(self._view, spec.result_ns, map_keys)
             red_futs = [pool.submit(
                 run_reduce_job, spec, self.store, self.result_store, str(p),
-                files, result_file_name(spec.result_ns, p))
+                files, result_file_name(spec.result_ns, p),
+                self.replication)
                 for p, files in sorted(parts.items())]
             reduce_times = [f.result() for f in red_futs]
         finally:
@@ -289,9 +308,12 @@ class LocalExecutor:
     def clean_namespace(self) -> None:
         """Drop every file under this task's result namespace in both
         stores (analog of server_drop_collections + remove_pending_tasks,
-        server.lua:331-345, 237-245)."""
-        for store in {id(self.store): self.store,
-                      id(self.result_store): self.result_store}.values():
+        server.lua:331-345, 237-245). The failover view makes the sweep
+        replica-aware: logical listing, fan-out removal."""
+        from lua_mapreduce_tpu.faults.replicate import reading_view
+        for store in {id(self.store): self._view,
+                      id(self.result_store): reading_view(
+                          self.result_store, self.replication)}.values():
             for name in store.list(f"{self.spec.result_ns}.P*"):
                 store.remove(name)
 

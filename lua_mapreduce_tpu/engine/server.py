@@ -80,6 +80,14 @@ class Server:
     IO hidden behind the map phase (IterationStats.overlap_fraction).
     ``premerge_min_runs``/``premerge_max_runs`` bound how many committed
     runs one pre-merge job consolidates.
+
+    ``replication`` (DESIGN §20; None = ``LMR_REPLICATION`` env, else 1)
+    turns on the replica-aware shuffle: every run/spill publish fans out
+    to r placement copies, readers fail over to any survivor, and this
+    server's scavenge path RECONSTRUCTS lost copies from survivors —
+    requeueing the producing map job only when every copy is gone.
+    Written to the task doc as the fleet default, like
+    ``segment_format``; r=1 is byte-identical to the unreplicated path.
     """
 
     def __init__(self, store: JobStore, poll_interval: float = DEFAULT_SLEEP,
@@ -87,7 +95,8 @@ class Server:
                  verbose: bool = False, strict: bool = False,
                  pipeline: bool = False, premerge_min_runs: int = 4,
                  premerge_max_runs: int = 8, batch_k: int = 1,
-                 segment_format: str = "v1"):
+                 segment_format: str = "v1",
+                 replication: Optional[int] = None):
         # coord RPCs ride the transient-fault retry layer (DESIGN §19);
         # the scavenge/requeue/drain housekeeping must not abort an
         # iteration over one store blip
@@ -115,10 +124,17 @@ class Server:
         # is free of crash-consistency ties (unlike the shuffle mode).
         from lua_mapreduce_tpu.core.segment import check_format
         self.segment_format = check_format(segment_format)
+        # shuffle replication factor (DESIGN §20): the fleet default,
+        # written to the task doc like segment_format
+        from lua_mapreduce_tpu.engine.placement import resolve_replication
+        self.replication = resolve_replication(replication)
         self.spec: Optional[TaskSpec] = None
         self.stats = TaskStats()
         self.finished_value: Any = None
         self.errors: List[dict] = []   # every drained worker error, kept
+        self._data_store = None        # intermediate store (recovery path)
+        self._map_ids: Optional[Dict[str, int]] = None  # map key -> jid
+        self._spill_repairs: Dict[str, tuple] = {}  # spill -> (part, a, b)
 
     # -- configuration ------------------------------------------------------
 
@@ -196,6 +212,14 @@ class Server:
                 # on the doc marker, so a doc that predates it must not
                 # leave published pre_merge jobs unclaimable
                 self.pipeline = bool(task.get("pipeline", self.pipeline))
+                # replication shares the pipeline rule: a crashed r>1
+                # run may hold data ONLY in replica copies (primary lost
+                # mid-crash) — an r=1 resume could not see it, so the
+                # doc's factor wins on resume
+                from lua_mapreduce_tpu.engine.placement import \
+                    check_replication
+                self.replication = check_replication(
+                    task.get("replication", self.replication) or 1)
                 # batch_k / segment_format are perf knobs with no
                 # crash-consistency tie to on-disk state (readers sniff
                 # spill formats per file; unlike the shuffle mode), so
@@ -203,7 +227,8 @@ class Server:
                 self.store.update_task({
                     "pipeline": self.pipeline,
                     "batch_k": self.batch_k,
-                    "segment_format": self.segment_format})
+                    "segment_format": self.segment_format,
+                    "replication": self.replication})
                 if status == TaskStatus.REDUCE.value:
                     skip_map = True
         if self.spec is None:
@@ -223,14 +248,25 @@ class Server:
                 # the fleet's spill encoding (workers with no explicit
                 # segment_format follow this; readers sniff per file)
                 "segment_format": self.segment_format,
+                # the fleet's shuffle replication factor (workers with
+                # no explicit replication follow this — DESIGN §20)
+                "replication": self.replication,
                 "started": time.time(),
             })
 
-        store = get_storage_from(self.spec.storage)
+        from lua_mapreduce_tpu.faults.replicate import reading_view
+        # the plain store repairs copies individually (scavenge path);
+        # discovery/cleanup go through the failover view so a lost
+        # primary with a surviving replica stays discoverable and
+        # sweeps fan out to every copy. r=1: both are the same object.
+        self._data_store = get_storage_from(self.spec.storage)
+        store = reading_view(self._data_store, self.replication)
         result_store = (get_storage_from(self.spec.result_storage)
-                        if self.spec.result_storage else store)
+                        if self.spec.result_storage else self._data_store)
 
         while True:
+            self._spill_repairs.clear()
+            self._map_ids = None
             it_stats = IterationStats(iteration=iteration)
             it_t0 = time.time()
             rounds0 = self.store.round_counts()
@@ -281,6 +317,10 @@ class Server:
                                      + fd.get("faults_injected", 0))
             it_stats.infra_releases = fd.get("infra_releases", 0)
             it_stats.degraded_reads = fd.get("degraded_reads", 0)
+            it_stats.failover_reads = fd.get("failover_reads", 0)
+            it_stats.replica_repairs = fd.get("replica_repairs", 0)
+            it_stats.map_reruns_avoided = fd.get("map_reruns_avoided", 0)
+            it_stats.map_reruns = fd.get("map_reruns", 0)
             it_stats.wall_time = time.time() - it_t0
             self.stats.iterations.append(it_stats)
             self.store.update_task({"stats": it_stats.as_dict()})
@@ -384,17 +424,139 @@ class Server:
         server.lua:186-234): scavenge BROKEN≥retries→FAILED and requeue
         stale RUNNING in every given namespace, then drain + retain
         worker errors. Both the barrier wait and the pipelined wait call
-        this so the recovery semantics cannot drift apart."""
+        this so the recovery semantics cannot drift apart. With
+        replication on, drained errors naming lost shuffle files feed
+        the reconstruct-vs-requeue scavenge path (DESIGN §20)."""
         for ns in namespaces:
             self.store.scavenge(ns, MAX_JOB_RETRIES)
             if self.stale_timeout_s is not None:
                 self.store.requeue_stale(ns, self.stale_timeout_s)
+        lost: List[str] = []
         for err in self.store.drain_errors():
             # the drain is destructive — always retain for diagnosis,
             # not only when verbose (server.lua:218-228 echoes live)
             self.errors.append(err)
+            lost.extend(err.get("lost_files") or ())
             self._log(f"worker error [{err['worker']}]: "
                       f"{err['msg'].splitlines()[-1] if err['msg'] else ''}")
+        if self.replication > 1:
+            if lost:
+                self._recover_lost(sorted(set(lost)))
+            if self._spill_repairs:
+                self._settle_spill_repairs()
+
+    # -- replica-aware recovery (DESIGN §20) --------------------------------
+
+    def _recover_lost(self, files: List[str]) -> None:
+        """The scavenger's reconstruct-vs-requeue decision, per lost
+        file: REPAIR from any surviving replica (milliseconds, no job
+        state touched — counted ``replica_repairs``), and only when
+        every copy is gone REQUEUE the producing map job(s) — the
+        last-resort re-run the replication layer exists to avoid."""
+        from lua_mapreduce_tpu.faults.replicate import repair
+        for name in files:
+            if name in self._spill_repairs:
+                continue            # republish already pending below
+            verdict = repair(self._data_store, name, self.replication)
+            if verdict != "lost":
+                # intact/repaired: full redundancy restored; degraded:
+                # a survivor still serves failover reads and the next
+                # housekeeping pass retries the heal — never a re-run
+                self._log(f"scavenge: {name} {verdict} "
+                          "(a surviving replica serves it)")
+                continue
+            self._requeue_producers(name)
+
+    def _map_id_by_key(self) -> Dict[str, int]:
+        if self._map_ids is None:
+            self._map_ids = {map_key_str(d["_id"]): d["_id"]
+                             for d in self.store.jobs(MAP_NS)}
+        return self._map_ids
+
+    def _requeue_producers(self, name: str) -> None:
+        """Every copy of ``name`` is gone: push its producer(s) back to
+        WAITING (no repetition charge — the loss is not the job's
+        fault) so the pool regenerates the data during the reduce
+        phase (Worker's replication-gated map probe). A lost SPILL
+        additionally needs its pre-merge republished once the covering
+        map jobs land — tracked in ``_spill_repairs``."""
+        ns = self.spec.result_ns
+        m = run_name_re(ns).match(name)
+        if m:
+            self._requeue_maps([m.group(2)], name)
+            return
+        parsed = parse_spill_name(ns, name)
+        if parsed is None:
+            return          # not a shuffle file of this task (a result
+                            # file, say): nothing to regenerate here
+        part, a, b = parsed
+        order = sorted(self._map_id_by_key())
+        if self._requeue_maps(order[a:b + 1], name):
+            self._spill_repairs[name] = (part, a, b)
+
+    def _requeue_maps(self, map_keys, why_file: str) -> int:
+        """WRITTEN→WAITING CAS per producer (a key already requeued —
+        or re-running — fails the CAS and is simply not re-charged).
+        Each landed requeue is a counted ``map_rerun`` and an
+        errors-stream entry tagged ``spill-lost-requeue``, so lost-data
+        re-runs are distinguishable from stale-worker requeues."""
+        by_key = self._map_id_by_key()
+        n = 0
+        for key in map_keys:
+            jid = by_key.get(key)
+            if jid is None:
+                continue
+            if not self.store.set_job_status(MAP_NS, jid, Status.WAITING,
+                                             expect=(Status.WRITTEN,)):
+                continue
+            n += 1
+            COUNTERS.bump("map_reruns")
+            self.store.insert_error(
+                "server",
+                f"map job {jid} requeued: shuffle file {why_file!r} lost "
+                "with no surviving replica (last-resort re-run)",
+                info={"classification": "spill-lost-requeue",
+                      "ns": MAP_NS, "job_id": jid, "file": why_file})
+            self._log(f"scavenge: {why_file} unrecoverable — map job "
+                      f"{jid} requeued for re-run")
+        return n
+
+    def _settle_spill_repairs(self) -> None:
+        """Republish the pre-merge for a lost spill once every covering
+        map job re-ran: rebuild the canonical file list from storage
+        (absent positions are transparent, engine/premerge.py) and
+        insert a fresh pre_merge job — workers claim it through the
+        reduce-phase probe and the retrying reduce job then finds its
+        spill again."""
+        store = self._data_store
+        from lua_mapreduce_tpu.faults.replicate import reading_view
+        view = reading_view(store, self.replication)
+        ns = self.spec.result_ns
+        by_key = self._map_id_by_key()
+        status = {d["_id"]: d["status"] for d in self.store.jobs(MAP_NS)}
+        order = sorted(by_key)
+        run_re = run_name_re(ns)
+        for spill, (part, a, b) in list(self._spill_repairs.items()):
+            if view.exists(spill):
+                self._spill_repairs.pop(spill)
+                continue
+            keys = order[a:b + 1]
+            if not all(status.get(by_key[k]) == Status.WRITTEN
+                       for k in keys if k in by_key):
+                continue        # producers still re-running
+            wanted = set(keys)
+            files = [n for n in view.list(f"{ns}.P{part}.M*")
+                     if (mm := run_re.match(n)) and mm.group(2) in wanted]
+            if not files:
+                self._spill_repairs.pop(spill)
+                continue        # nothing re-emitted for this partition
+            self.store.insert_jobs(PRE_NS, [make_job(
+                f"repair.{part}.{a}-{b}",
+                {"part": part, "seq": -1, "files": files,
+                 "spill": spill})])
+            self._spill_repairs.pop(spill)
+            self._log(f"scavenge: republished pre_merge for lost spill "
+                      f"{spill} ({len(files)} run(s))")
 
     def _finish_phase(self, phase: str, counts: Dict[Status, int],
                       total: int) -> None:
@@ -533,8 +695,15 @@ class Server:
         every interval — scavenge BROKEN≥3→FAILED, requeue stale RUNNING,
         drain + surface worker errors, report progress — until every job is
         WRITTEN or FAILED."""
+        namespaces = (ns,)
+        if ns == RED_NS and self.replication > 1:
+            # recovery re-runs ride the map/pre namespaces DURING the
+            # reduce phase (DESIGN §20): they need the same scavenge +
+            # stale-requeue upkeep, or a SIGKILLed re-run would wedge
+            # the repair forever
+            namespaces = (RED_NS, MAP_NS, PRE_NS)
         while True:
-            self._housekeep(ns)
+            self._housekeep(*namespaces)
             counts = self.store.counts(ns)
             done = counts[Status.WRITTEN] + counts[Status.FAILED]
             if progress is not None:

@@ -48,7 +48,8 @@ PRE_NS = "pre_jobs"     # eager pre-merge jobs, published DURING the map
 MAX_INFRA_POLL_FAILURES = 10
 
 _CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases",
-                "heartbeat_s", "batch_k", "batch_lease_s", "segment_format")
+                "heartbeat_s", "batch_k", "batch_lease_s", "segment_format",
+                "replication")
 
 # EWMA smoothing for the observed per-job duration that drives adaptive
 # batch sizing (recent jobs dominate: a phase whose jobs suddenly get big
@@ -102,6 +103,13 @@ class Worker:
         # per file, so any mix of formats in one namespace is valid.
         self.segment_format = None
         self._task_segment_format = None        # last task doc's value
+        # shuffle replication factor (DESIGN §20): None = follow the
+        # task document's fleet default (the server-deployed r); an
+        # explicit configure(replication=...) wins. r=1 keeps every
+        # spill publish, read, and remove byte-identical to the
+        # unreplicated path.
+        self.replication = None
+        self._task_replication = None           # last task doc's value
         self._dur_ewma: Dict[str, float] = {}   # ns -> smoothed real secs
         self._spec_cache: Dict[str, TaskSpec] = {}
         self._infra_released: Dict[tuple, int] = {}  # (ns, jid) -> count
@@ -123,6 +131,10 @@ class Worker:
                 # fail at configure time, not as a per-job failure storm
                 from lua_mapreduce_tpu.core.segment import check_format
                 check_format(v)
+            if k == "replication" and v is not None:
+                from lua_mapreduce_tpu.engine.placement import \
+                    check_replication
+                check_replication(v)
             setattr(self, k, v)
         return self
 
@@ -153,6 +165,7 @@ class Worker:
             self._release_gen = gen
             self._infra_released.clear()
         self._task_segment_format = task.get("segment_format")
+        self._task_replication = task.get("replication")
 
         if task["status"] == TaskStatus.MAP.value:
             if "map" in self.phases:
@@ -185,14 +198,39 @@ class Worker:
             return "idle"
 
         if task["status"] == TaskStatus.REDUCE.value:
+            # replica-aware recovery (DESIGN §20): when every copy of a
+            # run/spill is gone, the server requeues the PRODUCING map
+            # job (and republishes the covering pre_merge) DURING the
+            # reduce phase — last-resort regeneration. The probes are
+            # gated on replication being on: unreplicated deployments
+            # pay zero extra claim round trips, exactly like the
+            # pipeline gate on the pre_jobs probe above. They run
+            # BEFORE the reduce claim: producers unblock consumers, and
+            # in a single dual-phase-worker fleet a released lost-data
+            # reduce job would otherwise be reclaimed every poll,
+            # starving its own requeued producer forever.
+            if int(task.get("replication") or 1) > 1:
+                if "map" in self.phases:
+                    jobs = self.store.claim_batch(
+                        MAP_NS, self.name, self._effective_k(MAP_NS, task))
+                    if jobs:
+                        self._execute_batch(spec, MAP_NS, jobs)
+                        return "executed"
+                if "reduce" in self.phases and task.get("pipeline"):
+                    jobs = self.store.claim_batch(
+                        PRE_NS, self.name, self._effective_k(PRE_NS, task))
+                    if jobs:
+                        self._execute_batch(spec, PRE_NS, jobs)
+                        return "executed"
+            if "reduce" in self.phases:
+                jobs = self.store.claim_batch(
+                    RED_NS, self.name, self._effective_k(RED_NS, task))
+                if jobs:
+                    self._execute_batch(spec, RED_NS, jobs)
+                    return "executed"
             if "reduce" not in self.phases:
                 return "out-of-phase"
-            jobs = self.store.claim_batch(
-                RED_NS, self.name, self._effective_k(RED_NS, task))
-            if not jobs:
-                return "idle"
-            self._execute_batch(spec, RED_NS, jobs)
-            return "executed"
+            return "idle"
 
         raise RuntimeError(f"unknown task status {task['status']!r}")
 
@@ -287,11 +325,19 @@ class Worker:
         the task document's fleet default, else v1."""
         return self.segment_format or self._task_segment_format or "v1"
 
+    def _replication(self) -> int:
+        """The shuffle replication factor this worker publishes and
+        reads with: its own override, else the task document's fleet
+        default, else 1 (off)."""
+        return int(self.replication if self.replication is not None
+                   else (self._task_replication or 1))
+
     def _map_body(self, spec: TaskSpec, job: dict):
         store = get_storage_from(spec.storage)
         return run_map_job(spec, store, str(job["_id"]), job["key"],
                            job["value"],
-                           segment_format=self._segment_format())
+                           segment_format=self._segment_format(),
+                           replication=self._replication())
 
     def _premerge_body(self, spec: TaskSpec, job: dict):
         """Consolidate committed runs into a spill (pipelined shuffle).
@@ -301,10 +347,16 @@ class Worker:
         store = get_storage_from(spec.storage)
         v = job["value"]
         return run_premerge_job(spec, store, v["files"], v["spill"],
-                                segment_format=self._segment_format())
+                                segment_format=self._segment_format(),
+                                replication=self._replication())
 
     def _reduce_body(self, spec: TaskSpec, job: dict):
-        store = get_storage_from(spec.storage)
+        from lua_mapreduce_tpu.faults.replicate import reading_view
+        replication = self._replication()
+        # the failover view: the visibility check below answers for
+        # LOGICAL files (any surviving copy), and run_reduce_job's
+        # merge reads fail over per file (DESIGN §20). r=1: identity.
+        store = reading_view(get_storage_from(spec.storage), replication)
         result_store = (get_storage_from(spec.result_storage)
                         if spec.result_storage else store)
         v = job["value"]
@@ -339,13 +391,26 @@ class Worker:
                     store.remove(name)
                 times.finished = times.written = time.time()
                 return times
+            if replication > 1:
+                # every copy gone: a RECOVERABLE loss, not a dead job —
+                # release (no repetition charge) and name the files so
+                # the server's scavenger repairs them or requeues their
+                # producers (DESIGN §20 ladder, rungs 3-4)
+                from lua_mapreduce_tpu.faults.errors import \
+                    LostShuffleDataError
+                raise LostShuffleDataError(
+                    f"reduce {v['part']}: {len(missing)} run file(s) "
+                    f"lost with no surviving replica: {missing[:3]} — "
+                    "awaiting scavenger repair or producer re-run",
+                    op="reduce", name=missing[0], files=missing)
             raise RuntimeError(
                 f"reduce {v['part']}: {len(missing)} run file(s) not "
                 f"visible in storage (producers: "
                 f"{v.get('mappers') or 'unknown'}): {missing[:3]} — "
                 "cross-host pools need a backend every host can reach")
         return run_reduce_job(spec, store, result_store,
-                              str(v["part"]), v["files"], v["result"])
+                              str(v["part"]), v["files"], v["result"],
+                              replication=replication)
 
     _BODIES = {MAP_NS: _map_body, PRE_NS: _premerge_body,
                RED_NS: _reduce_body}
@@ -415,11 +480,27 @@ class Worker:
         """Structured post-mortem fields for an errors-stream entry:
         exception class, provenance-aware infra/user classification,
         and job context — so drained errors distinguish infra from
-        user-code failures without parsing tracebacks (DESIGN §19)."""
-        return {"exc_class": type(exc).__name__,
+        user-code failures without parsing tracebacks (DESIGN §19).
+        Store faults that name a shuffle file additionally carry
+        ``lost_files`` (logical names), the hook the server's scavenger
+        acts on: repair the file from a surviving replica, or requeue
+        its producer when every copy is gone (DESIGN §20)."""
+        info = {"exc_class": type(exc).__name__,
                 "exc_msg": str(exc)[:500],
                 "classification": classify_job_fault(exc),
                 "ns": ns, "job_id": jid}
+        from lua_mapreduce_tpu.engine.placement import base_name
+        from lua_mapreduce_tpu.faults.errors import StoreError
+        lost = getattr(exc, "lost_files", None)
+        if lost:
+            info["lost_files"] = sorted({base_name(n) for n in lost})
+        elif (isinstance(exc, StoreError) and exc.name
+              and exc.op in ("lines", "read_range", "size")):
+            # a data-plane read fault names ONE file — the mid-stream
+            # shape (merge began, the copy died under it) that the
+            # failover view cannot absorb without duplicating records
+            info["lost_files"] = [base_name(exc.name)]
+        return info
 
     def _release_budget_ok(self, ns: str, jid: int) -> bool:
         """Liveness backstop for the release-not-broken path: THIS

@@ -13,7 +13,12 @@ The robustness subsystem (DESIGN §19). Four pieces:
 - ``wrappers`` — :class:`FaultyStore` / :class:`FaultyJobStore`
   (injection) and :class:`RetryingStore` / :class:`RetryingJobStore`
   (transparent retry with build readback-verify), plus the router and
-  engine wiring points.
+  engine wiring points;
+- ``replicate`` — the replica-aware shuffle data plane (DESIGN §20):
+  r-way spill publish fanout (:func:`spill_writer`), failover reads
+  (:class:`ReplicatedStore`), and scavenger reconstruction
+  (:func:`repair`), addressed by the deterministic placement function
+  in engine/placement.py.
 """
 
 from lua_mapreduce_tpu.faults.errors import (ConcurrentInsertError,
@@ -25,7 +30,11 @@ from lua_mapreduce_tpu.faults.errors import (ConcurrentInsertError,
                                              classify_exception,
                                              describe_classification,
                                              is_transient_fault)
+from lua_mapreduce_tpu.faults.errors import LostShuffleDataError
 from lua_mapreduce_tpu.faults.plan import FaultPlan
+from lua_mapreduce_tpu.faults.replicate import (ReplicatedStore,
+                                                reading_view, repair,
+                                                spill_writer)
 from lua_mapreduce_tpu.faults.retry import (COUNTERS, FaultCounters,
                                             RetryPolicy, configure_retry,
                                             default_policy, retry_settings)
@@ -39,8 +48,9 @@ from lua_mapreduce_tpu.faults.wrappers import (FaultyJobStore, FaultyStore,
 __all__ = [
     "StoreError", "TransientStoreError", "PermanentStoreError",
     "InjectedFault", "InjectedPermanentFault", "NoTaskError",
-    "ConcurrentInsertError", "classify_exception", "is_transient_fault",
-    "describe_classification",
+    "ConcurrentInsertError", "LostShuffleDataError", "classify_exception",
+    "is_transient_fault", "describe_classification",
+    "ReplicatedStore", "reading_view", "repair", "spill_writer",
     "RetryPolicy", "FaultCounters", "COUNTERS", "configure_retry",
     "retry_settings", "default_policy",
     "FaultPlan",
@@ -52,6 +62,7 @@ __all__ = [
 
 def utest() -> None:
     """Run the subsystem's module self-tests."""
-    from lua_mapreduce_tpu.faults import errors, plan, retry, wrappers
-    for mod in (errors, retry, plan, wrappers):
+    from lua_mapreduce_tpu.faults import (errors, plan, replicate, retry,
+                                          wrappers)
+    for mod in (errors, retry, plan, wrappers, replicate):
         mod.utest()

@@ -132,6 +132,22 @@ class ConcurrentInsertError(PermanentStoreError, RuntimeError):
     inserter — the server). Deterministic protocol violation."""
 
 
+class LostShuffleDataError(TransientStoreError):
+    """Every replica of a shuffle file is unreadable (DESIGN §20).
+
+    Raised by the replicated read view (faults/replicate.py) when the
+    failover ladder runs out of copies. Transient by classification —
+    the worker RELEASES the consuming job (no repetition charge) while
+    the server's scavenger repairs the file from a survivor or, with
+    all ``r`` copies gone, requeues the producing map job (the
+    last-resort re-run). ``lost_files`` names the logical files so the
+    scavenger acts on structure, not on traceback parsing."""
+
+    def __init__(self, msg: str, *, files=(), **kw):
+        super().__init__(msg, **kw)
+        self.lost_files = list(files)
+
+
 def classify_exception(exc: BaseException) -> Optional[bool]:
     """The central classification table.
 

@@ -23,12 +23,18 @@ Fault kinds (the failure modes the store/coord planes must survive):
                            publish a duplicate)
 - ``rpc_transient``      — transient faults on jobstore RPCs (claim /
                            commit / heartbeat / counts ...)
+- ``blackout``           — every data-plane op on ONE placement tag
+                           (engine/placement.py) fails transient for a
+                           clock window: the whole-failure-domain shape
+                           ("all replicas on one backend died") the
+                           replicated shuffle must absorb (DESIGN §20)
 
 ``max_per_key`` bounds the faults charged to one ``(op, name)`` stream,
-guaranteeing liveness under any retry budget. Plans serialize to a
-compact ``k=v;k=v`` spec so subprocess fleets inherit one through the
-``LMR_FAULT_PLAN`` environment variable (parsed by the router at
-store-wrap time).
+guaranteeing liveness under any retry budget (the blackout kind is
+bounded by its WINDOW instead — a dark failure domain fails every op,
+not a budgeted few). Plans serialize to a compact ``k=v;k=v`` spec so
+subprocess fleets inherit one through the ``LMR_FAULT_PLAN``
+environment variable (parsed by the router at store-wrap time).
 """
 
 from __future__ import annotations
@@ -56,6 +62,14 @@ RPC_OPS = frozenset({
 # build-only kinds never apply to read ops and vice versa
 _BUILD_KINDS = ("torn", "error_after_write")
 
+# ops a BLACKOUT darkens: the per-file data plane. ``build`` is excluded
+# (the injected-build shapes torn/error_after_write model publish
+# failure precisely; a pre-op transient on build is indistinguishable
+# from error_after_write=never — the kind-orthogonality rule below),
+# and ``list`` addresses a pattern, not a file on a tag.
+_BLACKOUT_OPS = frozenset({"lines", "read_range", "size", "exists",
+                           "remove"})
+
 
 class FaultPlan:
     """Seeded deterministic fault schedule over store/coord operations."""
@@ -66,7 +80,9 @@ class FaultPlan:
                  error_after_write: float = 0.0, rpc_transient: float = 0.0,
                  latency_ms: float = 2.0, pattern: str = "*",
                  max_per_key: int = 2,
-                 sleep=time.sleep):
+                 blackout_tag: Optional[int] = None,
+                 blackout_s: float = 0.0, blackout_from_s: float = 0.0,
+                 sleep=time.sleep, clock=time.monotonic):
         self.seed = int(seed)
         self.rates: Dict[str, float] = {
             "transient": transient, "permanent": permanent,
@@ -77,6 +93,16 @@ class FaultPlan:
         self.latency_ms = float(latency_ms)
         self.pattern = pattern
         self.max_per_key = int(max_per_key)
+        # blackout: placement tag ``blackout_tag`` is dark for the
+        # window [blackout_from_s, blackout_from_s + blackout_s) on the
+        # plan's clock, zeroed at the FIRST decide() call — injectable
+        # clock keeps chaos suites deterministic and virtual-time fast
+        self.blackout_tag = (None if blackout_tag is None
+                             else int(blackout_tag))
+        self.blackout_s = float(blackout_s)
+        self.blackout_from_s = float(blackout_from_s)
+        self._clock = clock
+        self._t0: Optional[float] = None
         self._sleep = sleep
         self._lock = threading.Lock()
         self._occ: Dict[tuple, int] = {}      # (op, name) -> occurrences
@@ -107,9 +133,28 @@ class FaultPlan:
         # assertions lean on (cap < retry budget must stay true)
         with self._lock:
             k = self._occ[key] = self._occ.get(key, 0) + 1
-            if self._charged.get(key, 0) >= self.max_per_key:
+            if not is_rpc and not self._matches(name):
                 return None
-            if not is_rpc and not fnmatch.fnmatchcase(name, self.pattern):
+            # blackout before the per-key cap: a dark failure domain
+            # fails EVERY matched op on its tag for the window — never
+            # rate-drawn, never charged to the cap (the window is the
+            # liveness bound). It shares the pattern gate with every
+            # other kind: the name family being darkened is the plan
+            # author's scope knob (chaos legs blacking out the shuffle
+            # plane must not also take down result-file housekeeping,
+            # which no replica can absorb).
+            if self.blackout_tag is not None and op in _BLACKOUT_OPS:
+                if self._t0 is None:
+                    self._t0 = self._clock()
+                t = self._clock() - self._t0
+                if (self.blackout_from_s <= t
+                        < self.blackout_from_s + self.blackout_s):
+                    from lua_mapreduce_tpu.engine.placement import tag_of
+                    if tag_of(name) == self.blackout_tag:
+                        self.fired["blackout"] = \
+                            self.fired.get("blackout", 0) + 1
+                        return "transient"
+            if self._charged.get(key, 0) >= self.max_per_key:
                 return None
             u = self._uniform(op, name, k)
             acc = 0.0
@@ -132,6 +177,13 @@ class FaultPlan:
                     return kind
         return None
 
+    def _matches(self, name: str) -> bool:
+        """``pattern`` is ``|``-alternated globs — chaos schedules
+        addressing several name families (raw runs AND spills, say)
+        need one plan, not one per family."""
+        return any(fnmatch.fnmatchcase(name, p)
+                   for p in self.pattern.split("|"))
+
     def apply_latency(self) -> None:
         if self.latency_ms > 0:
             self._sleep(self.latency_ms / 1000.0)
@@ -151,6 +203,11 @@ class FaultPlan:
             parts.append(f"pattern={self.pattern}")
         if self.max_per_key != 2:
             parts.append(f"max_per_key={self.max_per_key}")
+        if self.blackout_tag is not None:
+            parts.append(f"blackout_tag={self.blackout_tag}")
+            parts.append(f"blackout_s={self.blackout_s:g}")
+            if self.blackout_from_s:
+                parts.append(f"blackout_from_s={self.blackout_from_s:g}")
         return ";".join(parts)
 
     @classmethod
@@ -169,9 +226,10 @@ class FaultPlan:
             k = k.strip()
             if k == "pattern":
                 kw[k] = v.strip()
-            elif k in ("seed", "max_per_key"):
+            elif k in ("seed", "max_per_key", "blackout_tag"):
                 kw[k] = int(v)
-            elif k in _KINDS or k == "latency_ms":
+            elif k in _KINDS or k in ("latency_ms", "blackout_s",
+                                      "blackout_from_s"):
                 kw[k] = float(v)
             else:
                 raise ValueError(f"unknown fault-plan key {k!r}")
@@ -215,3 +273,37 @@ def utest() -> None:
         pass
     else:
         raise AssertionError("unknown plan key must be rejected")
+
+    # pattern alternation: one plan addresses several name families
+    alt = FaultPlan(3, permanent=1.0, pattern="ns.P*.M*|ns.P*.SPILL-*",
+                    max_per_key=100)
+    assert alt.decide("lines", "ns.P0.M00000001") == "permanent"
+    assert alt.decide("lines", "ns.P0.SPILL-00000-00003") == "permanent"
+    assert alt.decide("lines", "ns.P0") is None
+
+    # blackout: one placement tag dark for a virtual-clock window —
+    # every data-plane op on that tag fails transient (no per-key cap);
+    # other tags and post-window ops are untouched
+    from lua_mapreduce_tpu.engine.placement import replica_name, tag_of
+    vt = [0.0]
+    bo = FaultPlan(4, blackout_tag=tag_of("ns.P0.M1"), blackout_s=5.0,
+                   clock=lambda: vt[0], sleep=lambda s: None)
+    dark = replica_name("other.P1.M9", 1)        # route a replica onto
+    while tag_of(dark) != bo.blackout_tag:       # the dark tag
+        dark = replica_name(dark[-1] + dark, 1)
+    for _ in range(6):                           # window, uncapped
+        assert bo.decide("read_range", "ns.P0.M1") == "transient"
+    assert bo.decide("size", dark) == "transient"
+    lit = "ns.P0.M2"
+    if tag_of(lit) == bo.blackout_tag:           # find a lit name
+        lit = next(f"ns.P0.M{i}" for i in range(3, 99)
+                   if tag_of(f"ns.P0.M{i}") != bo.blackout_tag)
+    assert bo.decide("read_range", lit) is None  # other tags lit
+    vt[0] = 5.0                                  # window over
+    assert bo.decide("read_range", "ns.P0.M1") is None
+    assert bo.fired["blackout"] == 7
+    spec2 = FaultPlan(5, blackout_tag=3, blackout_s=0.25,
+                      blackout_from_s=0.1).to_spec()
+    q2 = FaultPlan.from_spec(spec2)
+    assert (q2.blackout_tag, q2.blackout_s, q2.blackout_from_s) == \
+        (3, 0.25, 0.1)

@@ -1,0 +1,393 @@
+"""Replica-aware shuffle data plane: r-way publish, failover reads, repair.
+
+Coded MapReduce's trade (PAPERS.md): spend shuffle bytes to buy
+recovery latency. Three pieces implement it over any Store backend,
+addressed by the deterministic placement function (engine/placement.py):
+
+- :func:`spill_writer` — the replicated twin of
+  ``core.segment.writer_for``: every spill producer in engine/ goes
+  through it (lint rule LMR009), and with ``replication > 1`` the
+  returned writer TEES each chunk into ``r`` builders and publishes the
+  primary plus ``r−1`` replica copies (primary first, so a crash
+  mid-fanout leaves a readable primary and merely under-replicates).
+  No read-back: the copies are fanned from the in-flight chunks, so a
+  store whose reads are already failing can still publish whole.
+
+- :class:`ReplicatedStore` — the consumer's failover view. Every read
+  op (``lines`` / ``read_range`` / ``size`` — the v2 segment reader's
+  ranged surface included, since it calls straight through this store)
+  tries the primary and, on a CLASSIFIED storage fault (transient burst
+  that outlived the retry budget, or the copy simply gone), fails over
+  to the next replica — counted (``failover_reads``,
+  ``map_reruns_avoided``), never surfaced, never a repetition charge.
+  ``exists``/``list`` answer for the LOGICAL file (any surviving copy);
+  ``remove`` fans out to every copy. Only when every copy is
+  unreadable does :class:`LostShuffleDataError` escape — transient, so
+  the worker releases the job while the server's scavenger repairs or
+  requeues (engine/server.py, DESIGN §20). Like FaultyStore, this
+  wrapper exposes ONLY the portable Store surface: native fast paths
+  (``local_path``) cannot bypass the failover routing.
+
+- :func:`repair` — the scavenger's reconstruction primitive: copy any
+  surviving replica over the missing/unreadable copies, restoring full
+  ``r``-way redundancy without re-running the producing map job.
+
+``replication == 1`` is the identity everywhere: ``spill_writer``
+returns the plain writer, engines skip the wrapper, and not one extra
+byte or op exists — the golden r=1 byte-compares are untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Union
+
+from lua_mapreduce_tpu.engine.placement import (base_name, check_replication,
+                                                replica_names)
+from lua_mapreduce_tpu.faults.errors import (LostShuffleDataError,
+                                             classify_exception)
+from lua_mapreduce_tpu.faults.retry import COUNTERS
+from lua_mapreduce_tpu.store.base import FileBuilder, Store
+
+
+def _classifier(store):
+    """The backend's own classify hook when it has one, else the
+    central taxonomy — the same resolution the segment reader uses."""
+    return getattr(store, "classify", classify_exception)
+
+
+# --------------------------------------------------------------------------
+# write side: replicated spill publish
+# --------------------------------------------------------------------------
+
+
+class _TeeBuilder(FileBuilder):
+    """Fan every chunk into ``r`` real builders; ``build`` publishes the
+    primary name first, then each replica under its placement name.
+    Each underlying build stays atomic (tempfile+rename / object PUT),
+    so readers see whole copies or nothing; the primary-first order
+    means a crash mid-fanout under-replicates instead of losing data."""
+
+    def __init__(self, store: Store, replication: int):
+        self._r = check_replication(replication)
+        self._builders: List[FileBuilder] = []
+        self._bytes = 0
+        try:
+            for _ in range(self._r):
+                self._builders.append(store.builder())
+        except Exception:
+            self.close()        # a later builder() failed: release the
+            raise               # earlier ones' fds/tempfiles/threads
+
+    def write(self, data: str) -> None:
+        self._bytes += len(data)
+        for b in self._builders:
+            b.write(data)
+
+    def write_bytes(self, data: bytes) -> None:
+        self._bytes += len(data)
+        for b in self._builders:
+            b.write_bytes(data)
+
+    def build(self, name: str) -> None:
+        for copy_name, b in zip(replica_names(name, self._r),
+                                self._builders):
+            b.build(copy_name)
+        # write-amplification telemetry for the replication bench:
+        # primary payload once, fanout cost separately (honest overhead)
+        COUNTERS.bump("spill_bytes_primary", self._bytes)
+        COUNTERS.bump("spill_bytes_replica", self._bytes * (self._r - 1))
+
+    def close(self) -> None:
+        # every builder gets its close (fds/tempfiles/writer threads
+        # must not leak behind an earlier copy's close failure); the
+        # first error still surfaces once the sweep is done
+        first = None
+        for b in self._builders:
+            try:
+                b.close()
+            except Exception as exc:
+                if first is None:
+                    first = exc
+        if first is not None:
+            raise first
+
+
+def spill_writer(store: Store, segment_format: str = "v1",
+                 replication: int = 1, codec: str = "zlib"):
+    """The ONE factory every spill producer uses (LMR009): a
+    v1/v2 record writer whose ``build(name)`` publishes ``replication``
+    copies at the placement function's addresses. ``replication=1``
+    returns exactly ``writer_for``'s plain writer — zero overhead."""
+    from lua_mapreduce_tpu.core.segment import (SegmentWriter, TextWriter,
+                                                check_format, writer_for)
+    check_format(segment_format)
+    if check_replication(replication) == 1:
+        return writer_for(store, segment_format, codec=codec)
+    builder = _TeeBuilder(store, replication)
+    if segment_format == "v2":
+        return SegmentWriter(builder, codec=codec)
+    return TextWriter(builder)
+
+
+# --------------------------------------------------------------------------
+# read side: failover view
+# --------------------------------------------------------------------------
+
+
+class ReplicatedStore(Store):
+    """Failover view over a wrapped store: ops address LOGICAL files,
+    served from whichever of the ``r`` placement copies answers.
+
+    Per-name redirects are cached (a dead primary is not re-probed on
+    every frame of a segment read), and the first successful failover
+    of a name bumps ``failover_reads`` + ``map_reruns_avoided`` once —
+    the tail-latency events the replication bench sweeps. Unclassified
+    exceptions (user/data/logic) propagate untouched from the primary
+    attempt, exactly like the retry layer below.
+    """
+
+    def __init__(self, inner: Store, replication: int):
+        self._inner = inner
+        self._r = check_replication(replication)
+        self._redirect = {}     # logical name -> serving copy index
+        self._counted = set()   # names whose first failover was counted
+
+    # -- failover core ------------------------------------------------------
+
+    def _serve(self, op: str, name: str, fn):
+        """Run ``fn(copy_name)`` against the cached copy, failing over
+        through the remaining copies on classified storage faults."""
+        classify = _classifier(self._inner)
+        copies = replica_names(name, self._r)
+        start = self._redirect.get(name, 0)
+        last = None
+        for i in range(self._r):
+            idx = (start + i) % self._r
+            try:
+                out = fn(copies[idx])
+            except Exception as exc:
+                if classify(exc) is None:
+                    raise               # not a storage fault: never mask
+                last = exc
+                continue
+            if idx != start:
+                self._redirect[name] = idx
+            if idx != 0 and name not in self._counted:
+                self._counted.add(name)
+                COUNTERS.bump("failover_reads")
+                COUNTERS.bump("map_reruns_avoided")
+            return out
+        raise LostShuffleDataError(
+            f"{op}({name!r}): all {self._r} replica(s) unreadable "
+            f"(last: {type(last).__name__}: {last}) — scavenger repair "
+            "or map re-run required", op=op, name=name,
+            files=[name]) from last
+
+    # -- portable surface ----------------------------------------------------
+
+    def builder(self) -> FileBuilder:
+        return self._inner.builder()
+
+    def lines(self, name: str) -> Iterator[str]:
+        # prime the first record inside the failover scope (the same
+        # open-window the retry layer covers); mid-stream faults after
+        # that propagate — a silent replica restart would re-yield
+        # records the merge already consumed
+        def open_primed(copy_name):
+            it = iter(self._inner.lines(copy_name))
+            try:
+                return next(it), it
+            except StopIteration:
+                return None, None
+
+        first, it = self._serve("lines", name, open_primed)
+        if it is None:
+            return
+        yield first
+        yield from it
+
+    def read_range(self, name: str, offset: int, length: int) -> bytes:
+        return self._serve(
+            "read_range", name,
+            lambda n: self._inner.read_range(n, offset, length))
+
+    def size(self, name: str) -> int:
+        return self._serve("size", name, lambda n: self._inner.size(n))
+
+    def exists(self, name: str) -> bool:
+        classify = _classifier(self._inner)
+        for copy_name in replica_names(name, self._r):
+            try:
+                if self._inner.exists(copy_name):
+                    return True
+            except Exception as exc:
+                if classify(exc) is None:
+                    raise
+        return False
+
+    def list(self, pattern: str) -> List[str]:
+        from lua_mapreduce_tpu.engine.placement import replica_pattern
+        names = set(self._inner.list(pattern))
+        # a lost primary stays VISIBLE while any replica survives — the
+        # reduce pull-integrity check must not report a recoverable
+        # file as missing
+        names.update(base_name(n)
+                     for n in self._inner.list(replica_pattern(pattern)))
+        return sorted(names)
+
+    def remove(self, name: str) -> None:
+        # cleanup fans out to every copy; per-copy storage faults are
+        # swallowed (best-effort sweep — the iteration-start cleanup
+        # and the consumed-leftover sweeps catch stragglers)
+        classify = _classifier(self._inner)
+        for copy_name in replica_names(name, self._r):
+            try:
+                self._inner.remove(copy_name)
+            except Exception as exc:
+                if classify(exc) is None:
+                    raise
+
+    def classify(self, exc: BaseException):
+        return self._inner.classify(exc)
+
+
+def reading_view(store: Store, replication: int) -> Store:
+    """The engines' wrap point: the failover view when replication is
+    on, the store itself (identity — zero overhead) when it is not."""
+    if check_replication(replication) <= 1:
+        return store
+    if isinstance(store, ReplicatedStore):
+        return store
+    return ReplicatedStore(store, replication)
+
+
+# --------------------------------------------------------------------------
+# scavenger reconstruction
+# --------------------------------------------------------------------------
+
+
+def repair(store: Store, name: str, replication: int) -> str:
+    """Restore full ``r``-way redundancy of ``name`` from any readable
+    copy — the scavenger's cheap alternative to re-running the
+    producing map job.
+
+    Returns ``"intact"`` (every copy already readable and whole),
+    ``"repaired"`` (at least one copy rebuilt from a survivor),
+    ``"degraded"`` (a survivor is readable but every rebuild write
+    failed — reads still fail over, a later scavenge pass retries the
+    heal), or ``"lost"`` (NO copy readable — only then does the caller
+    escalate to map re-run). ``store`` is the plain wrapped store (copies addressed
+    individually, never through the failover view). Copies are whole
+    by construction (atomic publishes + readback-verify below), so the
+    first readable copy is trusted as the source; copies whose size
+    disagrees with it are rebuilt too."""
+    classify = _classifier(store)
+    copies = replica_names(name, check_replication(replication))
+    data = None
+    whole = set()
+    for copy_name in copies:
+        try:
+            sz = store.size(copy_name)
+            blob = store.read_range(copy_name, 0, sz)
+        except Exception as exc:
+            if classify(exc) is None:
+                raise
+            continue
+        if data is None and len(blob) == sz:
+            data = blob
+        if data is not None and blob == data:
+            whole.add(copy_name)
+    if data is None:
+        return "lost"
+    if len(whole) == len(copies):
+        return "intact"
+    rebuilt = 0
+    for copy_name in copies:
+        if copy_name in whole:
+            continue
+        try:
+            with store.builder() as b:
+                b.write_bytes(data)
+                b.build(copy_name)
+            rebuilt += 1
+        except Exception as exc:
+            if classify(exc) is None:
+                raise
+            # this copy's target is still failing: partial repair —
+            # redundancy improved where the store allowed it
+    if rebuilt:
+        COUNTERS.bump("replica_repairs", rebuilt)
+        COUNTERS.bump("map_reruns_avoided")
+    # a readable survivor means the data is NOT lost even when every
+    # rebuild write failed (the targets are still dark): failover
+    # reads keep serving it, and escalating to a map re-run here would
+    # pay the exact cost this layer exists to avoid
+    return "repaired" if rebuilt else "degraded"
+
+
+def utest() -> None:
+    """Self-test: tee publish fanout, failover reads + counting, the
+    logical exists/list/remove surface, repair, and the r=1 identity."""
+    from lua_mapreduce_tpu.core.segment import writer_for
+    from lua_mapreduce_tpu.store.memfs import MemStore
+
+    raw = MemStore()
+    # r=1 identity: spill_writer IS writer_for's plain writer shape
+    w1 = spill_writer(raw, "v1", 1)
+    assert type(w1) is type(writer_for(raw, "v1"))
+    w1.close()
+
+    # r=3 publish lands 3 byte-identical copies at the placement names
+    with spill_writer(raw, "v1", 3) as w:
+        w.add("k", [1, 2])
+        w.build("ns.P0.M00000001")
+    copies = replica_names("ns.P0.M00000001", 3)
+    blobs = [raw.read_range(n, 0, raw.size(n)) for n in copies]
+    assert len(set(blobs)) == 1 and blobs[0]
+    assert raw.list("ns.P*") == ["ns.P0.M00000001"]   # globs see primary
+
+    # failover: primary destroyed -> reads serve the replica, counted
+    before = COUNTERS.snapshot().get("failover_reads", 0)
+    raw._files.pop("ns.P0.M00000001")
+    view = reading_view(raw, 3)
+    assert view.exists("ns.P0.M00000001")
+    assert list(view.lines("ns.P0.M00000001")) == ['["k",[1,2]]\n']
+    assert view.size("ns.P0.M00000001") == len(blobs[0])
+    assert view.list("ns.P*") == ["ns.P0.M00000001"]  # logical listing
+    assert COUNTERS.snapshot()["failover_reads"] == before + 1  # once/name
+
+    # repair rebuilds the missing primary from a survivor
+    assert repair(raw, "ns.P0.M00000001", 3) == "repaired"
+    assert raw.read_range("ns.P0.M00000001", 0, 99) == blobs[0][:99]
+    assert repair(raw, "ns.P0.M00000001", 3) == "intact"
+
+    # a readable survivor + every rebuild target dark -> "degraded",
+    # NOT "lost": the scavenger must not escalate to a map re-run
+    # while failover reads can still serve the file
+    class _DarkBuilders(MemStore):
+        def builder(self):
+            raise OSError(5, "brownout")        # EIO: transient
+    dark = _DarkBuilders()
+    for k, copy_name in enumerate(replica_names("ns.P1.M00000001", 2)):
+        b = MemStore.builder(dark)              # publish past the dark
+        b.write('["k",[3]]\n')                  # override: both copies
+        b.build(copy_name)                      # land whole
+    dark._files.pop("ns.P1.M00000001")          # primary destroyed
+    assert repair(dark, "ns.P1.M00000001", 2) == "degraded"
+    assert list(reading_view(dark, 2).lines("ns.P1.M00000001")) \
+        == ['["k",[3]]\n']
+
+    # remove fans out to every copy; total loss raises the classified
+    # transient that releases (never breaks) the consuming job
+    view.remove("ns.P0.M00000001")
+    assert all(not raw.exists(n) for n in copies)
+    assert repair(raw, "ns.P0.M00000001", 3) == "lost"
+    try:
+        list(view.lines("ns.P0.M00000001"))
+    except LostShuffleDataError as e:
+        assert e.transient and e.lost_files == ["ns.P0.M00000001"]
+    else:
+        raise AssertionError("total loss must raise LostShuffleDataError")
+
+    assert reading_view(raw, 1) is raw                # identity when off
+    assert not hasattr(reading_view(raw, 2), "local_path")

@@ -90,10 +90,27 @@ class IterationStats:
     #   degraded_reads — ranged segment reads that fell back to a
     #                    whole-file read (the degradation ladder's
     #                    read-side rung)
+    # replica-aware shuffle accounting (DESIGN §20), same fold:
+    #   failover_reads     — shuffle files served from a non-primary
+    #                        replica after the primary failed
+    #   replica_repairs    — replica copies rebuilt from a survivor by
+    #                        the scavenger (under-replication healed
+    #                        without re-running the producer)
+    #   map_reruns_avoided — map re-executions the replication layer
+    #                        made unnecessary (one per failed-over or
+    #                        repaired file); the chaos gate asserts the
+    #                        companion map_reruns stays ZERO while this
+    #                        climbs
+    #   map_reruns         — last-resort producer requeues (every
+    #                        replica of a file gone)
     store_retries: int = 0
     store_faults: int = 0
     infra_releases: int = 0
     degraded_reads: int = 0
+    failover_reads: int = 0
+    replica_repairs: int = 0
+    map_reruns_avoided: int = 0
+    map_reruns: int = 0
 
     @property
     def cluster_time(self) -> float:
@@ -116,6 +133,10 @@ class IterationStats:
             "store_faults": self.store_faults,
             "infra_releases": self.infra_releases,
             "degraded_reads": self.degraded_reads,
+            "failover_reads": self.failover_reads,
+            "replica_repairs": self.replica_repairs,
+            "map_reruns_avoided": self.map_reruns_avoided,
+            "map_reruns": self.map_reruns,
             "cluster_time": self.cluster_time,
             "wall_time": self.wall_time,
         }

@@ -25,11 +25,19 @@ echo "segment conformance: python + native merge engines agree"
 # retry/degradation layer must make injected transient faults invisible
 python -m pytest tests/test_chaos.py -q -k "smoke"
 echo "chaos smoke: injected faults invisible on all three backends"
+# replication chaos-smoke gate (DESIGN §20): every primary replica
+# destroyed mid-run — the failover reads + scavenger reconstruction
+# must deliver byte-identical output with ZERO map re-runs
+python -m pytest tests/test_chaos.py -q -k "replication" \
+    --deselect tests/test_chaos.py::test_replication_chaos_distributed_matrix
+echo "replication smoke: r-1 replica kills absorbed with zero map re-runs"
 # lmr-analyze gate: the framework-aware lint pass must be clean against
 # the checked-in suppression baseline (analysis/baseline.json — shipped
-# EMPTY), and the lease-protocol model checker must exhaustively pass
-# the 2-worker lifecycle (worker death included) while re-finding both
-# seeded races. Machine output: add --format json.
+# EMPTY; LMR009 keeps every engine spill publish on the replication
+# helper), and the lease-protocol model checker must exhaustively pass
+# the 2-worker lifecycle (worker death included) AND the
+# replica-recovery (reconstruct-vs-requeue) edge while re-finding all
+# four seeded races. Machine output: add --format json.
 python -m lua_mapreduce_tpu.analysis --fail-on-findings
 echo "lmr-analyze: lint clean + lease protocol model-checked"
 python -m pytest tests/ -q --full

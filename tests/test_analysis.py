@@ -77,7 +77,9 @@ def test_lmr001_clean_patterns_pass(tmp_path):
         def wrapped(store):
             consume(SegmentWriter(store.builder()))
         """)
-    assert got == []
+    # writer_for/SegmentWriter in engine/ now also trip LMR009 (the
+    # replication-helper rule) — this fixture pins LMR001 only
+    assert [f for f in got if f.rule == "LMR001"] == []
 
 
 # --- LMR002 index-flock IO -------------------------------------------------
@@ -311,6 +313,58 @@ def test_lmr008_scoped_to_store_and_coord(tmp_path):
     assert all(f.rule != "LMR008" for f in got)
 
 
+# --- LMR009 replicated spill publishes --------------------------------------
+
+def test_lmr009_raw_spill_writers_in_engine_flagged(tmp_path):
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        from lua_mapreduce_tpu.core.segment import writer_for
+
+        def run_map(store, fmt):
+            w = writer_for(store, fmt)
+            try:
+                w.add("k", [1])
+                w.build("ns.P0.M1")
+            finally:
+                w.close()
+
+        def run_premerge(builder):
+            w = SegmentWriter(builder, codec="zlib")
+            try:
+                w.build("ns.P0.SPILL-0-1")
+            finally:
+                w.close()
+        """)
+    assert [f.rule for f in got] == ["LMR009", "LMR009"]
+    assert "spill_writer" in got[0].message
+
+
+def test_lmr009_replication_helper_and_other_paths_pass(tmp_path):
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        from lua_mapreduce_tpu.faults.replicate import spill_writer
+
+        def run_map(store, fmt, r):
+            w = spill_writer(store, fmt, r)
+            try:
+                w.add("k", [1])
+                w.build("ns.P0.M1")
+            finally:
+                w.close()
+
+        def publish_result(store, name):
+            # results are deliberately unreplicated: plain builder is fine
+            with store.builder() as b:
+                b.write("x\\t[1]\\n")
+                b.build(name)
+        """)
+    assert [f.rule for f in got] == []
+    # the factory's own home (core/) and tests are out of scope
+    got = _lint_snippet(tmp_path, "core/fx.py", """\
+        def writer_for(store, fmt):
+            return TextWriter(store.builder())
+        """)
+    assert all(f.rule != "LMR009" for f in got)
+
+
 # --- LMR007 jax purity -----------------------------------------------------
 
 def test_lmr007_impure_traced_functions_flagged(tmp_path):
@@ -391,7 +445,7 @@ def test_shipped_baseline_is_empty():
 
 def test_rule_catalog_complete():
     rules = lint_mod.all_rules()
-    assert [r.id for r in rules] == [f"LMR00{i}" for i in range(1, 9)]
+    assert [r.id for r in rules] == [f"LMR00{i}" for i in range(1, 10)]
     for r in rules:
         assert r.title and r.rationale and r.severity in ("error", "warning")
 
@@ -506,6 +560,82 @@ def test_replay_reproduces_correct_traces(tmp_path, make_store):
         rep = proto.replay_trace(make_store(tmp_path), trace, cfg,
                                  final_state=final, ns=f"ns{i}")
         assert rep["ok"], rep
+
+
+def test_protocol_replica_recovery_edge_exhaustive():
+    """The reconstruct-vs-requeue scavenge edge (DESIGN §20): budgeted
+    data-loss events, replica repair, and the lost-data WRITTEN→WAITING
+    requeue keep the FULL invariant set — including the new
+    zero-repetition-charge and no-stranded-data rules."""
+    for cfg in (proto.ModelConfig(n_workers=1, n_jobs=2, batch_k=2,
+                                  data_loss_budget=2),
+                proto.ModelConfig(n_workers=2, n_jobs=2, batch_k=1,
+                                  data_loss_budget=1)):
+        res = proto.check_protocol(cfg)
+        assert res.ok, res.violation.message
+        assert res.quiescent > 0
+
+
+def test_protocol_finds_scavenger_that_never_requeues_lost_data():
+    cfg = proto.ModelConfig(n_workers=1, n_jobs=2, batch_k=1,
+                            data_loss_budget=1,
+                            bug="scavenge_skips_lost_data")
+    res = proto.check_protocol(cfg, max_states=200_000)
+    assert not res.ok
+    assert "stranded" in res.violation.message
+    assert any(t[0] == "lose_all" for t in res.violation.trace)
+
+
+def test_protocol_finds_lost_requeue_without_written_cas():
+    """Dropping the expect=(WRITTEN,) CAS from the lost-data requeue
+    lets the scavenger yank a job out of another worker's commit —
+    caught as an illegal FINISHED→WAITING edge, and the real stores
+    refuse the same step on replay (the CAS Server._requeue_maps
+    carries)."""
+    cfg = proto.ModelConfig(n_workers=2, n_jobs=2, batch_k=1,
+                            data_loss_budget=2,
+                            bug="lost_requeue_skips_written_cas")
+    res = proto.check_protocol(cfg, max_states=400_000)
+    assert not res.ok
+    assert "illegal status edge" in res.violation.message
+    assert res.violation.trace[-1][0] == "rerun_requeue"
+
+
+@pytest.mark.parametrize("make_store", [
+    lambda tmp: MemJobStore(),
+    lambda tmp: FileJobStore(str(tmp / "js"), engine="python"),
+], ids=["mem", "file-py"])
+def test_replay_lost_data_requeue_on_real_stores(tmp_path, make_store):
+    """A correct-model trace through loss → requeue → re-run replays
+    step-for-step on the real stores: the WRITTEN→WAITING CAS lands,
+    the re-claimed job commits again, and the final per-job state
+    matches the model."""
+    from lua_mapreduce_tpu.core.constants import Status
+
+    cfg = proto.ModelConfig(n_workers=1, n_jobs=1, batch_k=1,
+                            data_loss_budget=1, allow_death=False)
+    model = proto.LeaseModel(cfg)
+    init = model.initial()
+    visited = {init: []}
+    frontier = [init]
+    picked = None
+    while frontier:
+        state = frontier.pop()
+        trace = visited[state]
+        ops = [t[0] for t in trace]
+        if "rerun_requeue" in ops and "lose_all" in ops:
+            jobs = state[0]
+            if all(s == int(Status.WRITTEN) for s, *_ in jobs):
+                picked = (trace, state)
+                break
+        for label, new in model.transitions(state):
+            if new not in visited:
+                visited[new] = trace + [label]
+                frontier.append(new)
+    assert picked, "no loss→requeue→recommit trace reachable"
+    rep = proto.replay_trace(make_store(tmp_path), picked[0], cfg,
+                             final_state=picked[1])
+    assert rep["ok"], rep
 
 
 def test_model_rejects_oversize_and_unknown_bug():

@@ -506,3 +506,216 @@ def test_stats_fold_fault_counters():
     d = it.as_dict()
     assert {"store_retries", "store_faults", "infra_releases",
             "degraded_reads"} <= set(d)
+
+
+# --- RetryingStore.lines mid-stream contract (ISSUE 6 satellite) ------------
+
+class _MidStreamFlakyStore:
+    """lines() raises a transient fault BEFORE the first record on the
+    first open, then — once reopened — dies again after yielding two
+    records: the connection-drop-mid-scan shape. Tracks opens so the
+    no-silent-reopen contract is assertable."""
+
+    def __init__(self, inner, records):
+        self._inner = inner
+        self.records = records
+        self.opens = 0
+
+    def lines(self, name):
+        self.opens += 1
+        if self.opens == 1:
+            raise TransientStoreError("dropped at open")
+        for i, rec in enumerate(self.records):
+            if self.opens == 2 and i == 2:
+                raise TransientStoreError("dropped mid-stream")
+            yield rec
+
+    def classify(self, exc):
+        return self._inner.classify(exc)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+def test_retrying_lines_mid_stream_fault_propagates():
+    """Pins the documented lines() retry scope: the OPEN + FIRST record
+    ride the retry policy (a fault there is re-opened transparently),
+    but a fault AFTER records were yielded downstream must propagate —
+    a silent re-open would re-yield records the merge already consumed,
+    duplicating data. The consumer-side recovery for the mid-stream
+    shape is the job-level release (worker) + scavenger repair ladder,
+    not a stream restart."""
+    flaky = _MidStreamFlakyStore(MemStore(), [f"r{i}\n" for i in range(5)])
+    store = RetryingStore(flaky, _policy())
+    it = iter(store.lines("f"))
+    assert next(it) == "r0\n"
+    assert flaky.opens == 2               # open-fault was retried once
+    assert next(it) == "r1\n"
+    with pytest.raises(TransientStoreError, match="mid-stream"):
+        next(it)
+    assert flaky.opens == 2               # and NEVER silently reopened
+
+
+def test_replicated_lines_mid_stream_fault_propagates():
+    """The failover view keeps the same mid-stream contract: replica
+    failover happens at open/first-record only — once records flowed, a
+    fault propagates rather than restarting the stream on another copy
+    (which would duplicate consumed records)."""
+    from lua_mapreduce_tpu.faults.replicate import ReplicatedStore
+
+    flaky = _MidStreamFlakyStore(MemStore(), [f"r{i}\n" for i in range(5)])
+    store = ReplicatedStore(flaky, 2)
+    it = iter(store.lines("f"))
+    assert next(it) == "r0\n"             # first-record fault failed over
+    with pytest.raises(TransientStoreError, match="mid-stream"):
+        for _ in it:
+            pass
+    assert flaky.opens == 2               # no third-copy stream restart
+
+
+# --- replica-aware shuffle (DESIGN §20) -------------------------------------
+
+def test_worker_releases_reduce_on_total_replica_loss():
+    """Every copy of a reduce input gone: the job is RELEASED (WAITING,
+    zero repetition charge — the loss is not the job's fault) and the
+    errors-stream entry names the lost files, the hook the server's
+    scavenger repairs or requeues on."""
+    from lua_mapreduce_tpu.faults.errors import LostShuffleDataError
+
+    store = MemJobStore()
+    w = Worker(store, name="wloss")
+    w.heartbeat_s = 0
+    w.configure(replication=2)
+    spec = _spec(lambda key, value, emit: emit("k", 1), "wloss")
+    files = ["result.P0.M00000000", "result.P0.M00000001"]
+    store.insert_jobs("red_jobs", [make_job(0, {
+        "part": 0, "files": files, "result": "result.P0", "mappers": []})])
+    jobs = w.store.claim_batch("red_jobs", "wloss", 1)
+    assert jobs
+    with pytest.raises(LostShuffleDataError):
+        w._execute_batch(spec, "red_jobs", jobs)
+    d = store.get_job("red_jobs", 0)
+    assert d["status"] == Status.WAITING and d["repetitions"] == 0
+    (err,) = store.drain_errors()
+    assert err["classification"] == "infra-transient"
+    assert err["lost_files"] == files
+
+
+def _recovery_server(tag, replication=2, n_maps=2):
+    """A Server wired for scavenge-path unit tests: spec + data store
+    bound (what loop() does), map jobs inserted and WRITTEN."""
+    from lua_mapreduce_tpu.engine.server import Server
+
+    store = MemJobStore()
+    spec = _spec(lambda key, value, emit: emit("k", 1), tag)
+    srv = Server(store, replication=replication)
+    srv.spec = spec               # what configure()+loop() bind, without
+    srv._data_store = None        # requiring module-path functions
+    srv._data_store = get_storage_from(spec.storage)
+    store.insert_jobs("map_jobs", [make_job(i, i) for i in range(n_maps)])
+    for jid in range(n_maps):
+        assert store.set_job_status("map_jobs", jid, Status.RUNNING)
+        assert store.set_job_status("map_jobs", jid, Status.WRITTEN)
+    return srv, store
+
+
+def _publish(store, name, replication, payload="x\t[1]\n"):
+    from lua_mapreduce_tpu.faults.replicate import spill_writer
+
+    with spill_writer(store, "v1", replication) as wtr:
+        wtr.add("x", [1])
+        wtr.build(name)
+
+
+def test_scavenger_repairs_under_replicated_file():
+    """Rung 3 of the failover ladder: a lost copy with a survivor is
+    REBUILT by the scavenger (counted replica_repairs) — no job state
+    touched, no map re-run."""
+    from lua_mapreduce_tpu.engine.placement import replica_name
+
+    srv, store = _recovery_server("scav-repair")
+    raw = srv._data_store
+    name = "result.P0.M00000000"
+    _publish(raw, name, 2)
+    golden = raw.read_range(name, 0, raw.size(name))
+    raw.remove(name)                      # primary lost, replica survives
+    before = COUNTERS.snapshot().get("replica_repairs", 0)
+    srv._recover_lost([name])
+    assert raw.read_range(name, 0, 99) == golden[:99]   # primary rebuilt
+    assert raw.exists(replica_name(name, 1))
+    assert COUNTERS.snapshot()["replica_repairs"] == before + 1
+    d = store.get_job("map_jobs", 0)
+    assert d["status"] == Status.WRITTEN  # producer untouched
+
+
+def test_scavenger_requeues_producer_on_total_loss():
+    """Rung 4 (last resort): every copy gone — the producing map job is
+    CAS-requeued WRITTEN→WAITING with no repetition charge, counted
+    map_reruns, and the errors stream distinguishes the requeue as
+    spill-lost-requeue (the ISSUE 6 diagnostics satellite)."""
+    srv, store = _recovery_server("scav-requeue")
+    name = "result.P0.M00000001"          # produced by map job 1
+    before = COUNTERS.snapshot().get("map_reruns", 0)
+    srv._recover_lost([name])             # no copy was ever published
+    assert store.get_job("map_jobs", 1)["status"] == Status.WAITING
+    assert store.get_job("map_jobs", 1)["repetitions"] == 0
+    assert store.get_job("map_jobs", 0)["status"] == Status.WRITTEN
+    assert COUNTERS.snapshot()["map_reruns"] == before + 1
+    (err,) = store.drain_errors()
+    assert err["classification"] == "spill-lost-requeue"
+    assert err["job_id"] == 1
+
+
+def test_scavenger_republishes_premerge_for_lost_spill():
+    """A lost SPILL requeues every covering producer and, once they all
+    re-land, republishes the pre-merge job so the retrying reduce finds
+    its spill again — the pipelined half of the reconstruction path."""
+    from lua_mapreduce_tpu.engine.premerge import spill_name
+
+    srv, store = _recovery_server("scav-spill")
+    raw = srv._data_store
+    spill = spill_name("result", 0, 0, 1)     # covers map keys 0..1
+    srv._recover_lost([spill])                # all copies gone
+    for jid in range(2):
+        assert store.get_job("map_jobs", jid)["status"] == Status.WAITING
+    assert srv._spill_repairs == {spill: (0, 0, 1)}
+
+    # producers re-ran: runs are back, statuses WRITTEN again
+    for jid in range(2):
+        _publish(raw, f"result.P0.M{jid:08d}", 2)
+        assert store.set_job_status("map_jobs", jid, Status.RUNNING)
+        assert store.set_job_status("map_jobs", jid, Status.WRITTEN)
+    srv._settle_spill_repairs()
+    assert srv._spill_repairs == {}
+    (job,) = store.jobs("pre_jobs")
+    assert job["value"]["spill"] == spill
+    assert job["value"]["files"] == ["result.P0.M00000000",
+                                     "result.P0.M00000001"]
+
+
+def test_blackout_dark_tag_absorbed_by_replication():
+    """The blackout kind × the placement function: every op on ONE
+    placement tag fails transient for the window — with r=2 the copies
+    live on two different tags, so the failover view serves every read
+    from the lit tag and the blackout is invisible to consumers."""
+    from lua_mapreduce_tpu.engine.placement import replica_name, tag_of
+    from lua_mapreduce_tpu.faults.replicate import ReplicatedStore
+
+    raw = MemStore()
+    name = "result.P0.M00000007"
+    _publish(raw, name, 2)
+    vt = [0.0]
+    plan = FaultPlan(9, blackout_tag=tag_of(name), blackout_s=60.0,
+                     clock=lambda: vt[0], sleep=lambda s: None,
+                     latency_ms=0)
+    view = ReplicatedStore(FaultyStore(raw, plan), 2)
+    rec = list(raw.lines(name))
+    # dark window: primary's tag fails every op, replica serves
+    assert list(view.lines(name)) == rec
+    assert view.exists(name) and view.size(name) == raw.size(name)
+    assert plan.fired.get("blackout", 0) > 0
+    vt[0] = 61.0                          # window over: tag back, and
+    fired = plan.fired["blackout"]        # the plan goes quiet
+    assert list(view.lines(name)) == rec
+    assert plan.fired["blackout"] == fired
+    assert tag_of(replica_name(name, 1)) != tag_of(name)
