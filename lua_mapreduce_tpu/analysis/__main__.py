@@ -45,6 +45,15 @@ def _protocol_suite(args):
     runs.append(("replica-recovery", dataclasses.replace(
         base, n_jobs=2, batch_k=min(args.batch_k, 2),
         data_loss_budget=2)))
+    # the duplicate-lease edge (DESIGN §21): speculate / claim_spec /
+    # racing commits / revoke, exhaustively with worker death — PINNED
+    # to a 2-worker 2-job box (~377k states; the spec dimension
+    # multiplies the space, so the lifecycle box above stays spec-free,
+    # and at 2 workers the model's tag-free claim_spec scan order
+    # matches both engines exactly, keeping violation traces replayable)
+    runs.append(("speculation", dataclasses.replace(
+        base, n_workers=2, n_jobs=2,
+        batch_k=min(args.batch_k, 2), allow_spec=True)))
     if args.seed_bug:
         bugs = [args.seed_bug]
     else:
@@ -63,13 +72,19 @@ def _protocol_suite(args):
             failed = True
         out.append(entry)
     for bug in bugs:
-        cfg = dataclasses.replace(
-            base, bug=bug,
+        extra = {}
+        if bug in proto_mod.LOSS_BUGS:
             # loss-edge bugs are unreachable without loss events; the
             # smaller box keeps the seeded sweep fast
-            **(dict(n_jobs=2, batch_k=min(args.batch_k, 2),
-                    data_loss_budget=2)
-               if bug in proto_mod.LOSS_BUGS else {}))
+            extra = dict(n_jobs=2, batch_k=min(args.batch_k, 2),
+                         data_loss_budget=2)
+        elif bug in proto_mod.SPEC_BUGS:
+            # spec-edge bugs need the duplicate-lease dimension and a
+            # second worker to hold the shadow lease (pinned to 2 for
+            # trace replayability, like the exhaustive run)
+            extra = dict(n_workers=2, n_jobs=2,
+                         batch_k=min(args.batch_k, 2), allow_spec=True)
+        cfg = dataclasses.replace(base, bug=bug, **extra)
         res = proto_mod.check_protocol(cfg)
         entry = {"run": f"seeded:{bug}", "states": res.states,
                  "wall_s": round(res.wall_s, 3),

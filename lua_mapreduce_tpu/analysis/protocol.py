@@ -61,6 +61,26 @@ rule (quiescent with a live worker ⇒ no WRITTEN job whose output is
 wholly lost — the reduce phase would wedge on it) and the
 zero-charge rule on the requeue edge itself.
 
+**Speculative execution (DESIGN §21).** With
+``ModelConfig(allow_spec=True)`` each job record carries its
+duplicate-lease state (none / OPEN / taken-by-worker-w) and the system
+gains the speculation edges, op-for-op with the shipped protocol: the
+detector's ``speculate`` (RUNNING ∧ no-speculation → OPEN — a pure
+marker, no status or repetition change), an idle worker's
+``claim_spec`` (OPEN → taken, never the job's own claimant, lowest id
+first — the same scan order as both index engines), the clone's body +
+two-step commit racing the original's (ownership satisfied by EITHER
+the claimant or the shadow holder; the status CAS arbitrates
+first-commit-wins, so the loser's commit fails and degrades to
+``spec_cancel`` — a pure shadow-lease dissolution), the clone's
+revocation/failure edge (``spec_cancel`` from any clone stage), and
+shadow-lease dissolution on every unlease transition (release,
+requeue, mark-broken — a re-claimed job must never be committable by a
+stale clone). The full invariant set rides along unchanged; the ones
+speculation exists to threaten — no-double-commit and
+reps-monotone — are checked on every interleaving of original vs
+clone commit, death at any step included.
+
 Seedable bugs (``ModelConfig(bug=...)``):
 
 - ``"commit_skips_owner_cas"`` — commit checks status but not
@@ -77,7 +97,12 @@ Seedable bugs (``ModelConfig(bug=...)``):
 - ``"lost_requeue_skips_written_cas"`` — the lost-data requeue fires
   without the expect=(WRITTEN,) status CAS: it can yank a job another
   worker is mid-commit on (the real ``Server._requeue_maps`` carries
-  exactly that CAS; requires ``data_loss_budget > 0``).
+  exactly that CAS; requires ``data_loss_budget > 0``);
+- ``"spec_commit_skips_winner_cas"`` — the loser's commit skips the
+  winner's status CAS: a clone (or original) that lost the
+  first-commit-wins race lands its commit anyway — the double-commit /
+  illegal-WRITTEN-edge shape the one-transition arbitration exists to
+  prevent (requires ``allow_spec=True``).
 """
 
 from __future__ import annotations
@@ -105,10 +130,23 @@ _ALLOWED_EDGES = {
 }
 
 KNOWN_BUGS = ("commit_skips_owner_cas", "requeue_ignores_finished",
-              "scavenge_skips_lost_data", "lost_requeue_skips_written_cas")
+              "scavenge_skips_lost_data", "lost_requeue_skips_written_cas",
+              "spec_commit_skips_winner_cas")
 
 # bugs living on the replica-recovery edge need loss events to surface
 LOSS_BUGS = ("scavenge_skips_lost_data", "lost_requeue_skips_written_cas")
+
+# bugs living on the duplicate-lease edge need speculation enabled
+SPEC_BUGS = ("spec_commit_skips_winner_cas",)
+
+# job spec-lease state: none / OPEN / taken-by-worker-w (w = value - 10)
+_SP_NONE = 0
+_SP_OPEN = 1
+_SP_TAKEN0 = 10     # taken by worker w encodes as _SP_TAKEN0 + w
+
+# labels that must be state-transparent on the job (no status or
+# repetition change) — the zero-charge rule of the speculation edges
+_SPEC_PURE_OPS = frozenset({"speculate", "claim_spec", "spec_cancel"})
 
 # replica-set state of a job's published output
 _D_LOST = 0      # every copy gone — only a producer re-run regenerates
@@ -129,6 +167,7 @@ class ModelConfig:
     allow_death: bool = True
     allow_fail: bool = False
     data_loss_budget: int = 0
+    allow_spec: bool = False
     bug: Optional[str] = None
 
     def __post_init__(self):
@@ -152,13 +191,23 @@ class ModelConfig:
             raise ValueError(f"bug {self.bug!r} lives on the "
                              "replica-recovery edge: it needs "
                              "data_loss_budget ≥ 1 to be reachable")
+        if self.bug in SPEC_BUGS and not self.allow_spec:
+            raise ValueError(f"bug {self.bug!r} lives on the "
+                             "duplicate-lease edge: it needs "
+                             "allow_spec=True to be reachable")
+        if self.allow_spec and self.n_workers < 2:
+            raise ValueError("allow_spec needs ≥ 2 workers: a shadow "
+                             "lease is never taken by the job's own "
+                             "claimant, so a 1-worker box has no "
+                             "reachable speculation edge")
 
 
-# Job record: (status, reps, owner, age, data).  owner is 0 (none) or
-# worker-index+1; age counts virtual ticks since the last liveness
-# signal and saturates at stale_age; data is the replica-set state of
-# the job's published output (_D_INTACT until a budgeted loss event,
-# restored by repair or by the re-run's commit).  State:
+# Job record: (status, reps, owner, age, data, spec).  owner is 0
+# (none) or worker-index+1; age counts virtual ticks since the last
+# liveness signal and saturates at stale_age; data is the replica-set
+# state of the job's published output (_D_INTACT until a budgeted loss
+# event, restored by repair or by the re-run's commit); spec is the
+# duplicate-lease state (_SP_NONE | _SP_OPEN | _SP_TAKEN0 + w).  State:
 # (jobs, workers, commits, loss_budget).  Worker modes:
 #   ("I",)                                       idle (polling)
 #   ("D",)                                       dead
@@ -166,6 +215,8 @@ class ModelConfig:
 #   ("C", leased, entries, i, phase, tail, brk)  committing entry i
 #   ("L", leased, tail, brk)                     releasing unstarted tail
 #   ("K", leased, brk)                           marking the failed job
+#   ("SR", j)                                    executing a clone body
+#   ("SC", j, phase)                             clone committing (2-step)
 # brk is the failing job id (failure path) or -1 (clean commit).
 
 _IDLE = ("I",)
@@ -201,7 +252,7 @@ class LeaseModel:
         self._rep_cap = config.max_retries + 1   # saturate: finite space
 
     def initial(self) -> tuple:
-        jobs = tuple((_WAIT, 0, 0, 0, _D_INTACT)
+        jobs = tuple((_WAIT, 0, 0, 0, _D_INTACT, _SP_NONE)
                      for _ in range(self.cfg.n_jobs))
         workers = tuple(_IDLE for _ in range(self.cfg.n_workers))
         commits = (0,) * self.cfg.n_jobs
@@ -241,11 +292,28 @@ class LeaseModel:
                 if take:
                     nj = list(jobs)
                     for j in take:
-                        s, r, _, _, d = nj[j]
-                        nj[j] = (_RUN, r, w + 1, 0, d)
+                        s, r, _, _, d, _ = nj[j]
+                        # fresh claim resets any carried shadow lease,
+                        # mirroring both index engines
+                        nj[j] = (_RUN, r, w + 1, 0, d, _SP_NONE)
                     out.append((("claim", w, take),
                                 repl_w(w, ("R", take, 0, ()),
                                        tuple(nj))))
+                elif cfg.allow_spec:
+                    # only a worker with NOTHING claimable probes for a
+                    # shadow lease (Worker.poll_once's gating); lowest
+                    # open id first — the engines' scan order inside a
+                    # preference class (the model has no placement
+                    # tags, so traces replay exactly on the 2-worker
+                    # boxes the gate pins). Never the worker's own job.
+                    open_ids = [j for j, rec in enumerate(jobs)
+                                if rec[0] == _RUN and rec[5] == _SP_OPEN
+                                and rec[2] != w + 1]
+                    for j in open_ids[:1]:
+                        s, r, o, a, d, _ = jobs[j]
+                        nj = repl_job(j, (s, r, o, a, d, _SP_TAKEN0 + w))
+                        out.append((("claim_spec", w, j),
+                                    repl_w(w, ("SR", j), nj)))
             elif kind == "R":
                 _, leased, pos, done = mode
                 j = leased[pos]
@@ -260,12 +328,12 @@ class LeaseModel:
             elif kind == "C":
                 _, leased, entries, i, phase, tail, brk = mode
                 j = entries[i]
-                s, r, o, a, d = jobs[j]
+                s, r, o, a, d, sp = jobs[j]
                 owner_ok = (o == w + 1) or \
                     (cfg.bug == "commit_skips_owner_cas")
                 if phase == 0:
                     ok = (s == _RUN) and owner_ok
-                    nj = repl_job(j, (_FIN, r, o, a, d)) if ok else jobs
+                    nj = repl_job(j, (_FIN, r, o, a, d, sp)) if ok else jobs
                     nmode = ("C", leased, entries, i, 1, tail, brk) if ok \
                         else ("C", leased, entries, i + 1, 0, tail, brk)
                     out.append((("commit_a", w, j, ok),
@@ -274,7 +342,7 @@ class LeaseModel:
                     ok = (s == _FIN) and owner_ok
                     # a landed commit means the (re-)run's output was
                     # published whole at full redundancy
-                    nj = repl_job(j, (_WRI, r, o, a, _D_INTACT)) \
+                    nj = repl_job(j, (_WRI, r, o, a, _D_INTACT, sp)) \
                         if ok else jobs
                     nc = tuple(min(c + 1, 2) if ok and i2 == j else c
                                for i2, c in enumerate(commits))
@@ -286,26 +354,81 @@ class LeaseModel:
                 nj = list(jobs)
                 released = []
                 for t in tail:
-                    s, r, o, a, d = nj[t]
+                    s, r, o, a, d, _ = nj[t]
                     if s == _RUN and o == w + 1:
-                        nj[t] = (_WAIT, r, o, 0, d)  # no repetition bump
+                        # no repetition bump; release dissolves any
+                        # shadow lease (the index engines' unlease rule)
+                        nj[t] = (_WAIT, r, o, 0, d, _SP_NONE)
                         released.append(t)
                 out.append((("release", w, tail, tuple(released)),
                             repl_w(w, self._norm(("K", leased, brk)),
                                    tuple(nj))))
             elif kind == "K":
                 _, leased, brk = mode
-                s, r, o, a, d = jobs[brk]
+                s, r, o, a, d, sp = jobs[brk]
                 # ownership AND still-RUNNING: a job the scavenger
                 # already requeued (BROKEN) or failed (FAILED) must not
                 # be touched — Worker._mark_broken carries the matching
                 # expect=(RUNNING,) CAS
                 ok = (o == w + 1) and s == _RUN
-                nj = repl_job(brk, (_BRK, self._sat(r + 1), o, 0, d)) \
-                    if ok else jobs
+                nj = repl_job(brk, (_BRK, self._sat(r + 1), o, 0, d,
+                                    _SP_NONE)) if ok else jobs
                 out.append((("mark_broken", w, brk, ok),
                             repl_w(w, _IDLE, nj)))
-            # heartbeats: alive while job bodies run (R) and on the
+            elif kind == "SR":
+                j = mode[1]
+                out.append((("spec_exec", w, j),
+                            repl_w(w, ("SC", j, 0))))
+                # the clone's revocation probe / body failure: dissolve
+                # the shadow lease (iff still held), touch nothing else
+                # — Worker.run_one's cancel path
+                sp = jobs[j][5]
+                held = sp == _SP_TAKEN0 + w
+                nj = repl_job(j, jobs[j][:5] + (_SP_NONE,)) if held \
+                    else jobs
+                out.append((("spec_cancel", w, j, held),
+                            repl_w(w, _IDLE, nj)))
+            elif kind == "SC":
+                _, j, phase = mode
+                s, r, o, a, d, sp = jobs[j]
+                # clone ownership = holding the shadow lease; the bug
+                # variant ALSO skips the winner's status CAS — the race
+                # the one-transition arbitration exists to prevent
+                spec_ok = sp == _SP_TAKEN0 + w
+                skip_status = cfg.bug == "spec_commit_skips_winner_cas"
+                if phase == 0:
+                    ok = spec_ok and (s == _RUN or skip_status)
+                    if ok:
+                        nj = repl_job(j, (_FIN, r, o, a, d, sp))
+                        out.append((("commit_a", w, j, True),
+                                    repl_w(w, ("SC", j, 1), nj)))
+                    else:
+                        # lost the race (or the lease): the cancel is
+                        # the NEXT step (SP_X), mirroring run_one's
+                        # failed-commit-then-cancel_spec order
+                        out.append((("commit_a", w, j, False),
+                                    repl_w(w, ("SP_X", j))))
+                else:
+                    ok = spec_ok and (s == _FIN or skip_status)
+                    if ok:
+                        nj = repl_job(j, (_WRI, r, o, a, _D_INTACT, sp))
+                        nc = tuple(min(c + 1, 2) if i2 == j else c
+                                   for i2, c in enumerate(commits))
+                        out.append((("commit_b", w, j, True),
+                                    repl_w(w, _IDLE, nj, nc)))
+                    else:
+                        out.append((("commit_b", w, j, False),
+                                    repl_w(w, ("SP_X", j))))
+            elif kind == "SP_X":
+                # a clone whose commit failed dissolves its shadow lease
+                # (iff still held) and goes idle — Worker._spec_lost
+                j = mode[1]
+                held = jobs[j][5] == _SP_TAKEN0 + w
+                nj = repl_job(j, jobs[j][:5] + (_SP_NONE,)) if held \
+                    else jobs
+                out.append((("spec_cancel", w, j, held),
+                            repl_w(w, _IDLE, nj)))
+            # heartbeats: alive while job bodies run (R / SR) and on the
             # failure path (the except runs inside the _beating scope);
             # the clean commit happens after the beat thread stopped
             beating = (kind == "R") or (
@@ -319,19 +442,43 @@ class LeaseModel:
                 if any(jobs[t][3] > 0 for t in beaten):
                     nj = list(jobs)
                     for t in beaten:
-                        s, r, o, _, d = nj[t]
-                        nj[t] = (s, r, o, 0, d)
+                        s, r, o, _, d, sp = nj[t]
+                        nj[t] = (s, r, o, 0, d, sp)
                     out.append((("beat", w, beaten),
                                 (tuple(nj), workers, commits, budget)))
+            elif kind == "SR":
+                # the clone's beat thread: ownership through the shadow
+                # lease — this is what keeps a job whose ORIGINAL died
+                # from being stale-requeued (and repetition-charged)
+                # while a live clone still races it
+                j = mode[1]
+                if (jobs[j][0] in (_RUN, _FIN)
+                        and jobs[j][5] == _SP_TAKEN0 + w
+                        and jobs[j][3] > 0):
+                    nj = repl_job(j, jobs[j][:3] + (0,) + jobs[j][4:])
+                    out.append((("beat", w, (j,)),
+                                (nj, workers, commits, budget)))
 
         # -- global (server/scavenger/clock) steps -----------------------
+        if cfg.allow_spec:
+            # the straggler detector's edge: any RUNNING job with no
+            # speculation may be marked OPEN (the model abstracts the
+            # EWMA-age threshold away — WHICH job straggles is the
+            # environment's choice, so every choice is enumerated; the
+            # CAS shape is what the checker verifies). A pure marker:
+            # status, reps, owner, age all untouched.
+            for j, rec in enumerate(jobs):
+                if rec[0] == _RUN and rec[5] == _SP_NONE:
+                    out.append((("speculate", j),
+                                (repl_job(j, rec[:5] + (_SP_OPEN,)),
+                                 workers, commits, budget)))
         aged = [j for j, rec in enumerate(jobs)
                 if rec[0] in (_RUN, _FIN) and rec[3] < self.cfg.stale_age]
         if aged:
             nj = list(jobs)
             for j in aged:
-                s, r, o, a, d = nj[j]
-                nj[j] = (s, r, o, a + 1, d)
+                s, r, o, a, d, sp = nj[j]
+                nj[j] = (s, r, o, a + 1, d, sp)
             out.append((("tick",), (tuple(nj), workers, commits, budget)))
 
         requeue_from = (_RUN,) if self.cfg.bug == "requeue_ignores_finished" \
@@ -342,8 +489,9 @@ class LeaseModel:
         if stale:
             nj = list(jobs)
             for j in stale:
-                s, r, o, a, d = nj[j]
-                nj[j] = (_BRK, self._sat(r + 1), o, 0, d)
+                s, r, o, a, d, sp = nj[j]
+                # requeue dissolves any shadow lease (unlease rule)
+                nj[j] = (_BRK, self._sat(r + 1), o, 0, d, _SP_NONE)
             out.append((("requeue", stale),
                         (tuple(nj), workers, commits, budget)))
 
@@ -352,8 +500,8 @@ class LeaseModel:
         if failed:
             nj = list(jobs)
             for j in failed:
-                s, r, o, a, d = nj[j]
-                nj[j] = (_FAI, r, o, a, d)
+                s, r, o, a, d, sp = nj[j]
+                nj[j] = (_FAI, r, o, a, d, sp)
             out.append((("scavenge", failed),
                         (tuple(nj), workers, commits, budget)))
 
@@ -362,18 +510,18 @@ class LeaseModel:
         # loses one replica, or every copy at once (the blackout /
         # dead-backend shape). Only WRITTEN jobs hold published output.
         if budget > 0:
-            for j, (s, r, o, a, d) in enumerate(jobs):
+            for j, (s, r, o, a, d, sp) in enumerate(jobs):
                 if s != _WRI:
                     continue
                 if d == _D_INTACT:
                     out.append((
                         ("lose_replica", j),
-                        (repl_job(j, (s, r, o, a, _D_UNDER)), workers,
+                        (repl_job(j, (s, r, o, a, _D_UNDER, sp)), workers,
                          commits, budget - 1)))
                 if d != _D_LOST:
                     out.append((
                         ("lose_all", j),
-                        (repl_job(j, (s, r, o, a, _D_LOST)), workers,
+                        (repl_job(j, (s, r, o, a, _D_LOST, sp)), workers,
                          commits, budget - 1)))
         # scavenger pass, reconstruct rung: every under-replicated
         # output is healed from a survivor — job state UNTOUCHED (the
@@ -383,8 +531,8 @@ class LeaseModel:
         if under:
             nj = list(jobs)
             for j in under:
-                s, r, o, a, _ = nj[j]
-                nj[j] = (s, r, o, a, _D_INTACT)
+                s, r, o, a, _, sp = nj[j]
+                nj[j] = (s, r, o, a, _D_INTACT, sp)
             out.append((("repair", under),
                         (tuple(nj), workers, commits, budget)))
         # scavenger pass, requeue rung (last resort): producers of
@@ -404,8 +552,10 @@ class LeaseModel:
                 nj = list(jobs)
                 nc = list(commits)
                 for j in lost:
-                    _, r, _, _, d = nj[j]
-                    nj[j] = (_WAIT, r, 0, 0, d)
+                    _, r, _, _, d, _ = nj[j]
+                    # the WAITING transition dissolves any (historical)
+                    # shadow lease, like every unlease edge
+                    nj[j] = (_WAIT, r, 0, 0, d, _SP_NONE)
                     nc[j] = 0
                 out.append((("rerun_requeue", lost),
                             (tuple(nj), workers, tuple(nc), budget)))
@@ -435,11 +585,19 @@ class LeaseModel:
                        label: tuple) -> Optional[str]:
         ojobs, _, ocommits, _ = old
         njobs, _, ncommits, _ = new
-        for j, ((os_, or_, oo, _, _), (ns_, nr, no, _, _)) in enumerate(
-                zip(ojobs, njobs)):
+        for j, ((os_, or_, oo, _, _, osp), (ns_, nr, no, _, _, nsp)) in \
+                enumerate(zip(ojobs, njobs)):
             if nr < or_:
                 return (f"repetitions of job {j} decreased {or_}→{nr} "
                         f"on {label}")
+            if label[0] in _SPEC_PURE_OPS and (ns_ != os_ or nr != or_):
+                # the zero-charge rule of the speculation edges: marking,
+                # taking, or dissolving a shadow lease must be invisible
+                # to the job's status and retry budget (DESIGN §21)
+                return (f"speculation edge {label} touched job {j} state "
+                        f"({Status(os_).name},{or_})→"
+                        f"({Status(ns_).name},{nr}) — speculate/claim/"
+                        "cancel must be pure lease-markers")
             if ns_ != os_ and ns_ not in _ALLOWED_EDGES[os_]:
                 # the ONE legal WRITTEN→WAITING edge: the scavenger's
                 # lost-data requeue — and it must charge no repetition
@@ -457,26 +615,28 @@ class LeaseModel:
             w, j = label[1], label[2]
             if ncommits[j] > 1:
                 return (f"double commit: job {j} committed twice "
-                        f"(worker {w} landed a second commit)")
-            if ojobs[j][2] != w + 1:
+                        f"(worker {w} landed a second commit — the "
+                        "first-commit-wins CAS failed to arbitrate)")
+            if ojobs[j][2] != w + 1 and ojobs[j][5] != _SP_TAKEN0 + w:
                 return (f"commit without ownership: worker {w} committed "
                         f"job {j} currently claimed by worker "
-                        f"{ojobs[j][2] - 1} — the scavenger requeued and "
-                        "re-claimed it mid-commit")
+                        f"{ojobs[j][2] - 1} with no shadow lease — the "
+                        "scavenger requeued and re-claimed it mid-commit")
         return None
 
     def quiescent_violation(self, state: tuple) -> Optional[str]:
         jobs, workers, _, _ = state
         if all(m[0] == "D" for m in workers):
             return None              # a fully dead pool may strand work
-        bad = {j: Status(s).name for j, (s, _, _, _, _) in enumerate(jobs)
+        bad = {j: Status(s).name
+               for j, (s, _, _, _, _, _) in enumerate(jobs)
                if s not in (_WRI, _FAI)}
         if bad:
             return (f"lost/stuck jobs at quiescence with a live worker: "
                     f"{bad} (every job must end WRITTEN or FAILED; a "
                     "FINISHED entry here is the stuck-FINISHED+unclaimed "
                     "gap)")
-        stranded = [j for j, (s, _, _, _, d) in enumerate(jobs)
+        stranded = [j for j, (s, _, _, _, d, _) in enumerate(jobs)
                     if s == _WRI and d == _D_LOST]
         if stranded:
             return (f"stranded lost shuffle data at quiescence with a "
@@ -602,12 +762,31 @@ def replay_trace(store, trace: Sequence[tuple], config: ModelConfig,
 
     for i, label in enumerate(trace):
         op = label[0]
-        if op in ("exec", "exec_fail", "die", "tick",
+        if op in ("exec", "exec_fail", "spec_exec", "die", "tick",
                   "lose_replica", "lose_all", "repair"):
             # loss events and replica repair live on the data plane
             # (store files, faults/replicate.py) — no jobstore op
             continue
-        if op == "claim":
+        if op == "speculate":
+            (_, j) = label
+            if not store.speculate(ns, j):
+                return diverged(i, label,
+                                f"speculate CAS refused job {j}")
+        elif op == "claim_spec":
+            _, w, j = label
+            doc = store.claim_spec(ns, wname[w])
+            got = doc["_id"] if doc else None
+            if got != j:
+                return diverged(i, label,
+                                f"claim_spec took {got}, model took {j}")
+        elif op == "spec_cancel":
+            _, w, j, held = label
+            got = store.cancel_spec(ns, j, wname[w])
+            if got != held:
+                return diverged(i, label,
+                                f"cancel_spec returned {got}, model "
+                                f"said {held}")
+        elif op == "claim":
             _, w, take = label
             docs = store.claim_batch(ns, wname[w], k=config.batch_k)
             got = tuple(d["_id"] for d in docs)
@@ -701,7 +880,7 @@ def replay_trace(store, trace: Sequence[tuple], config: ModelConfig,
     if final_state is not None:
         jobs, _, _, _ = final_state
         cap = config.max_retries + 1
-        for j, (s, r, _, _, _) in enumerate(jobs):
+        for j, (s, r, _, _, _, _) in enumerate(jobs):
             doc = store.get_job(ns, j)
             if int(doc["status"]) != s or min(int(doc["repetitions"]),
                                               cap) != r:
@@ -754,3 +933,20 @@ def utest() -> None:
     assert not rep2["ok"]
     assert rep2["label"][0] in ("rerun_requeue", "commit_a", "commit_b",
                                 "claim")
+
+    # speculation edges (DESIGN §21): the duplicate-lease lifecycle
+    # holds every invariant exhaustively, and the loser-commit-skips-
+    # winner-CAS race is re-found and diverges on the real store's CAS
+    spec = dataclasses.replace(small, n_workers=2, allow_spec=True)
+    res3 = check_protocol(spec)
+    assert res3.ok and res3.states > res.states
+
+    race = check_protocol(dataclasses.replace(
+        spec, bug="spec_commit_skips_winner_cas"))
+    assert not race.ok, "seeded spec race not found"
+    assert ("double commit" in race.violation.message
+            or "illegal status edge" in race.violation.message)
+    rep3 = replay_trace(MemJobStore(), race.violation.trace, race.config)
+    assert not rep3["ok"]
+    assert rep3["label"][0].startswith(("commit", "claim_spec",
+                                        "spec_cancel"))

@@ -100,6 +100,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "lost copies instead of re-running map jobs — "
                         "docs/DESIGN.md §20. r=1 is byte-identical to "
                         "the unreplicated path")
+    p.add_argument("--speculation-factor", type=float, default=None,
+                   help="straggler factor (default 0 = off, or "
+                        "LMR_SPECULATION): a RUNNING job older than "
+                        "FACTOR x the fleet per-namespace duration EWMA "
+                        "gets a speculative duplicate lease; idle "
+                        "workers race it and the first commit wins — "
+                        "the loser degrades to a zero-repetition no-op "
+                        "(docs/DESIGN.md §21)")
+    p.add_argument("--speculation-cap", type=int, default=2,
+                   help="max live speculative clones per namespace "
+                        "(bounds wasted duplicate work)")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -149,7 +160,9 @@ def main(argv=None) -> int:
                     premerge_max_runs=args.premerge_max_runs,
                     batch_k=args.batch_k,
                     segment_format=args.segment_format,
-                    replication=args.replication).configure(spec)
+                    replication=args.replication,
+                    speculation=args.speculation_factor,
+                    speculation_cap=args.speculation_cap).configure(spec)
 
     for _ in range(args.inline_workers):
         w = Worker(store).configure(max_iter=10_000)

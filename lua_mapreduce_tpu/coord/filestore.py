@@ -380,24 +380,26 @@ class FileJobStore(JobStore):
         # the gen file / one sidecar per job)
         batches = self._resolve_batches(ns)
         wnames = self._read_wlog(ns)
-        for jid, (status, reps, whash, started, t5) in \
-                enumerate(idx.snapshot()):
+        for jid, (status, reps, whash, started, t5, spec_state,
+                  spec_whash) in enumerate(idx.snapshot()):
             doc = copy.deepcopy(self._lookup_payload(batches, jid)) or {}
             doc.update(_id=jid, status=Status(status), repetitions=reps,
                        worker=wnames.get(jid, whash or None),
                        started_time=started or None,
-                       times=_times_doc(t5))
+                       times=_times_doc(t5), spec_state=spec_state,
+                       spec_worker=spec_whash or None)
             docs.append(doc)
         return docs
 
     def _job_doc(self, ns, jid, idx) -> dict:
         state = idx.get(jid)
-        status, reps, whash, started, t5 = state
+        status, reps, whash, started, t5, spec_state, spec_whash = state
         doc = dict(self._payload_doc(ns, jid))
         doc.update(_id=jid, status=Status(status), repetitions=reps,
                    worker=self._read_wlog(ns).get(jid, whash or None),
                    started_time=started or None,
-                   times=_times_doc(t5))
+                   times=_times_doc(t5), spec_state=spec_state,
+                   spec_worker=spec_whash or None)
         return doc
 
     def job_workers(self, ns):
@@ -427,6 +429,33 @@ class FileJobStore(JobStore):
     def heartbeat(self, ns, job_id, worker):
         return self._idx(ns).heartbeat(job_id, worker_hash(worker),
                                        time.time())
+
+    # -- duplicate leases (speculative execution, DESIGN §21) --------------
+
+    def speculate(self, ns, job_id):
+        self._bump("commit")
+        return bool(self._idx(ns).speculate(job_id))
+
+    def claim_spec(self, ns, worker):
+        self._bump("claim")
+        got = self._idx(ns).claim_spec(worker_hash(worker))
+        if got is None:
+            return None
+        jid, reps = got
+        # the clone doc carries the ORIGINAL claimant as ``worker`` (the
+        # claim log's last entry — claim_spec never appends to it, so
+        # producer lookups keep naming the original)
+        doc = copy.deepcopy(
+            self._lookup_payload(self._resolve_batches(ns), jid)) or {}
+        doc.update(_id=jid, status=Status.RUNNING, repetitions=reps,
+                   worker=self._read_wlog(ns).get(jid), times=None,
+                   spec_state=2, spec_worker=worker, speculative=True)
+        return doc
+
+    def cancel_spec(self, ns, job_id, worker):
+        self._bump("commit")
+        return bool(self._idx(ns).cancel_spec(
+            job_id, worker_hash(worker) if worker is not None else 0))
 
     def drop_ns(self, ns):
         self._batches.pop(ns, None)

@@ -112,7 +112,17 @@ def _load() -> Optional[ctypes.CDLL]:
                             ctypes.POINTER(ctypes.c_int32),
                             ctypes.POINTER(ctypes.c_int64),
                             ctypes.POINTER(ctypes.c_double),
-                            ctypes.POINTER(ctypes.c_double)]
+                            ctypes.POINTER(ctypes.c_double),
+                            ctypes.POINTER(ctypes.c_int32),
+                            ctypes.POINTER(ctypes.c_int64)]
+    lib.jsx_speculate.restype = ctypes.c_int
+    lib.jsx_speculate.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.jsx_claim_spec.restype = ctypes.c_int64
+    lib.jsx_claim_spec.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.c_int32)]
+    lib.jsx_cancel_spec.restype = ctypes.c_int
+    lib.jsx_cancel_spec.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_int64]
     lib.jsx_counts.restype = ctypes.c_int64
     lib.jsx_counts.argtypes = [ctypes.c_char_p,
                                ctypes.POINTER(ctypes.c_int64)]
@@ -130,6 +140,8 @@ def _load() -> Optional[ctypes.CDLL]:
                                  ctypes.POINTER(ctypes.c_int64),
                                  ctypes.POINTER(ctypes.c_double),
                                  ctypes.POINTER(ctypes.c_double),
+                                 ctypes.POINTER(ctypes.c_int32),
+                                 ctypes.POINTER(ctypes.c_int64),
                                  ctypes.c_int64]
     return lib
 
@@ -240,16 +252,43 @@ class NativeJobIndex:
         worker = ctypes.c_int64()
         started = ctypes.c_double()
         times = (ctypes.c_double * 5)()
+        spec_state = ctypes.c_int32()
+        spec_worker = ctypes.c_int64()
         r = self._lib.jsx_get(self._p, job_id, ctypes.byref(status),
                               ctypes.byref(reps), ctypes.byref(worker),
-                              ctypes.byref(started), times)
+                              ctypes.byref(started), times,
+                              ctypes.byref(spec_state),
+                              ctypes.byref(spec_worker))
         if r < 0:
             raise NativeIndexError(f"jsx_get failed on {self.path}")
         if r == 0:
             return None
         t = tuple(times)
         return (status.value, reps.value, worker.value, started.value,
-                None if t == (0.0,) * 5 else t)
+                None if t == (0.0,) * 5 else t, spec_state.value,
+                spec_worker.value)
+
+    def speculate(self, job_id: int) -> bool:
+        r = self._lib.jsx_speculate(self._p, job_id)
+        if r < 0:
+            raise NativeIndexError(f"jsx_speculate failed on {self.path}")
+        return bool(r)
+
+    def claim_spec(self, worker: int) -> Optional[Tuple[int, int]]:
+        reps = ctypes.c_int32()
+        jid = self._lib.jsx_claim_spec(self._p, worker, ctypes.byref(reps))
+        if jid <= -2:
+            # -1 means "nothing open"; anything below is a real IO
+            # failure and must surface classified, not as a silent
+            # speculation blackout
+            raise NativeIndexError(f"jsx_claim_spec failed on {self.path}")
+        return None if jid < 0 else (jid, reps.value)
+
+    def cancel_spec(self, job_id: int, worker: int) -> bool:
+        r = self._lib.jsx_cancel_spec(self._p, job_id, worker)
+        if r < 0:
+            raise NativeIndexError(f"jsx_cancel_spec failed on {self.path}")
+        return bool(r)
 
     def counts(self) -> Dict[Status, int]:
         out = (ctypes.c_int64 * 6)()
@@ -285,8 +324,11 @@ class NativeJobIndex:
         workers = (ctypes.c_int64 * cap)()
         started = (ctypes.c_double * cap)()
         times = (ctypes.c_double * (cap * 5))()
+        spec_states = (ctypes.c_int32 * cap)()
+        spec_workers = (ctypes.c_int64 * cap)()
         n = self._lib.jsx_snapshot(self._p, statuses, reps, workers,
-                                   started, times, cap)
+                                   started, times, spec_states,
+                                   spec_workers, cap)
         if n < 0:
             raise NativeIndexError(f"jsx_snapshot failed on {self.path}")
         out = []
@@ -294,7 +336,8 @@ class NativeJobIndex:
         for i in range(n):
             t = tuple(times[i * 5:(i + 1) * 5])
             out.append((statuses[i], reps[i], workers[i], started[i],
-                        None if t == zero else t))
+                        None if t == zero else t, spec_states[i],
+                        spec_workers[i]))
         return out
 
 

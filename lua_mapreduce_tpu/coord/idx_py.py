@@ -11,17 +11,23 @@ The on-disk format is shared byte-for-byte with the native C++ engine
 (native/jobstore.cpp); processes may mix the two freely on the same files.
 
 Layout (little-endian):
-    header:  8s magic "JSIX0002" | q record count
+    header:  8s magic "JSIX0003" | q record count
     record:  i status | i repetitions | q worker-hash | d started_time
              | d heartbeat | 5d job times (started, finished, written,
-             cpu, real; all-zero = not recorded)
+             cpu, real; all-zero = not recorded) | q spec-worker-hash
+             | i spec_state | i reserved
 
-Format note: JSIX0002 embeds the per-job TIMES in the record (the v1
+Format note: JSIX0002 embedded the per-job TIMES in the record (the v1
 times sidecar was one tempfile+rename per job — at many-tiny-jobs scale
 those renames dominated the commit path, and the server's stats fold
-re-opened one JSON file per job). Index files are per-run coordination
-state, not durable data, so v1 files are not migrated — a v1 file left
-by an older process fails the magic check loudly rather than being
+re-opened one JSON file per job). JSIX0003 adds the DUPLICATE-LEASE
+fields (DESIGN §21): ``spec_state`` (0 = none, 1 = speculation OPEN —
+the straggler detector marked this RUNNING job cloneable, 2 = TAKEN —
+``spec_worker`` holds the shadow lease) ride every record so the
+first-commit-wins arbitration is one CAS under the same flock as every
+other transition. Index files are per-run coordination state, not
+durable data, so older formats are not migrated — a v1/v2 file left by
+an older process fails the magic check loudly rather than being
 misread.
 """
 
@@ -34,28 +40,47 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES, Status
 
-MAGIC = b"JSIX0002"
+MAGIC = b"JSIX0003"
 _HEADER = struct.Struct("<8sq")
-_REC = struct.Struct("<iiqddddddd")
+_REC = struct.Struct("<iiqdddddddqii")
 HEADER_SIZE = _HEADER.size       # 16
-RECORD_SIZE = _REC.size          # 72
+RECORD_SIZE = _REC.size          # 88
 N_TIMES = 5                      # started, finished, written, cpu, real
 _ZERO_TIMES = (0.0,) * N_TIMES
 
-# import-time drift guard: these numbers ARE the v2 wire format shared
+# record tuple indices past the times block
+_I_SPECW = 10                    # spec-worker hash
+_I_SPECS = 11                    # spec_state
+
+# spec_state values (DESIGN §21)
+SPEC_NONE = 0
+SPEC_OPEN = 1                    # detector marked: shadow lease claimable
+SPEC_TAKEN = 2                   # spec-worker holds the shadow lease
+
+# import-time drift guard: these numbers ARE the v3 wire format shared
 # with native/jobstore.cpp (its static_asserts pin the same values, and
 # idx.py cross-checks both sides via jsx_abi() when the native engine
 # loads). A drifted struct string must fail here, before any index file
 # is touched — as a real raise, not an assert, so python -O cannot
 # strip the guard whose whole point is preventing silent corruption.
-if HEADER_SIZE != 16 or RECORD_SIZE != 72:
-    raise ImportError(f"JSIX0002 layout drifted: header {HEADER_SIZE}B, "
-                      f"record {RECORD_SIZE}B (must be 16/72)")
+if HEADER_SIZE != 16 or RECORD_SIZE != 88:
+    raise ImportError(f"JSIX0003 layout drifted: header {HEADER_SIZE}B, "
+                      f"record {RECORD_SIZE}B (must be 16/88)")
 if [int(s) for s in Status] != [0, 1, 2, 3, 4, 5]:
-    raise ImportError("Status enum drifted from the JSIX0002 record "
+    raise ImportError("Status enum drifted from the JSIX0003 record "
                       "encoding (native/jobstore.cpp pins 0..5)")
 
 _CLAIM_MASK = (1 << Status.WAITING) | (1 << Status.BROKEN)
+
+
+def worker_tag(worker_hash: int, num_tags: int = 8) -> int:
+    """Placement tag of a worker, from its stable name hash — the
+    fleet-side twin of engine/placement.py's file tags. Used by the
+    speculative claim to PREFER shadow workers on a different tag than
+    the straggler (a degraded rack slows all its members; a clone on
+    the same tag would likely share the fate). Unsigned arithmetic so
+    Python and C++ (uint64 cast) agree on negative hashes."""
+    return (worker_hash & 0xFFFFFFFFFFFFFFFF) % num_tags
 
 
 class PyJobIndex:
@@ -98,15 +123,28 @@ class PyJobIndex:
     @staticmethod
     def _write_rec(fd, job_id: int, status: int, reps: int, worker: int,
                    started: float, heartbeat: float = 0.0,
-                   times: Sequence[float] = _ZERO_TIMES) -> None:
+                   times: Sequence[float] = _ZERO_TIMES,
+                   spec_worker: int = 0, spec_state: int = SPEC_NONE) -> None:
         os.lseek(fd, HEADER_SIZE + job_id * RECORD_SIZE, os.SEEK_SET)
         os.write(fd, _REC.pack(status, reps, worker, started, heartbeat,
-                               *times))
+                               *times, spec_worker, spec_state, 0))
 
     @staticmethod
     def _times_of(rec: tuple) -> Optional[Tuple[float, ...]]:
         times = rec[5:5 + N_TIMES]
         return None if times == _ZERO_TIMES else times
+
+    @staticmethod
+    def _owner_ok(rec: tuple, expect_worker: int) -> bool:
+        """The duplicate-lease ownership rule (DESIGN §21): a record is
+        'owned' by its claimant AND, while a shadow lease is TAKEN, by
+        the speculative worker — either may land the one commit; the
+        status CAS (only one RUNNING|FINISHED→WRITTEN transition can
+        ever succeed under the flock) arbitrates first-commit-wins."""
+        if rec[2] == expect_worker:
+            return True
+        return (rec[_I_SPECS] == SPEC_TAKEN
+                and rec[_I_SPECW] == expect_worker)
 
     @classmethod
     def _read_all(cls, fd) -> List[Tuple[int, int, int, float, float]]:
@@ -222,12 +260,18 @@ class PyJobIndex:
             status, reps, w = rec[0], rec[1], rec[2]
             if expect_mask and not ((1 << status) & expect_mask):
                 return False
-            if expect_worker and w != expect_worker:
+            if expect_worker and not self._owner_ok(rec, expect_worker):
                 return False
             if to == Status.BROKEN:
                 reps += 1
+            # leaving the leased states (release/requeue) dissolves any
+            # shadow lease: a re-claimed job must never be committable
+            # by a stale speculative worker
+            sw, ss = ((0, SPEC_NONE)
+                      if to in (Status.WAITING, Status.BROKEN)
+                      else (rec[_I_SPECW], rec[_I_SPECS]))
             self._write_rec(fd, job_id, int(to), reps, w, rec[3], rec[4],
-                            rec[5:])
+                            rec[5:5 + N_TIMES], sw, ss)
             return True
         finally:
             os.close(fd)
@@ -253,12 +297,15 @@ class PyJobIndex:
                 status, reps, w = rec[0], rec[1], rec[2]
                 if expect_mask and not ((1 << status) & expect_mask):
                     continue
-                if expect_worker and w != expect_worker:
+                if expect_worker and not self._owner_ok(rec, expect_worker):
                     continue
                 if to == Status.BROKEN:
                     reps += 1
+                sw, ss = ((0, SPEC_NONE)
+                          if to in (Status.WAITING, Status.BROKEN)
+                          else (rec[_I_SPECW], rec[_I_SPECS]))
                 self._write_rec(fd, job_id, int(to), reps, w, rec[3],
-                                rec[4], rec[5:])
+                                rec[4], rec[5:5 + N_TIMES], sw, ss)
                 out[i] = True
             return out
         finally:
@@ -287,10 +334,15 @@ class PyJobIndex:
                 status, reps, w = rec[0], rec[1], rec[2]
                 if not ((1 << status) & commit_mask):
                     continue
-                if worker and w != worker:
+                if worker and not self._owner_ok(rec, worker):
                     continue
+                # the ONE commit (first-commit-wins): WRITTEN is outside
+                # commit_mask, so the losing duplicate's entry fails the
+                # status check above and is skipped without any state
+                # change — never a double commit, never a rep bump
                 self._write_rec(fd, job_id, Status.WRITTEN, reps, w,
-                                rec[3], rec[4], times or _ZERO_TIMES)
+                                rec[3], rec[4], times or _ZERO_TIMES,
+                                rec[_I_SPECW], rec[_I_SPECS])
                 out[i] = True
             return out
         finally:
@@ -307,14 +359,14 @@ class PyJobIndex:
                 return False
             rec = self._read_rec(fd, job_id)
             self._write_rec(fd, job_id, rec[0], rec[1], rec[2], rec[3],
-                            rec[4], times)
+                            rec[4], times, rec[_I_SPECW], rec[_I_SPECS])
             return True
         finally:
             os.close(fd)
 
     def get(self, job_id: int) -> Optional[tuple]:
-        """(status, reps, worker, started, times5 | None) or None when
-        missing/out of bounds."""
+        """(status, reps, worker, started, times5 | None, spec_state,
+        spec_worker) or None when missing/out of bounds."""
         if not os.path.exists(self.path):
             return None
         fd = self._open_locked()
@@ -322,7 +374,8 @@ class PyJobIndex:
             if not (0 <= job_id < self._read_count(fd)):
                 return None
             rec = self._read_rec(fd, job_id)
-            return rec[0], rec[1], rec[2], rec[3], self._times_of(rec)
+            return (rec[0], rec[1], rec[2], rec[3], self._times_of(rec),
+                    rec[_I_SPECS], rec[_I_SPECW])
         finally:
             os.close(fd)
 
@@ -348,7 +401,8 @@ class PyJobIndex:
                 status, reps = rec[0], rec[1]
                 if status == Status.BROKEN and reps >= max_retries:
                     self._write_rec(fd, jid, Status.FAILED, reps, rec[2],
-                                    rec[3], rec[4], rec[5:])
+                                    rec[3], rec[4], rec[5:5 + N_TIMES],
+                                    rec[_I_SPECW], rec[_I_SPECS])
                     n += 1
             return n
         finally:
@@ -369,8 +423,11 @@ class PyJobIndex:
                 status, reps, w, st, hb = rec[:5]
                 if (status in (Status.RUNNING, Status.FINISHED) and
                         max(st, hb) < cutoff):
+                    # requeue dissolves any shadow lease (the clone's
+                    # beats count as liveness, so reaching here means
+                    # BOTH holders went silent)
                     self._write_rec(fd, jid, Status.BROKEN, reps + 1, w,
-                                    st, hb, rec[5:])
+                                    st, hb, rec[5:5 + N_TIMES])
                     n += 1
             return n
         finally:
@@ -389,9 +446,11 @@ class PyJobIndex:
             status, reps, w, st = rec[:4]
             if status not in (Status.RUNNING, Status.FINISHED):
                 return False
-            if worker and w != worker:
+            if worker and not self._owner_ok(rec, worker):
                 return False
-            self._write_rec(fd, job_id, status, reps, w, st, now, rec[5:])
+            self._write_rec(fd, job_id, status, reps, w, st, now,
+                            rec[5:5 + N_TIMES], rec[_I_SPECW],
+                            rec[_I_SPECS])
             return True
         finally:
             os.close(fd)
@@ -414,24 +473,107 @@ class PyJobIndex:
                 status, reps, w, st = rec[:4]
                 if status not in (Status.RUNNING, Status.FINISHED):
                     continue
-                if worker and w != worker:
+                if worker and not self._owner_ok(rec, worker):
                     continue
                 self._write_rec(fd, job_id, status, reps, w, st, now,
-                                rec[5:])
+                                rec[5:5 + N_TIMES], rec[_I_SPECW],
+                                rec[_I_SPECS])
                 n += 1
             return n
         finally:
             os.close(fd)
 
+    # -- duplicate leases (speculative execution, DESIGN §21) --------------
+
+    def speculate(self, job_id: int) -> bool:
+        """Mark a RUNNING record speculation-OPEN: a shadow lease may be
+        taken by :meth:`claim_spec`. The straggler detector's op — CASed
+        on (RUNNING, no existing speculation), so repeated detector
+        passes over the same straggler are idempotent and a job can
+        carry at most ONE shadow lease at a time."""
+        if not os.path.exists(self.path):
+            return False
+        fd = self._open_locked()
+        try:
+            if not (0 <= job_id < self._read_count(fd)):
+                return False
+            rec = self._read_rec(fd, job_id)
+            if rec[0] != Status.RUNNING or rec[_I_SPECS] != SPEC_NONE:
+                return False
+            self._write_rec(fd, job_id, rec[0], rec[1], rec[2], rec[3],
+                            rec[4], rec[5:5 + N_TIMES], 0, SPEC_OPEN)
+            return True
+        finally:
+            os.close(fd)
+
+    def claim_spec(self, worker: int) -> Optional[Tuple[int, int]]:
+        """Take ONE speculation-open shadow lease → (job_id, reps), or
+        None. A worker never shadows its own job, and records whose
+        claimant sits on a DIFFERENT placement tag than the claimer are
+        preferred (a straggler's slowness is often its failure domain's;
+        a clone sharing the domain would likely share the fate) — same
+        scan order (lowest id first) within each preference class, so
+        both engines and the protocol model agree on who wins."""
+        if not os.path.exists(self.path):
+            return None
+        fd = self._open_locked()
+        try:
+            recs = self._read_all(fd)
+            my_tag = worker_tag(worker)
+            candidates = [jid for jid, rec in enumerate(recs)
+                          if rec[0] == Status.RUNNING
+                          and rec[_I_SPECS] == SPEC_OPEN
+                          and rec[2] != worker]
+            ordered = ([j for j in candidates
+                        if worker_tag(recs[j][2]) != my_tag]
+                       + [j for j in candidates
+                          if worker_tag(recs[j][2]) == my_tag])
+            for jid in ordered[:1]:
+                rec = recs[jid]
+                self._write_rec(fd, jid, rec[0], rec[1], rec[2], rec[3],
+                                rec[4], rec[5:5 + N_TIMES], worker,
+                                SPEC_TAKEN)
+                return jid, rec[1]
+            return None
+        finally:
+            os.close(fd)
+
+    def cancel_spec(self, job_id: int, worker: int) -> bool:
+        """Dissolve a shadow lease this worker holds (the loser /
+        failure path — the job's status and repetitions are NEVER
+        touched: the original claimant still owns the lease). CASed on
+        (TAKEN, spec owner == worker); with worker == 0 any OPEN or
+        TAKEN speculation is cleared (the detector's retraction)."""
+        if not os.path.exists(self.path):
+            return False
+        fd = self._open_locked()
+        try:
+            if not (0 <= job_id < self._read_count(fd)):
+                return False
+            rec = self._read_rec(fd, job_id)
+            if worker:
+                if (rec[_I_SPECS] != SPEC_TAKEN
+                        or rec[_I_SPECW] != worker):
+                    return False
+            elif rec[_I_SPECS] == SPEC_NONE:
+                return False
+            self._write_rec(fd, job_id, rec[0], rec[1], rec[2], rec[3],
+                            rec[4], rec[5:5 + N_TIMES], 0, SPEC_NONE)
+            return True
+        finally:
+            os.close(fd)
+
     def snapshot(self) -> List[tuple]:
-        """All records (status, reps, worker, started, times5 | None) in
-        one locked pass over one bulk read — the stats/jobs() read path
-        (v1 additionally opened one times-sidecar JSON per job here)."""
+        """All records (status, reps, worker, started, times5 | None,
+        spec_state, spec_worker) in one locked pass over one bulk read —
+        the stats/jobs() read path (v1 additionally opened one
+        times-sidecar JSON per job here)."""
         if not os.path.exists(self.path):
             return []
         fd = self._open_locked()
         try:
-            return [rec[:4] + (self._times_of(rec),)
+            return [rec[:4] + (self._times_of(rec), rec[_I_SPECS],
+                               rec[_I_SPECW])
                     for rec in self._read_all(fd)]
         finally:
             os.close(fd)

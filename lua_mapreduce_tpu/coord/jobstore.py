@@ -237,10 +237,54 @@ class JobStore(abc.ABC):
 
     def heartbeat(self, ns: str, job_id: int, worker: str) -> bool:
         """Refresh the liveness timestamp of a RUNNING|FINISHED job this
-        worker owns, so :meth:`requeue_stale` measures silence instead of
-        elapsed time. Returns False when the claim is lost (requeued and
-        re-claimed), the job is in another state, or the store does not
-        track liveness (this default)."""
+        worker owns — or holds the SHADOW lease of (speculation, see
+        :meth:`speculate`) — so :meth:`requeue_stale` measures silence
+        instead of elapsed time. Returns False when the claim is lost
+        (requeued and re-claimed), the job is in another state, or the
+        store does not track liveness (this default). Doubling as the
+        worker's cheap lease-revocation probe: a False on a lease the
+        worker believed live means the other duplicate committed (or
+        the scavenger intervened) and remaining work is wasted."""
+        return False
+
+    # -- duplicate leases (speculative execution, DESIGN §21) --------------
+
+    def speculate(self, ns: str, job_id: int) -> bool:
+        """Mark a RUNNING job speculation-OPEN so one other worker may
+        clone its lease via :meth:`claim_spec` — the straggler
+        detector's op. CASed on (RUNNING, no existing speculation):
+        repeated detector passes are idempotent, and a job carries at
+        most ONE shadow lease at a time. The original claimant keeps
+        its lease untouched; FIRST-COMMIT-WINS arbitration happens at
+        commit time (the one RUNNING|FINISHED→WRITTEN transition — the
+        loser's commit fails the status CAS and degrades to a
+        zero-repetition no-op, never a double commit, never a rep bump
+        against either worker). Stores without speculation support
+        keep this default: the detector simply never launches clones."""
+        return False
+
+    def claim_spec(self, ns: str, worker: str) -> Optional[dict]:
+        """Take ONE speculation-open shadow lease for ``worker``:
+        returns the cloned job doc (``speculative=True``, ``worker`` =
+        the ORIGINAL claimant) or None. A worker never shadows its own
+        job; candidates whose claimant sits on a different placement
+        tag (engine/placement.py's failure domains, hashed from the
+        worker name) are preferred — a straggler's slowness is often
+        its domain's, and a clone sharing the domain would likely share
+        the fate. Scan order is lowest id first within each preference
+        class on every store; the protocol model abstracts the tag
+        preference away (it has no placement), so its traces replay
+        exactly on two-worker boxes — the gate's pinned configuration —
+        where every candidate shares one preference class."""
+        return None
+
+    def cancel_spec(self, ns: str, job_id: int, worker: str) -> bool:
+        """Dissolve a shadow lease ``worker`` holds — the loser /
+        clone-failure path. The job's status and repetitions are NEVER
+        touched: the original claimant still owns the lease, so a
+        failed or revoked clone costs nothing but its own wasted time.
+        ``worker=None`` clears any speculation regardless of holder
+        (the detector's retraction)."""
         return False
 
     @abc.abstractmethod
@@ -331,7 +375,7 @@ class MemJobStore(JobStore):
                 d = dict(doc)
                 d.update(_id=base + i, status=Status.WAITING, repetitions=0,
                          worker=None, started_time=None, hb_time=None,
-                         times=None)
+                         times=None, spec_state=0, spec_worker=None)
                 queue.append(d)
                 ids.append(base + i)
             return ids
@@ -353,6 +397,8 @@ class MemJobStore(JobStore):
                     d["worker"] = worker
                     d["started_time"] = now
                     d["hb_time"] = None   # fresh claim, fresh silence clock
+                    d["spec_state"] = 0   # no carried shadow lease
+                    d["spec_worker"] = None
                     out.append(dict(d))
 
             for jid in (preferred_ids or ()):
@@ -365,6 +411,23 @@ class MemJobStore(JobStore):
                     try_claim(d)
             return out
 
+    @staticmethod
+    def _owner_ok(d: dict, worker: str) -> bool:
+        """Duplicate-lease ownership (DESIGN §21): the claimant owns the
+        job and, while a shadow lease is taken, so does the speculative
+        worker — the status CAS arbitrates first-commit-wins."""
+        return (d["worker"] == worker
+                or (d.get("spec_state") == 2
+                    and d.get("spec_worker") == worker))
+
+    @staticmethod
+    def _clear_spec_on_unlease(d: dict, status: Status) -> None:
+        """Leaving the leased states dissolves any shadow lease: a
+        re-claimed job must never be committable by a stale clone."""
+        if status in (Status.WAITING, Status.BROKEN):
+            d["spec_state"] = 0
+            d["spec_worker"] = None
+
     def commit_batch(self, ns, worker, entries):
         self._bump("commit")
         with self._lock:
@@ -376,9 +439,12 @@ class MemJobStore(JobStore):
                 d = queue[job_id]
                 # RUNNING|FINISHED, matching the index engines: a job a
                 # crashed commit left FINISHED must retire, not wait for
-                # the stale requeue to re-execute completed work
+                # the stale requeue to re-execute completed work. A
+                # speculative loser's entry fails the status check here
+                # (the winner already moved it to WRITTEN) and is
+                # skipped without any state change — first-commit-wins
                 if (d["status"] not in (Status.RUNNING, Status.FINISHED)
-                        or d["worker"] != worker):
+                        or not self._owner_ok(d, worker)):
                     continue       # claim lost: the new claimant owns it
                 if times is not None:
                     d["times"] = dict(times)
@@ -396,7 +462,7 @@ class MemJobStore(JobStore):
                     continue
                 d = queue[job_id]
                 if d["status"] in (Status.RUNNING, Status.FINISHED) \
-                        and d["worker"] == worker:
+                        and self._owner_ok(d, worker):
                     d["hb_time"] = now
                     n += 1
             return n
@@ -411,11 +477,13 @@ class MemJobStore(JobStore):
             d = queue[job_id]
             if expect is not None and d["status"] not in expect:
                 return False
-            if expect_worker is not None and d["worker"] != expect_worker:
+            if expect_worker is not None \
+                    and not self._owner_ok(d, expect_worker):
                 return False
             if status == Status.BROKEN:
                 d["repetitions"] += 1
             d["status"] = status
+            self._clear_spec_on_unlease(d, status)
             return True
 
     def get_job(self, ns, job_id):
@@ -461,6 +529,10 @@ class MemJobStore(JobStore):
                         d["started_time"] is not None and live < cutoff):
                     d["status"] = Status.BROKEN
                     d["repetitions"] += 1
+                    # requeue dissolves any shadow lease (clone beats
+                    # count as liveness — reaching here means BOTH
+                    # holders went silent)
+                    self._clear_spec_on_unlease(d, Status.BROKEN)
                     n += 1
             return n
 
@@ -472,9 +544,63 @@ class MemJobStore(JobStore):
                 return False
             d = queue[job_id]
             if d["status"] not in (Status.RUNNING, Status.FINISHED) \
-                    or d["worker"] != worker:
+                    or not self._owner_ok(d, worker):
                 return False
             d["hb_time"] = now
+            return True
+
+    # -- duplicate leases (speculative execution, DESIGN §21) --------------
+
+    def speculate(self, ns, job_id):
+        self._bump("commit")
+        with self._lock:
+            queue = self._jobs.get(ns, [])
+            if not (0 <= job_id < len(queue)):
+                return False
+            d = queue[job_id]
+            if d["status"] != Status.RUNNING or d.get("spec_state"):
+                return False
+            d["spec_state"] = 1
+            d["spec_worker"] = None
+            return True
+
+    def claim_spec(self, ns, worker):
+        from lua_mapreduce_tpu.coord.filestore import worker_hash
+        from lua_mapreduce_tpu.coord.idx_py import worker_tag
+        my_tag = worker_tag(worker_hash(worker))
+        self._bump("claim")
+        with self._lock:
+            queue = self._jobs.get(ns, [])
+            candidates = [d for d in queue
+                          if d["status"] == Status.RUNNING
+                          and d.get("spec_state") == 1
+                          and d["worker"] != worker]
+            ordered = ([d for d in candidates
+                        if worker_tag(worker_hash(d["worker"])) != my_tag]
+                       + [d for d in candidates
+                          if worker_tag(worker_hash(d["worker"])) == my_tag])
+            for d in ordered[:1]:
+                d["spec_state"] = 2
+                d["spec_worker"] = worker
+                doc = dict(d)
+                doc["speculative"] = True
+                return doc
+            return None
+
+    def cancel_spec(self, ns, job_id, worker):
+        self._bump("commit")
+        with self._lock:
+            queue = self._jobs.get(ns, [])
+            if not (0 <= job_id < len(queue)):
+                return False
+            d = queue[job_id]
+            if worker is not None:
+                if d.get("spec_state") != 2 or d.get("spec_worker") != worker:
+                    return False
+            elif not d.get("spec_state"):
+                return False
+            d["spec_state"] = 0
+            d["spec_worker"] = None
             return True
 
     def drop_ns(self, ns):

@@ -13,16 +13,21 @@
 // is what makes worker failure recovery sound with no lease machinery.
 //
 // Layout (little-endian, matching idx_py.py):
-//   header: char magic[8] = "JSIX0002"; int64 count;
+//   header: char magic[8] = "JSIX0003"; int64 count;
 //   record: int32 status; int32 repetitions; int64 worker; double started;
 //           double reserved;   // reserved = last heartbeat time
 //                              // (0.0 = never beaten)
 //           double times[5];   // job times (started, finished, written,
 //                              // cpu, real); all-zero = not recorded.
-//                              // 72 bytes total. JSIX0002 embeds the
+//           int64 spec_worker; // shadow-lease holder (duplicate lease)
+//           int32 spec_state;  // 0 none | 1 open | 2 taken
+//           int32 spec_pad;    // reserved (alignment)
+//                              // 88 bytes total. JSIX0002 embedded the
 //                              // times so a batch commit retires status
-//                              // AND timing in one flock cycle (the v1
-//                              // sidecar was a tempfile+rename per job).
+//                              // AND timing in one flock cycle; JSIX0003
+//                              // adds the duplicate-lease fields so the
+//                              // first-commit-wins arbitration is one
+//                              // CAS under the same flock (DESIGN §21).
 
 #include <cstdint>
 #include <cstring>
@@ -35,9 +40,9 @@
 
 namespace {
 
-constexpr char kMagic[8] = {'J', 'S', 'I', 'X', '0', '0', '0', '2'};
+constexpr char kMagic[8] = {'J', 'S', 'I', 'X', '0', '0', '0', '3'};
 constexpr int64_t kHeaderSize = 16;
-constexpr int64_t kRecordSize = 72;
+constexpr int64_t kRecordSize = 88;
 constexpr int kNTimes = 5;
 
 // Status values mirror core/constants.py (reference utils.lua:33-40).
@@ -52,6 +57,13 @@ enum Status : int32_t {
 
 constexpr uint32_t kClaimMask = (1u << kWaiting) | (1u << kBroken);
 
+// spec_state values (DESIGN §21), mirrored by coord/idx_py.py
+enum SpecState : int32_t {
+  kSpecNone = 0,
+  kSpecOpen = 1,   // straggler detector marked: shadow lease claimable
+  kSpecTaken = 2,  // spec_worker holds the shadow lease
+};
+
 #pragma pack(push, 1)
 struct Header {
   char magic[8];
@@ -64,6 +76,9 @@ struct Record {
   double started;
   double reserved;
   double times[kNTimes];
+  int64_t spec_worker;
+  int32_t spec_state;
+  int32_t spec_pad;
 };
 #pragma pack(pop)
 
@@ -132,6 +147,31 @@ class LockedIndex {
   int fd_;
 };
 
+// the duplicate-lease ownership rule (DESIGN §21): the claimant owns
+// the record, and while a shadow lease is TAKEN so does the speculative
+// worker — either may land the ONE commit; the status CAS arbitrates
+// first-commit-wins under the flock.
+bool owner_ok(const Record& rec, int64_t expect_worker) {
+  if (rec.worker == expect_worker) return true;
+  return rec.spec_state == kSpecTaken && rec.spec_worker == expect_worker;
+}
+
+// placement tag of a worker from its stable name hash (the fleet-side
+// twin of engine/placement.py's 8 virtual failure domains; unsigned so
+// Python and C++ agree on negative hashes)
+uint64_t worker_tag(int64_t worker) { return (uint64_t)worker % 8; }
+
+// leaving the leased states (release/requeue) dissolves any shadow
+// lease: a re-claimed job must never be committable by a stale
+// speculative worker.
+void clear_spec_on_unlease(Record* rec, int32_t to) {
+  if (to == kWaiting || to == kBroken) {
+    rec->spec_worker = 0;
+    rec->spec_state = kSpecNone;
+    rec->spec_pad = 0;
+  }
+}
+
 double now_seconds() {
   struct timespec ts;
   clock_gettime(CLOCK_REALTIME, &ts);
@@ -170,7 +210,7 @@ int64_t jsx_insert(const char* path, int64_t n) {
   if (!idx.ok()) return -1;
   int64_t count = idx.count();  // 0 for a freshly created empty file
   if (count < 0) return -1;
-  Record rec{kWaiting, 0, 0, 0.0, 0.0, {}};
+  Record rec{kWaiting, 0, 0, 0.0, 0.0, {}, 0, kSpecNone, 0};
   for (int64_t i = 0; i < n; ++i) {
     if (!idx.write(count + i, rec)) return -1;
   }
@@ -230,8 +270,11 @@ int64_t jsx_claim_batch(const char* path, int64_t worker,
     rec.status = kRunning;
     rec.worker = worker;
     rec.started = now;
-    rec.reserved = 0.0;  // fresh claim: fresh silence clock AND fresh
-    for (int t = 0; t < kNTimes; ++t) rec.times[t] = 0.0;  // times
+    rec.reserved = 0.0;  // fresh claim: fresh silence clock, fresh
+    for (int t = 0; t < kNTimes; ++t) rec.times[t] = 0.0;  // times,
+    rec.spec_worker = 0;                 // and no carried shadow lease
+    rec.spec_state = kSpecNone;
+    rec.spec_pad = 0;
     if (!idx.write(id, rec)) return false;
     ++taken;
     return true;
@@ -262,9 +305,10 @@ int jsx_cas_status(const char* path, int64_t id, int32_t to,
   Record rec;
   if (!idx.read(id, &rec)) return -1;
   if (expect_mask && !((1u << rec.status) & expect_mask)) return 0;
-  if (expect_worker != 0 && rec.worker != expect_worker) return 0;
+  if (expect_worker != 0 && !owner_ok(rec, expect_worker)) return 0;
   if (to == kBroken) rec.repetitions += 1;
   rec.status = to;
+  clear_spec_on_unlease(&rec, to);
   return idx.write(id, rec) ? 1 : -1;
 }
 
@@ -288,9 +332,10 @@ int64_t jsx_cas_status_batch(const char* path, const int64_t* ids, int64_t n,
     if (id < 0 || id >= count) continue;
     if (!idx.read(id, &rec)) return -1;
     if (expect_mask && !((1u << rec.status) & expect_mask)) continue;
-    if (expect_worker != 0 && rec.worker != expect_worker) continue;
+    if (expect_worker != 0 && !owner_ok(rec, expect_worker)) continue;
     if (to == kBroken) rec.repetitions += 1;
     rec.status = to;
+    clear_spec_on_unlease(&rec, to);
     if (!idx.write(id, rec)) return -1;
     ok_out[i] = 1;
     ++landed;
@@ -319,8 +364,10 @@ int64_t jsx_commit_batch(const char* path, const int64_t* ids, int64_t n,
     const int64_t id = ids[i];
     if (id < 0 || id >= count) continue;
     if (!idx.read(id, &rec)) return -1;
+    // first-commit-wins: WRITTEN fails this status check, so the
+    // losing duplicate's entry is skipped without any state change
     if (rec.status != kRunning && rec.status != kFinished) continue;
-    if (worker != 0 && rec.worker != worker) continue;
+    if (worker != 0 && !owner_ok(rec, worker)) continue;
     rec.status = kWritten;
     for (int t = 0; t < kNTimes; ++t) rec.times[t] = times[i * kNTimes + t];
     if (!idx.write(id, rec)) return -1;
@@ -345,10 +392,11 @@ int jsx_set_times(const char* path, int64_t id, const double* times5) {
 }
 
 // Read one record (times5 gets the 5 job times; all-zero = none
-// recorded). Returns 1 on success, 0 if out of bounds, -1 on error.
+// recorded; spec_state/spec_worker describe any duplicate lease).
+// Returns 1 on success, 0 if out of bounds, -1 on error.
 int jsx_get(const char* path, int64_t id, int32_t* status,
             int32_t* repetitions, int64_t* worker, double* started,
-            double* times5) {
+            double* times5, int32_t* spec_state, int64_t* spec_worker) {
   if (access(path, F_OK) != 0) return 0;
   LockedIndex idx(path, false);
   if (!idx.ok()) return -1;
@@ -360,6 +408,8 @@ int jsx_get(const char* path, int64_t id, int32_t* status,
   *worker = rec.worker;
   *started = rec.started;
   for (int t = 0; t < kNTimes; ++t) times5[t] = rec.times[t];
+  *spec_state = rec.spec_state;
+  *spec_worker = rec.spec_worker;
   return 1;
 }
 
@@ -398,6 +448,7 @@ int64_t jsx_requeue_stale(const char* path, double cutoff) {
         live < cutoff) {
       rec.status = kBroken;
       rec.repetitions += 1;
+      clear_spec_on_unlease(&rec, kBroken);
       if (!idx.write(id, rec)) return -1;
       ++n;
     }
@@ -418,7 +469,7 @@ int jsx_heartbeat(const char* path, int64_t id, int64_t worker, double now) {
   Record rec;
   if (!idx.read(id, &rec)) return -1;
   if (rec.status != kRunning && rec.status != kFinished) return 0;
-  if (worker != 0 && rec.worker != worker) return 0;
+  if (worker != 0 && !owner_ok(rec, worker)) return 0;
   rec.reserved = now;
   return idx.write(id, rec) ? 1 : -1;
 }
@@ -440,7 +491,7 @@ int64_t jsx_heartbeat_batch(const char* path, const int64_t* ids, int64_t n,
     if (id < 0 || id >= count) continue;
     if (!idx.read(id, &rec)) return -1;
     if (rec.status != kRunning && rec.status != kFinished) continue;
-    if (worker != 0 && rec.worker != worker) continue;
+    if (worker != 0 && !owner_ok(rec, worker)) continue;
     rec.reserved = now;
     if (!idx.write(id, rec)) return -1;
     ++landed;
@@ -452,6 +503,7 @@ int64_t jsx_heartbeat_batch(const char* path, const int64_t* ids, int64_t n,
 // state in one locked pass. Returns the number filled, or -1 on error.
 int64_t jsx_snapshot(const char* path, int32_t* statuses, int32_t* reps,
                      int64_t* workers, double* started, double* times,
+                     int32_t* spec_states, int64_t* spec_workers,
                      int64_t cap) {
   if (access(path, F_OK) != 0) return 0;
   LockedIndex idx(path, false);
@@ -468,6 +520,8 @@ int64_t jsx_snapshot(const char* path, int32_t* statuses, int32_t* reps,
     started[id] = rec.started;
     for (int t = 0; t < kNTimes; ++t)
       times[id * kNTimes + t] = rec.times[t];
+    spec_states[id] = rec.spec_state;
+    spec_workers[id] = rec.spec_worker;
   }
   return count;
 }
@@ -489,6 +543,88 @@ int64_t jsx_scavenge(const char* path, int32_t max_retries) {
     }
   }
   return n;
+}
+
+// -- duplicate leases (speculative execution, DESIGN §21) -------------------
+
+// Mark a RUNNING record speculation-OPEN (a shadow lease may be taken by
+// jsx_claim_spec). CASed on (RUNNING, no existing speculation) so the
+// detector's repeated passes are idempotent and a job carries at most ONE
+// shadow lease. Returns 1 landed, 0 refused, -1 on error.
+int jsx_speculate(const char* path, int64_t id) {
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  if (id < 0 || id >= idx.count()) return 0;
+  Record rec;
+  if (!idx.read(id, &rec)) return -1;
+  if (rec.status != kRunning || rec.spec_state != kSpecNone) return 0;
+  rec.spec_worker = 0;
+  rec.spec_state = kSpecOpen;
+  return idx.write(id, rec) ? 1 : -1;
+}
+
+// Take ONE speculation-open shadow lease for `worker`. A worker never
+// shadows its own job; records whose claimant sits on a DIFFERENT
+// placement tag are preferred, lowest id first within each preference
+// class (same scan order as the Python engine). Fills *out_reps;
+// returns the job id, -1 when nothing is open, or -2 on IO error —
+// "no lease" and "the index is broken" must stay distinguishable, or
+// speculation dies silently on a sick disk.
+int64_t jsx_claim_spec(const char* path, int64_t worker, int32_t* out_reps) {
+  if (access(path, F_OK) != 0) return -1;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -2;
+  std::vector<Record> recs;
+  if (!idx.read_all(&recs)) return -2;
+  const uint64_t my_tag = worker_tag(worker);
+  int64_t fallback = -1;
+  for (int64_t id = 0; id < (int64_t)recs.size(); ++id) {
+    const Record& rec = recs[(size_t)id];
+    if (rec.status != kRunning || rec.spec_state != kSpecOpen ||
+        rec.worker == worker)
+      continue;
+    if (worker_tag(rec.worker) != my_tag) {
+      Record take = rec;
+      take.spec_worker = worker;
+      take.spec_state = kSpecTaken;
+      if (!idx.write(id, take)) return -2;
+      *out_reps = take.repetitions;
+      return id;
+    }
+    if (fallback < 0) fallback = id;
+  }
+  if (fallback >= 0) {
+    Record take = recs[(size_t)fallback];
+    take.spec_worker = worker;
+    take.spec_state = kSpecTaken;
+    if (!idx.write(fallback, take)) return -2;
+    *out_reps = take.repetitions;
+    return fallback;
+  }
+  return -1;
+}
+
+// Dissolve a shadow lease `worker` holds — the loser / failure path; the
+// job's status and repetitions are never touched (the original claimant
+// still owns the lease). worker == 0 clears any OPEN or TAKEN speculation
+// (the detector's retraction). Returns 1 cleared, 0 refused, -1 on error.
+int jsx_cancel_spec(const char* path, int64_t id, int64_t worker) {
+  if (access(path, F_OK) != 0) return 0;
+  LockedIndex idx(path, false);
+  if (!idx.ok()) return -1;
+  if (id < 0 || id >= idx.count()) return 0;
+  Record rec;
+  if (!idx.read(id, &rec)) return -1;
+  if (worker != 0) {
+    if (rec.spec_state != kSpecTaken || rec.spec_worker != worker) return 0;
+  } else if (rec.spec_state == kSpecNone) {
+    return 0;
+  }
+  rec.spec_worker = 0;
+  rec.spec_state = kSpecNone;
+  rec.spec_pad = 0;
+  return idx.write(id, rec) ? 1 : -1;
 }
 
 }  // extern "C"

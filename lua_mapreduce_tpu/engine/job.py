@@ -99,8 +99,16 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
 
     Mirrors job.lua:154-228: run user mapfn with the grouping emit; sort
     keys; apply combiner per key; route keys through partitionfn; write one
-    atomic file per non-empty partition; remove any stale file first (the
-    re-run / iteration case, job.lua:217-221).
+    atomic file per non-empty partition. The reference removes any stale
+    file first (job.lua:217-221); here every ``build`` is an atomic
+    OVERWRITING publish on every backend, so the remove is dropped — a
+    remove-then-build pair opens a window where the run file is missing,
+    and under speculative execution (DESIGN §21) a disowned straggler
+    finishing late would routinely open that window while the winner's
+    reduce is already reading the name. Overwrite-in-place means readers
+    always see a complete file (and duplicate executions write identical
+    bytes: job inputs and user functions are deterministic — the
+    assumption the whole golden-diff matrix already leans on).
 
     ``segment_format`` picks the run-file encoding — ``"v1"`` text lines
     or ``"v2"`` framed binary segments (core/segment.py) — negotiated via
@@ -156,9 +164,7 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
             w.add(key, values)
 
         for part, w in writers.items():
-            name = map_output_name(spec.result_ns, part, job_id)
-            store.remove(name)
-            w.build(name)
+            w.build(map_output_name(spec.result_ns, part, job_id))
     finally:
         # deterministic release of any unbuilt builder (failed user code
         # / partitionfn): writer threads, fds, and tempfiles must not
@@ -218,7 +224,8 @@ def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
                 merged = merge_iterator(store, run_files)
             for key, values in merged:
                 writer.add(key, values)
-            store.remove(spill_file)
+            # atomic overwriting publish — no remove-first (a vanish
+            # window a racing duplicate execution must never open)
             writer.build(spill_file)
         finally:
             writer.close()
@@ -291,7 +298,9 @@ def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
             builder.write(dump_record(key, [reduced]) + "\n")
         times.finished = time.time()
 
-        result_store.remove(result_file)
+        # atomic overwriting publish — no remove-first: a disowned
+        # duplicate (speculation / stale requeue) finishing late must
+        # never make the partition result vanish under a running finalfn
         builder.build(result_file)
     finally:
         builder.close()

@@ -215,6 +215,14 @@ class LocalExecutor:
         it_stats.replica_repairs = fd.get("replica_repairs", 0)
         it_stats.map_reruns_avoided = fd.get("map_reruns_avoided", 0)
         it_stats.map_reruns = fd.get("map_reruns", 0)
+        # speculation accounting (DESIGN §21): the in-process executor
+        # has no control plane to speculate over, but an in-process
+        # WORKER pool sharing this process's counters does — fold the
+        # same fields so both engines report one schema
+        it_stats.spec_launched = fd.get("spec_launched", 0)
+        it_stats.spec_wins = fd.get("spec_wins", 0)
+        it_stats.spec_cancelled = fd.get("spec_cancelled", 0)
+        it_stats.spec_wasted_s = float(fd.get("spec_wasted_s", 0.0))
         it_stats.wall_time = time.time() - t0
         self.stats.iterations.append(it_stats)
         return verdict

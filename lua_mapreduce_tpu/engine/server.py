@@ -38,6 +38,23 @@ from lua_mapreduce_tpu.utils.stats import (IterationStats, TaskStats,
                                            overlap_fraction)
 
 
+def resolve_speculation(arg) -> float:
+    """The speculation knob's shared resolution order: explicit
+    argument, else ``LMR_SPECULATION`` env, else 0 (off). The value is
+    the straggler FACTOR: a RUNNING job older than ``factor × fleet
+    duration EWMA`` gets a speculative duplicate lease (DESIGN §21).
+    Factors below 1 would clone jobs younger than a typical job —
+    pure waste — and are rejected."""
+    if arg is None:
+        import os
+        arg = os.environ.get("LMR_SPECULATION") or 0
+    f = float(arg)
+    if f and f < 1.0:
+        raise ValueError(f"speculation factor {f} < 1 would clone jobs "
+                         "younger than the typical job duration")
+    return f
+
+
 class PhaseFailed(RuntimeError):
     """A phase completed with FAILED jobs while the server ran in strict
     mode. The reference proceeds to finalfn on partial results
@@ -88,6 +105,17 @@ class Server:
     requeueing the producing map job only when every copy is gone.
     Written to the task doc as the fleet default, like
     ``segment_format``; r=1 is byte-identical to the unreplicated path.
+
+    ``speculation`` (DESIGN §21; None = ``LMR_SPECULATION`` env, else 0
+    = off) is the straggler factor: every housekeeping pass compares
+    each RUNNING job's age against the fleet per-namespace duration
+    EWMA (folded from the workers onto the task doc) and opens a
+    speculative DUPLICATE lease on jobs older than ``factor × EWMA`` —
+    at most ``speculation_cap`` live clones per namespace. Idle workers
+    clone the job; the first commit wins (the loser's commit degrades
+    to a zero-repetition no-op), so one degraded machine stops setting
+    the barrier's wall clock. Safe because spill publishes are
+    idempotent; byte-identical output is the chaos suite's gate.
     """
 
     def __init__(self, store: JobStore, poll_interval: float = DEFAULT_SLEEP,
@@ -96,7 +124,9 @@ class Server:
                  pipeline: bool = False, premerge_min_runs: int = 4,
                  premerge_max_runs: int = 8, batch_k: int = 1,
                  segment_format: str = "v1",
-                 replication: Optional[int] = None):
+                 replication: Optional[int] = None,
+                 speculation: Optional[float] = None,
+                 speculation_cap: int = 2):
         # coord RPCs ride the transient-fault retry layer (DESIGN §19);
         # the scavenge/requeue/drain housekeeping must not abort an
         # iteration over one store blip
@@ -128,6 +158,12 @@ class Server:
         # written to the task doc like segment_format
         from lua_mapreduce_tpu.engine.placement import resolve_replication
         self.replication = resolve_replication(replication)
+        # speculative execution (DESIGN §21): the straggler factor (0 =
+        # off) and the per-namespace live-clone cap, task-doc deployed —
+        # workers gate their clone-claim probe on the doc marker, so an
+        # unspeculative fleet pays zero extra round trips
+        self.speculation = resolve_speculation(speculation)
+        self.speculation_cap = max(1, int(speculation_cap))
         self.spec: Optional[TaskSpec] = None
         self.stats = TaskStats()
         self.finished_value: Any = None
@@ -135,6 +171,8 @@ class Server:
         self._data_store = None        # intermediate store (recovery path)
         self._map_ids: Optional[Dict[str, int]] = None  # map key -> jid
         self._spill_repairs: Dict[str, tuple] = {}  # spill -> (part, a, b)
+        self._spec_taken_at: Dict[tuple, float] = {}  # (ns, jid) -> seen
+        self._spec_scan_at: Dict[str, float] = {}     # ns -> last scan
 
     # -- configuration ------------------------------------------------------
 
@@ -228,7 +266,8 @@ class Server:
                     "pipeline": self.pipeline,
                     "batch_k": self.batch_k,
                     "segment_format": self.segment_format,
-                    "replication": self.replication})
+                    "replication": self.replication,
+                    "speculation": self.speculation})
                 if status == TaskStatus.REDUCE.value:
                     skip_map = True
         if self.spec is None:
@@ -251,6 +290,9 @@ class Server:
                 # the fleet's shuffle replication factor (workers with
                 # no explicit replication follow this — DESIGN §20)
                 "replication": self.replication,
+                # the straggler factor (DESIGN §21): nonzero makes idle
+                # workers probe for speculative duplicate leases
+                "speculation": self.speculation,
                 "started": time.time(),
             })
 
@@ -266,6 +308,8 @@ class Server:
 
         while True:
             self._spill_repairs.clear()
+            self._spec_taken_at.clear()
+            self._spec_scan_at.clear()
             self._map_ids = None
             it_stats = IterationStats(iteration=iteration)
             it_t0 = time.time()
@@ -321,6 +365,10 @@ class Server:
             it_stats.replica_repairs = fd.get("replica_repairs", 0)
             it_stats.map_reruns_avoided = fd.get("map_reruns_avoided", 0)
             it_stats.map_reruns = fd.get("map_reruns", 0)
+            it_stats.spec_launched = fd.get("spec_launched", 0)
+            it_stats.spec_wins = fd.get("spec_wins", 0)
+            it_stats.spec_cancelled = fd.get("spec_cancelled", 0)
+            it_stats.spec_wasted_s = float(fd.get("spec_wasted_s", 0.0))
             it_stats.wall_time = time.time() - it_t0
             self.stats.iterations.append(it_stats)
             self.store.update_task({"stats": it_stats.as_dict()})
@@ -431,6 +479,8 @@ class Server:
             self.store.scavenge(ns, MAX_JOB_RETRIES)
             if self.stale_timeout_s is not None:
                 self.store.requeue_stale(ns, self.stale_timeout_s)
+            if self.speculation:
+                self._speculate_stragglers(ns)
         lost: List[str] = []
         for err in self.store.drain_errors():
             # the drain is destructive — always retain for diagnosis,
@@ -444,6 +494,84 @@ class Server:
                 self._recover_lost(sorted(set(lost)))
             if self._spill_repairs:
                 self._settle_spill_repairs()
+
+    # -- straggler detection (speculative execution, DESIGN §21) ------------
+
+    def _speculate_stragglers(self, ns: str) -> None:
+        """Open speculative duplicate leases on RUNNING jobs whose age
+        exceeds ``speculation × fleet-EWMA`` for this namespace — the
+        detector half of the speculation layer (the commit race and
+        revocation live in Worker.run_one). The EWMA is the task doc's
+        fleet aggregate, folded there by the workers at lease end
+        (DESIGN §21): a cold fleet (no commits yet) speculates nothing,
+        so the detector can never misfire on a phase whose jobs are
+        legitimately all long. At most ``speculation_cap`` clones live
+        per namespace; oldest stragglers first; ``speculate``'s CAS
+        makes repeated passes over the same job idempotent.
+
+        The detector also RETRACTS abandoned shadow leases: a TAKEN
+        lease whose job is still RUNNING ``threshold`` after the
+        detector first saw it taken means the clone died (a healthy
+        clone finishes in ~one EWMA) — clear it (``cancel_spec`` with
+        no holder) so the straggler can be re-cloned instead of a dead
+        clone pinning the cap forever. Retracting a merely-slow LIVE
+        clone is benign: its commit then fails the ownership CAS and
+        degrades to the normal zero-charge loser path.
+
+        Scans are throttled to ~a quarter of the detection threshold:
+        jobs() materializes payload copies, and a per-poll scan would
+        turn the index-only housekeeping pass into a full-payload one."""
+        counts = self.store.counts(ns)
+        if not counts[Status.RUNNING]:
+            return
+        task = self.store.get_task() or {}
+        ewma = task.get(f"dur_ewma:{ns}")
+        if not ewma or ewma <= 0:
+            return
+        threshold = self.speculation * ewma
+        now = time.time()
+        last = self._spec_scan_at.get(ns)
+        if last is not None and now - last < threshold / 4:
+            return
+        self._spec_scan_at[ns] = now
+        running = [d for d in self.store.jobs(ns)
+                   if d["status"] == Status.RUNNING]
+        taken = {d["_id"] for d in running if d.get("spec_state") == 2}
+        for key in [k for k in self._spec_taken_at
+                    if k[0] == ns and k[1] not in taken]:
+            self._spec_taken_at.pop(key)      # resolved: forget
+        active = 0
+        for d in running:
+            if not d.get("spec_state"):
+                continue
+            first = self._spec_taken_at.setdefault((ns, d["_id"]), now) \
+                if d["spec_state"] == 2 else None
+            if first is not None and now - first > threshold \
+                    and self.store.cancel_spec(ns, d["_id"], None):
+                COUNTERS.bump("spec_cancelled")
+                self._spec_taken_at.pop((ns, d["_id"]), None)
+                self._log(f"straggler: {ns} job {d['_id']} shadow lease "
+                          "abandoned (clone silent past the threshold) "
+                          "— retracted for re-cloning")
+                d["spec_state"] = 0
+                continue
+            active += 1
+        budget = self.speculation_cap - active
+        if budget <= 0:
+            return
+        overdue = sorted(
+            (d for d in running
+             if not d.get("spec_state") and d.get("started_time")
+             and now - d["started_time"] > threshold),
+            key=lambda d: d["started_time"])
+        for d in overdue[:budget]:
+            if self.store.speculate(ns, d["_id"]):
+                COUNTERS.bump("spec_launched")
+                self._log(
+                    f"straggler: {ns} job {d['_id']} RUNNING "
+                    f"{now - d['started_time']:.2f}s > "
+                    f"{self.speculation:g}x EWMA {ewma:.3f}s — "
+                    "speculative duplicate lease opened")
 
     # -- replica-aware recovery (DESIGN §20) --------------------------------
 

@@ -56,6 +56,14 @@ _CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases",
 # must shrink the next lease quickly)
 _DUR_ALPHA = 0.3
 
+# blend weight for folding THIS worker's duration EWMA into the fleet
+# aggregate persisted on the task doc (DESIGN §21): each worker pulls
+# the doc value toward its own observation, so the aggregate tracks the
+# fleet median-ish without any coordination — and the straggler's own
+# slow observations are diluted by every healthy worker's folds, which
+# is exactly what keeps the detector's threshold honest
+_FLEET_ALPHA = 0.3
+
 
 class Worker:
     """Claim-and-execute loop (reference worker.lua:42-138)."""
@@ -111,6 +119,9 @@ class Worker:
         self.replication = None
         self._task_replication = None           # last task doc's value
         self._dur_ewma: Dict[str, float] = {}   # ns -> smoothed real secs
+        self._fleet_ewma: Dict[str, float] = {}  # last task-doc aggregate
+        self._ewma_pushed: Dict[str, float] = {}  # ns -> last value pushed
+        self._speculation = 0.0          # task-doc factor (0 = off)
         self._spec_cache: Dict[str, TaskSpec] = {}
         self._infra_released: Dict[tuple, int] = {}  # (ns, jid) -> count
         self._release_gen = None        # (task spec, iteration) the
@@ -166,6 +177,19 @@ class Worker:
             self._infra_released.clear()
         self._task_segment_format = task.get("segment_format")
         self._task_replication = task.get("replication")
+        self._speculation = float(task.get("speculation") or 0.0)
+        # fleet duration aggregate (DESIGN §21): remember the doc's
+        # values for the persist blend, and SEED this worker's own EWMA
+        # from them — a fresh worker starts with calibrated adaptive
+        # batch sizing instead of probing cold with k=1. One FLAT task
+        # doc key per namespace ("dur_ewma:<ns>"): update_task merges
+        # top-level keys, so concurrent workers folding different
+        # namespaces can never revert each other's aggregate
+        self._fleet_ewma = {k.split(":", 1)[1]: v for k, v in task.items()
+                            if k.startswith("dur_ewma:")}
+        for ns_key, val in self._fleet_ewma.items():
+            if ns_key not in self._dur_ewma and val and val > 0:
+                self._dur_ewma[ns_key] = float(val)
 
         if task["status"] == TaskStatus.MAP.value:
             if "map" in self.phases:
@@ -192,6 +216,22 @@ class Worker:
                     self._idle_count = 0
                     self._execute_batch(spec, PRE_NS, jobs)
                     return "executed"
+            # speculative duplicate leases (DESIGN §21): only a worker
+            # with NOTHING claimable reaches here, so clones never
+            # steal capacity from unstarted jobs. Gated on the task-doc
+            # marker: speculation-off deployments pay zero extra claim
+            # round trips per idle poll.
+            if self._speculation:
+                for spec_ns, phase in ((MAP_NS, "map"), (PRE_NS, "reduce")):
+                    if phase not in self.phases:
+                        continue
+                    if spec_ns == PRE_NS and not task.get("pipeline"):
+                        continue
+                    clone = self.store.claim_spec(spec_ns, self.name)
+                    if clone is not None:
+                        self._idle_count = 0
+                        self.run_one(spec, spec_ns, clone)
+                        return "executed"
             if "map" not in self.phases:
                 return "out-of-phase"
             self._idle_count += 1
@@ -228,6 +268,11 @@ class Worker:
                 if jobs:
                     self._execute_batch(spec, RED_NS, jobs)
                     return "executed"
+                if self._speculation:
+                    clone = self.store.claim_spec(RED_NS, self.name)
+                    if clone is not None:
+                        self.run_one(spec, RED_NS, clone)
+                        return "executed"
             if "reduce" not in self.phases:
                 return "out-of-phase"
             return "idle"
@@ -269,7 +314,8 @@ class Worker:
     # -- job execution ------------------------------------------------------
 
     @contextlib.contextmanager
-    def _beating(self, ns: str, jids: List[int]):
+    def _beating(self, ns: str, jids: List[int],
+                 revoked: Optional[threading.Event] = None):
         """Heartbeat every leased job every ``heartbeat_s`` seconds from
         ONE daemon thread while the (blocking, user-code) job bodies run —
         a batch lease gets a single beat thread, not one per job, and
@@ -277,7 +323,16 @@ class Worker:
         effort: a failed beat is ignored — the CAS ownership checks keep
         correctness; the beat only prevents WASTEFUL requeues of live
         long jobs. Jobs the batch already committed simply miss (they
-        left the RUNNING|FINISHED states)."""
+        left the RUNNING|FINISHED states).
+
+        ``revoked`` (DESIGN §21), when given, is SET the moment a beat
+        lands on fewer jobs than the lease holds — the cheap
+        lease-revocation signal: some lease member left the leased
+        states under this worker (a speculative duplicate committed it
+        first, or the scavenger intervened). The executor checks it
+        between job bodies so a loser stops burning work it can no
+        longer commit; no extra RPC — the signal rides the beats the
+        lease already pays for."""
         if not self.heartbeat_s:
             yield
             return
@@ -294,7 +349,9 @@ class Worker:
             delay = self.heartbeat_s
             while not stop.wait(delay):
                 try:
-                    self.store.heartbeat_batch(ns, jids, self.name)
+                    n = self.store.heartbeat_batch(ns, jids, self.name)
+                    if revoked is not None and n < len(jids):
+                        revoked.set()
                     if failures:
                         self._log(f"heartbeat recovered after "
                                   f"{failures} failure(s)")
@@ -431,8 +488,23 @@ class Worker:
         label = {MAP_NS: "map", PRE_NS: "pre_merge", RED_NS: "reduce"}[ns]
         jids = [j["_id"] for j in jobs]
         done: List[tuple] = []          # (jid, times_dict), commit order
-        with self._beating(ns, jids):
+        revoked = threading.Event()
+        skipped: List[int] = []
+        with self._beating(ns, jids, revoked=revoked):
             for pos, job in enumerate(jobs):
+                if pos and revoked.is_set() \
+                        and not self.store.heartbeat(ns, job["_id"],
+                                                     self.name):
+                    # lease-revocation probe (DESIGN §21): a beat came
+                    # up short, and THIS job's lease is confirmed gone —
+                    # a speculative duplicate committed it (or the
+                    # scavenger moved it on). Executing it anyway would
+                    # be pure wasted work; the commit CAS would refuse
+                    # it regardless. Only consulted after the beat
+                    # thread raised the flag, so the fault-free path
+                    # pays zero probes.
+                    skipped.append(job["_id"])
+                    continue
                 try:
                     times = body(self, spec, job)
                 except Exception as exc:
@@ -464,9 +536,129 @@ class Worker:
                              if len(jobs) > 1 else ""))
         committed = self.store.commit_batch(ns, self.name, done)
         self._settle_committed(ns, committed)
+        if committed:
+            # only WINNING observations calibrate the fleet aggregate:
+            # a straggler whose commits keep losing their races must
+            # not inflate the very EWMA the detector compares it
+            # against (its local _dur_ewma still learns, shrinking its
+            # own leases)
+            self._persist_ewma(ns)
         lost = len(done) - len(committed)
         if lost:
+            if self._speculation:
+                # with speculation on, a lost claim is (near-always) a
+                # lost first-commit-wins race: this worker WAS the
+                # straggler and a clone covered it. Book the discarded
+                # seconds on the same wasted-work ledger as losing
+                # clones — both sides of a race cost the same when they
+                # lose (DESIGN §21).
+                from lua_mapreduce_tpu.faults.retry import COUNTERS
+                won = set(committed)
+                COUNTERS.bump("spec_wasted_s",
+                              sum(t["real"] for jid, t in done
+                                  if jid not in won and t))
             self._log(f"{label}: {lost} claim(s) lost mid-lease; yielded")
+        if skipped:
+            self._log(f"{label}: {len(skipped)} leased job(s) revoked "
+                      "mid-lease (duplicate committed first); skipped")
+
+    # -- speculative execution (duplicate leases, DESIGN §21) ---------------
+
+    def run_one(self, spec: TaskSpec, ns: str, job: dict) -> bool:
+        """Execute ONE speculative clone of a straggler's job and race
+        its commit against the original — first-commit-wins. The clone
+        path differs from a lease in every failure edge: a clone that
+        loses the race, fails, or observes its revocation NEVER touches
+        the job's status or repetitions — it just dissolves its shadow
+        lease (cancel_spec) and walks away; the original still owns the
+        claim. Spill publishes inside the body are idempotent
+        (readback-verified, exists-short-circuited — DESIGN §19/§20),
+        which is what makes duplicate execution safe at all. Returns
+        True when this clone WON the commit race."""
+        jid = job["_id"]
+        label = {MAP_NS: "map", PRE_NS: "pre_merge", RED_NS: "reduce"}[ns]
+        revoked = threading.Event()
+        t0 = time.time()
+        times = None
+        try:
+            with self._beating(ns, [jid], revoked=revoked):
+                # pre-body revocation probe: the original may have
+                # committed between claim_spec and here — the beat
+                # doubles as the liveness refresh for the shared record
+                if not self.store.heartbeat(ns, jid, self.name):
+                    self._spec_lost(ns, jid, 0.0,
+                                    f"{label} clone {jid}: decided before "
+                                    "the body started")
+                    return False
+                times = body_times = self._BODIES[ns](self, spec, job)
+        except Exception as exc:
+            self._spec_lost(ns, jid, time.time() - t0,
+                            f"{label} clone {jid}: body failed "
+                            f"({type(exc).__name__}: {exc}) — original "
+                            "keeps the lease, nothing charged")
+            return False
+        if revoked.is_set():
+            # the beat thread observed the lease gone mid-body (the
+            # original won, or the detector retracted this clone):
+            # skip the commit RPC — it is guaranteed to miss
+            self._spec_lost(ns, jid, time.time() - t0,
+                            f"{label} clone {jid}: revoked mid-body "
+                            "(original won) — commit skipped")
+            return False
+        committed = self.store.commit_batch(ns, self.name,
+                                            [(jid, _times_dict(times))])
+        if committed:
+            from lua_mapreduce_tpu.faults.retry import COUNTERS
+            COUNTERS.bump("spec_wins")
+            self._note_duration(ns, body_times.real)
+            self._settle_committed(ns, committed)
+            self._persist_ewma(ns)
+            self._log(f"{label} clone {jid} WON the commit race "
+                      f"({body_times.real:.3f}s)")
+            return True
+        self._spec_lost(ns, jid, time.time() - t0,
+                        f"{label} clone {jid}: lost the commit race "
+                        "(original finished first)")
+        return False
+
+    def _spec_lost(self, ns: str, jid: int, wasted_s: float,
+                   msg: str) -> None:
+        """A clone that did not win: dissolve the shadow lease, book the
+        wasted seconds, touch nothing else (zero-repetition by
+        construction — no status op is ever issued)."""
+        from lua_mapreduce_tpu.faults.retry import COUNTERS
+        self.store.cancel_spec(ns, jid, self.name)
+        COUNTERS.bump("spec_cancelled")
+        if wasted_s > 0:
+            COUNTERS.bump("spec_wasted_s", wasted_s)
+        self._log(msg)
+
+    def _persist_ewma(self, ns: str) -> None:
+        """Fold this worker's per-namespace duration EWMA into the task
+        doc's fleet aggregate (DESIGN §21) so the server's straggler
+        detector and fresh workers are calibrated by live observations
+        instead of starting cold. Piggybacks on the lease-end commit
+        cadence and skips unchanged values (<10% drift), so the
+        fault-free control-plane cost is one extra update_task per
+        meaningful shift, not per lease."""
+        mine = self._dur_ewma.get(ns)
+        if mine is None or mine <= 0:
+            return
+        last = self._ewma_pushed.get(ns)
+        if last is not None and abs(mine - last) < 0.1 * last:
+            return
+        fleet = self._fleet_ewma.get(ns)
+        merged = (mine if not fleet
+                  else _FLEET_ALPHA * mine + (1 - _FLEET_ALPHA) * fleet)
+        try:
+            # ONE flat key — other namespaces' aggregates (possibly
+            # folded by other workers since this worker's last poll)
+            # are left untouched by the doc merge
+            self.store.update_task({f"dur_ewma:{ns}": merged})
+        except Exception:
+            return          # no task doc / store blip: purely advisory
+        self._fleet_ewma[ns] = merged
+        self._ewma_pushed[ns] = mine
 
     def _settle_committed(self, ns: str, committed: List[int]) -> None:
         """Book committed jobs: execution count + map affinity."""
@@ -578,6 +770,20 @@ class Worker:
         sleep = DEFAULT_SLEEP
         saw_work = False
         self._jobs_at_start = self.jobs_executed
+        # declare this thread's worker identity for the fault plane —
+        # the `slow` chaos kind routes its per-worker latency tax by it
+        # (faults/plan.py); cleared on exit so pooled threads don't
+        # inherit a stale name
+        from lua_mapreduce_tpu.faults.plan import set_current_worker
+        set_current_worker(self.name)
+        try:
+            return self._execute_loop(retries, infra_fails, idle_iters,
+                                      tasks_done, sleep, saw_work)
+        finally:
+            set_current_worker(None)
+
+    def _execute_loop(self, retries, infra_fails, idle_iters, tasks_done,
+                      sleep, saw_work) -> int:
         while idle_iters < self.max_iter and tasks_done < self.max_tasks:
             if (self.max_jobs is not None and
                     self.jobs_executed - self._jobs_at_start >= self.max_jobs):

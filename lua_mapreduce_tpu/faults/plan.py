@@ -28,13 +28,27 @@ Fault kinds (the failure modes the store/coord planes must survive):
                            clock window: the whole-failure-domain shape
                            ("all replicas on one backend died") the
                            replicated shuffle must absorb (DESIGN §20)
+- ``slow``               — every data-plane op by workers matching
+                           ``slow_worker`` sleeps ``slow_ms`` for a
+                           clock window: the DEGRADED-MACHINE shape
+                           (thermal throttle, sick disk, noisy
+                           neighbor) the speculative-execution layer
+                           must absorb (DESIGN §21). A latency
+                           multiplier in effect: an op that cost ε now
+                           costs ε + slow_ms, every time, only for the
+                           named worker — deterministic stragglers on
+                           demand
 
 ``max_per_key`` bounds the faults charged to one ``(op, name)`` stream,
-guaranteeing liveness under any retry budget (the blackout kind is
-bounded by its WINDOW instead — a dark failure domain fails every op,
-not a budgeted few). Plans serialize to a compact ``k=v;k=v`` spec so
-subprocess fleets inherit one through the ``LMR_FAULT_PLAN``
-environment variable (parsed by the router at store-wrap time).
+guaranteeing liveness under any retry budget (the blackout and slow
+kinds are bounded by their WINDOW instead — a dark failure domain fails
+every op and a sick machine slows every op, not a budgeted few). Plans
+serialize to a compact ``k=v;k=v`` spec so subprocess fleets inherit
+one through the ``LMR_FAULT_PLAN`` environment variable (parsed by the
+router at store-wrap time). The ``slow`` kind needs to know WHICH
+worker is executing: the worker runtime declares itself via
+:func:`set_current_worker` (a thread-local — worker threads in one
+process, one worker per process in subprocess fleets, both just work).
 """
 
 from __future__ import annotations
@@ -51,12 +65,15 @@ _KINDS = ("transient", "permanent", "latency", "torn", "error_after_write",
 # jobstore RPC op names (rate 'rpc_transient' applies; 'pattern' does not).
 # put_task/delete_task/drop_ns are idempotent on replay (overwrite /
 # tolerate-missing) — the server's inter-phase housekeeping must not
-# abort a whole task over one store blip any more than scavenge may
+# abort a whole task over one store blip any more than scavenge may.
+# speculate/cancel_spec are CASed idempotent (a replayed attempt reports
+# False); claim_spec shares claim_batch's non-replayable exclusion below.
 RPC_OPS = frozenset({
     "get_task", "put_task", "update_task", "delete_task", "drop_ns",
     "claim_batch", "commit_batch", "release_batch", "heartbeat",
     "heartbeat_batch", "set_job_status", "set_job_times", "counts",
     "scavenge", "requeue_stale", "insert_error", "drain_errors",
+    "speculate", "claim_spec", "cancel_spec",
 })
 
 # build-only kinds never apply to read ops and vice versa
@@ -70,6 +87,30 @@ _BUILD_KINDS = ("torn", "error_after_write")
 _BLACKOUT_OPS = frozenset({"lines", "read_range", "size", "exists",
                            "remove"})
 
+# ops a SLOW worker pays its latency tax on: the whole data plane a job
+# body touches — reads AND publishes AND listings (a sick machine is
+# slow at everything; unlike blackout, no tag routing is involved, so
+# list's pattern argument is as taxable as any name)
+_SLOW_OPS = frozenset({"lines", "read_range", "size", "exists", "remove",
+                       "build", "list"})
+
+# which worker is executing on THIS thread — the slow kind's routing
+# input. Worker.execute declares its name here (thread-local: in-process
+# pools run one worker per thread; subprocess fleets one per process);
+# server/executor threads never declare and are never slowed.
+_current_worker = threading.local()
+
+
+def set_current_worker(name: Optional[str]) -> None:
+    """Declare (or with None, clear) the worker identity executing on
+    this thread — consumed by the ``slow`` fault kind's per-worker
+    schedule."""
+    _current_worker.name = name
+
+
+def current_worker() -> Optional[str]:
+    return getattr(_current_worker, "name", None)
+
 
 class FaultPlan:
     """Seeded deterministic fault schedule over store/coord operations."""
@@ -82,6 +123,8 @@ class FaultPlan:
                  max_per_key: int = 2,
                  blackout_tag: Optional[int] = None,
                  blackout_s: float = 0.0, blackout_from_s: float = 0.0,
+                 slow_worker: Optional[str] = None, slow_ms: float = 0.0,
+                 slow_s: float = 0.0, slow_from_s: float = 0.0,
                  sleep=time.sleep, clock=time.monotonic):
         self.seed = int(seed)
         self.rates: Dict[str, float] = {
@@ -101,6 +144,14 @@ class FaultPlan:
                              else int(blackout_tag))
         self.blackout_s = float(blackout_s)
         self.blackout_from_s = float(blackout_from_s)
+        # slow: workers matching the ``slow_worker`` glob pay slow_ms of
+        # latency on every data-plane op inside the window
+        # [slow_from_s, slow_from_s + slow_s) — the deterministic
+        # straggler (DESIGN §21). Shares the blackout clock zero.
+        self.slow_worker = slow_worker or None
+        self.slow_ms = float(slow_ms)
+        self.slow_s = float(slow_s)
+        self.slow_from_s = float(slow_from_s)
         self._clock = clock
         self._t0: Optional[float] = None
         self._sleep = sleep
@@ -154,6 +205,23 @@ class FaultPlan:
                         self.fired["blackout"] = \
                             self.fired.get("blackout", 0) + 1
                         return "transient"
+            # slow, like blackout, before the per-key cap: a sick
+            # machine is slow at EVERY op for its window, never a
+            # budgeted few — and never charged to the cap (latency is
+            # not a fault the retry layer absorbs; liveness is the
+            # window). Routed by the executing WORKER, not the name:
+            # the thread-local identity the worker runtime declares.
+            if self.slow_worker is not None and op in _SLOW_OPS:
+                me = current_worker()
+                if me is not None and fnmatch.fnmatchcase(
+                        me, self.slow_worker):
+                    if self._t0 is None:
+                        self._t0 = self._clock()
+                    t = self._clock() - self._t0
+                    if (self.slow_from_s <= t
+                            < self.slow_from_s + self.slow_s):
+                        self.fired["slow"] = self.fired.get("slow", 0) + 1
+                        return "slow"
             if self._charged.get(key, 0) >= self.max_per_key:
                 return None
             u = self._uniform(op, name, k)
@@ -188,6 +256,13 @@ class FaultPlan:
         if self.latency_ms > 0:
             self._sleep(self.latency_ms / 1000.0)
 
+    def apply_slow(self) -> None:
+        """The slow kind's per-op latency tax (separate knob from
+        latency_ms — a plan can mix background jitter with one
+        deterministic straggler)."""
+        if self.slow_ms > 0:
+            self._sleep(self.slow_ms / 1000.0)
+
     def total_fired(self) -> int:
         with self._lock:
             return sum(self.fired.values())
@@ -208,6 +283,12 @@ class FaultPlan:
             parts.append(f"blackout_s={self.blackout_s:g}")
             if self.blackout_from_s:
                 parts.append(f"blackout_from_s={self.blackout_from_s:g}")
+        if self.slow_worker is not None:
+            parts.append(f"slow_worker={self.slow_worker}")
+            parts.append(f"slow_ms={self.slow_ms:g}")
+            parts.append(f"slow_s={self.slow_s:g}")
+            if self.slow_from_s:
+                parts.append(f"slow_from_s={self.slow_from_s:g}")
         return ";".join(parts)
 
     @classmethod
@@ -224,12 +305,13 @@ class FaultPlan:
             if not sep:
                 raise ValueError(f"bad fault-plan entry {part!r}")
             k = k.strip()
-            if k == "pattern":
+            if k in ("pattern", "slow_worker"):
                 kw[k] = v.strip()
             elif k in ("seed", "max_per_key", "blackout_tag"):
                 kw[k] = int(v)
             elif k in _KINDS or k in ("latency_ms", "blackout_s",
-                                      "blackout_from_s"):
+                                      "blackout_from_s", "slow_ms",
+                                      "slow_s", "slow_from_s"):
                 kw[k] = float(v)
             else:
                 raise ValueError(f"unknown fault-plan key {k!r}")
@@ -307,3 +389,31 @@ def utest() -> None:
     q2 = FaultPlan.from_spec(spec2)
     assert (q2.blackout_tag, q2.blackout_s, q2.blackout_from_s) == \
         (3, 0.25, 0.1)
+
+    # slow: only the matching worker pays the tax, only in the window,
+    # only on data-plane ops; deterministic and uncapped; spec round-trip
+    slept = []
+    vt2 = [0.0]
+    sl = FaultPlan(6, slow_worker="straggler-*", slow_ms=100.0, slow_s=4.0,
+                   clock=lambda: vt2[0], sleep=slept.append)
+    assert sl.decide("read_range", "f") is None       # no worker declared
+    set_current_worker("straggler-7")
+    try:
+        assert sl.decide("read_range", "f") == "slow"
+        assert sl.decide("build", "g") == "slow"      # publishes slowed too
+        assert sl.decide("claim_batch", "map_jobs") is None   # RPCs exempt
+        sl.apply_slow()
+        assert slept == [0.1]
+        set_current_worker("healthy-1")
+        assert sl.decide("read_range", "f") is None   # other workers lit
+        set_current_worker("straggler-7")
+        vt2[0] = 4.0                                  # window over
+        assert sl.decide("read_range", "f") is None
+        assert sl.fired["slow"] == 2
+    finally:
+        set_current_worker(None)
+    q3 = FaultPlan.from_spec(
+        FaultPlan(8, slow_worker="w-[0-9]", slow_ms=50, slow_s=2.5,
+                  slow_from_s=0.5).to_spec())
+    assert (q3.slow_worker, q3.slow_ms, q3.slow_s, q3.slow_from_s) == \
+        ("w-[0-9]", 50.0, 2.5, 0.5)

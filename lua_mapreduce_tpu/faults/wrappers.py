@@ -81,6 +81,9 @@ class _FaultyBuilder(FileBuilder):
         if kind == "latency":
             self._store._plan.apply_latency()
             kind = None
+        elif kind == "slow":
+            self._store._plan.apply_slow()
+            kind = None
         if kind == "torn":
             # publish a PREFIX (the crash-mid-upload shape an object
             # store can surface), then report failure: readback-verify
@@ -140,6 +143,8 @@ class FaultyStore(Store):
         COUNTERS.bump("faults_injected")
         if kind == "latency":
             self._plan.apply_latency()
+        elif kind == "slow":
+            self._plan.apply_slow()
         elif kind == "permanent":
             raise InjectedPermanentFault(
                 f"injected permanent fault on {op}({name!r})",
@@ -366,8 +371,12 @@ class RetryingStore(Store):
 # exists to prevent). An unretried claim failure simply surfaces to the
 # worker's poll loop, which sleeps and re-polls; by then the stale
 # requeue recovers any orphan WITHOUT this worker re-claiming blind.
+# claim_spec shares the exclusion for the same shape (a landed first
+# attempt would strand a TAKEN shadow lease nobody executes; the
+# stranded lease is harmless — the original still commits — but it
+# blocks the speculation cap until then, so don't retry blind).
 _WRAPPED_RPCS = tuple(sorted(RPC_OPS))
-_RETRIED_RPCS = tuple(sorted(RPC_OPS - {"claim_batch"}))
+_RETRIED_RPCS = tuple(sorted(RPC_OPS - {"claim_batch", "claim_spec"}))
 
 
 class _JobStoreProxy:

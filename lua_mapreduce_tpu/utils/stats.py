@@ -103,6 +103,16 @@ class IterationStats:
     #                        climbs
     #   map_reruns         — last-resort producer requeues (every
     #                        replica of a file gone)
+    # speculative-execution accounting (DESIGN §21), same fold:
+    #   spec_launched  — duplicate leases the straggler detector opened
+    #   spec_wins      — commit races a CLONE won (the original's
+    #                    commit degraded to a zero-repetition no-op)
+    #   spec_cancelled — clones that lost, failed, or observed their
+    #                    revocation (job state untouched either way)
+    #   spec_wasted_s  — seconds EITHER duplicate (clone or disowned
+    #                    original) spent on work that lost its commit
+    #                    race (the duplicate-execution trade's cost
+    #                    side; the bench's wasted-work fraction)
     store_retries: int = 0
     store_faults: int = 0
     infra_releases: int = 0
@@ -111,6 +121,10 @@ class IterationStats:
     replica_repairs: int = 0
     map_reruns_avoided: int = 0
     map_reruns: int = 0
+    spec_launched: int = 0
+    spec_wins: int = 0
+    spec_cancelled: int = 0
+    spec_wasted_s: float = 0.0
 
     @property
     def cluster_time(self) -> float:
@@ -137,6 +151,10 @@ class IterationStats:
             "replica_repairs": self.replica_repairs,
             "map_reruns_avoided": self.map_reruns_avoided,
             "map_reruns": self.map_reruns,
+            "spec_launched": self.spec_launched,
+            "spec_wins": self.spec_wins,
+            "spec_cancelled": self.spec_cancelled,
+            "spec_wasted_s": self.spec_wasted_s,
             "cluster_time": self.cluster_time,
             "wall_time": self.wall_time,
         }

@@ -31,13 +31,22 @@ echo "chaos smoke: injected faults invisible on all three backends"
 python -m pytest tests/test_chaos.py -q -k "replication" \
     --deselect tests/test_chaos.py::test_replication_chaos_distributed_matrix
 echo "replication smoke: r-1 replica kills absorbed with zero map re-runs"
+# speculation chaos-smoke gate (DESIGN §21): one deterministically slow
+# worker (the `slow` FaultPlan kind) with speculation on — a clone must
+# win the first-commit-wins race, output byte-identical to the
+# fault-free twin, zero repetition charges; plus the store-level
+# duplicate-lease conformance suite across all three job stores
+python -m pytest tests/test_chaos.py::test_speculation_smoke_straggler \
+    tests/test_speculation.py -q
+echo "speculation smoke: straggler covered by a clone, zero rep bumps"
 # lmr-analyze gate: the framework-aware lint pass must be clean against
 # the checked-in suppression baseline (analysis/baseline.json — shipped
 # EMPTY; LMR009 keeps every engine spill publish on the replication
 # helper), and the lease-protocol model checker must exhaustively pass
-# the 2-worker lifecycle (worker death included) AND the
-# replica-recovery (reconstruct-vs-requeue) edge while re-finding all
-# four seeded races. Machine output: add --format json.
+# the 2-worker lifecycle (worker death included), the replica-recovery
+# (reconstruct-vs-requeue) edge, AND the speculation (duplicate-lease /
+# first-commit-wins / revoke) edge while re-finding all five seeded
+# races. Machine output: add --format json.
 python -m lua_mapreduce_tpu.analysis --fail-on-findings
 echo "lmr-analyze: lint clean + lease protocol model-checked"
 python -m pytest tests/ -q --full

@@ -128,7 +128,8 @@ def _run_local(tmp_path, backend, pipeline, tag, plan=None, replication=1):
 
 
 def _run_distributed(tmp_path, backend, pipeline, tag, plan=None,
-                     n_workers=2, replication=1):
+                     n_workers=2, replication=1, speculation=0.0,
+                     straggler=False, batch_k=2):
     _install_module()
     spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
                     reducefn=_MOD,
@@ -137,16 +138,40 @@ def _run_distributed(tmp_path, backend, pipeline, tag, plan=None,
     install_fault_plan(plan)
     try:
         server = Server(store, poll_interval=0.01, pipeline=pipeline,
-                        premerge_min_runs=2, batch_k=2,
+                        premerge_min_runs=2, batch_k=batch_k,
                         segment_format="v2" if pipeline else "v1",
-                        replication=replication).configure(spec)
-        workers = [Worker(store).configure(max_iter=800, max_sleep=0.02)
-                   for _ in range(n_workers)]
+                        replication=replication,
+                        speculation=speculation).configure(spec)
+        # ``straggler`` names the LAST worker "straggler-0" (the slow
+        # FaultPlan kind routes by worker name) and gives it a head
+        # start so it deterministically holds at least one lease
+        names = [f"healthy-{i}" for i in range(n_workers - 1)] \
+            + ["straggler-0"] if straggler else [None] * n_workers
+        workers = [Worker(store, name=names[i]).configure(max_iter=800,
+                                                          max_sleep=0.02)
+                   for i in range(n_workers)]
         threads = [threading.Thread(target=w.execute, daemon=True)
                    for w in workers]
-        for t in threads:
-            t.start()
-        stats = server.loop()
+        if straggler:
+            # server in the background; the straggler alone gets first
+            # claim so it deterministically holds a lease before any
+            # healthy worker (or clone) exists
+            final = {}
+            st = threading.Thread(
+                target=lambda: final.setdefault("stats", server.loop()),
+                daemon=True)
+            st.start()
+            threads[-1].start()
+            _wait_for_claim(store)
+            for t in threads[:-1]:
+                t.start()
+            st.join(timeout=120)
+            assert not st.is_alive(), "server wedged under the straggler"
+            stats = final["stats"]
+        else:
+            for t in threads:
+                t.start()
+            stats = server.loop()
         for t in threads:
             t.join(timeout=30)
     finally:
@@ -165,8 +190,28 @@ def _run_distributed(tmp_path, backend, pipeline, tag, plan=None,
            for k, v in iter_results(get_storage_from(spec.storage),
                                     "result")}
     assert got == GOLDEN
+    # speculation legs narrow to final result files: a disowned
+    # straggler finishing after the winner's reduce consumed the runs
+    # legitimately leaves identical-bytes run files behind (its commit
+    # lands nowhere), exactly like replica-kill legs leave dead copies
     return _result_bytes(spec.storage,
-                         only_results=replication > 1), stats
+                         only_results=replication > 1
+                         or speculation > 0), stats
+
+
+def _wait_for_claim(store, timeout=30.0):
+    """Block until some worker holds a RUNNING lease (the straggler's
+    head start)."""
+    import time as _t
+    deadline = _t.time() + timeout
+    while _t.time() < deadline:
+        try:
+            if store.counts(MAP_NS)[Status.RUNNING] > 0:
+                return
+        except Exception:
+            pass
+        _t.sleep(0.005)
+    raise AssertionError("straggler never claimed a lease")
 
 
 # --- smoke legs: the test.sh chaos gate (one seeded plan per backend) -------
@@ -376,6 +421,77 @@ def test_replication_total_loss_falls_back_to_map_rerun(tmp_path):
         "total loss must requeue every destroyed producer"
     kinds = {e.get("classification") for e in server.errors}
     assert "spill-lost-requeue" in kinds
+
+
+# --- speculative-execution legs (DESIGN §21) ---------------------------------
+#
+# The ISSUE 7 acceptance gate: one deterministically SLOW worker (the
+# `slow` FaultPlan kind taxes every data-plane op of "straggler-0" with
+# per-op latency) on every backend × both shuffle modes — with
+# speculation on, output must be byte-identical to the fault-free twin,
+# repetition counts all zero (asserted per job inside _run_distributed)
+# and at least one clone must win its commit race (spec_wins ≥ 1).
+
+def _slow_plan(seed, slow_ms=120.0):
+    """Every data-plane op by the straggler pays ``slow_ms`` for the
+    whole run — a ~20x op-latency multiplier against this suite's
+    healthy ops, provoked deterministically."""
+    return FaultPlan(seed, slow_worker="straggler-*", slow_ms=slow_ms,
+                     slow_s=3600.0)
+
+
+def test_speculation_smoke_straggler(tmp_path):
+    """The test.sh speculation chaos gate: one fast leg — slow worker,
+    clone wins, byte-identical output, zero repetition charges."""
+    clean, _ = _run_distributed(tmp_path, "mem", False, "spec-smoke-c")
+    plan = _slow_plan(81)
+    chaotic, stats = _run_distributed(
+        tmp_path, "mem", False, "spec-smoke-f", plan=plan, n_workers=3,
+        speculation=3.0, straggler=True, batch_k=1)
+    assert chaotic == clean, "speculation leg output differs"
+    assert plan.fired.get("slow", 0) > 0, "the straggler was never slowed"
+    it = stats.iterations[-1]
+    assert it.spec_launched >= 1, "detector never opened a shadow lease"
+    assert it.spec_wins >= 1, "no clone ever won the commit race"
+
+
+@pytest.mark.parametrize("pipeline", [False, True],
+                         ids=["barrier", "pipelined"])
+@pytest.mark.parametrize("backend", ["mem", "shared", "object"])
+def test_speculation_chaos_matrix(tmp_path, backend, pipeline):
+    """The full acceptance matrix: a slow-plan straggler on every
+    backend × both shuffle modes — speculation-on output byte-identical
+    to the fault-free twin, zero repetition bumps, spec_wins ≥ 1."""
+    tag = f"spec-{backend}-{int(pipeline)}"
+    clean, _ = _run_distributed(tmp_path, backend, pipeline, tag + "-c")
+    plan = _slow_plan(83)
+    chaotic, stats = _run_distributed(
+        tmp_path, backend, pipeline, tag + "-f", plan=plan, n_workers=3,
+        speculation=3.0, straggler=True, batch_k=1)
+    assert chaotic == clean, "speculation leg output differs"
+    assert plan.fired.get("slow", 0) > 0
+    it = stats.iterations[-1]
+    assert it.spec_wins >= 1, "no clone ever won the commit race"
+    assert it.map.failed == 0 and it.reduce.failed == 0
+
+
+def test_speculation_off_same_bytes_under_straggler(tmp_path):
+    """The tri-compare leg: the same slow-plan straggler run with
+    speculation OFF still produces the identical bytes (slower — the
+    straggler sets the wall clock) and the speculation-ON run matches
+    both. Speculation changes WHO computes, never WHAT."""
+    clean, _ = _run_distributed(tmp_path, "mem", False, "spec3-c")
+    off, off_stats = _run_distributed(
+        tmp_path, "mem", False, "spec3-off", plan=_slow_plan(89),
+        n_workers=3, straggler=True, batch_k=1, speculation=0.0)
+    on, on_stats = _run_distributed(
+        tmp_path, "mem", False, "spec3-on", plan=_slow_plan(89),
+        n_workers=3, straggler=True, batch_k=1, speculation=3.0)
+    # the off leg leaves no orphans (nothing was ever disowned), so its
+    # full listing equals the narrowed ones
+    assert off == clean and on == clean
+    assert off_stats.iterations[-1].spec_launched == 0
+    assert on_stats.iterations[-1].spec_wins >= 1
 
 
 def test_replication_total_loss_single_dual_phase_worker(tmp_path):

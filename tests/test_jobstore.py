@@ -469,7 +469,7 @@ def test_crash_orphaned_manifest_is_superseded(tmp_path):
 
 
 def test_native_python_abi_drift_guard():
-    """The v2 layout constants (JSIX0002, 16B header, 72B records) and
+    """The v3 layout constants (JSIX0003, 16B header, 88B records) and
     the status enum must be asserted equal on both index engines: the
     Python side pins them at import, and the native build exports
     jsx_abi() which coord/idx.py verifies at load. Both engines write
@@ -481,8 +481,8 @@ def test_native_python_abi_drift_guard():
 
     # python side: the import-time guard already ran; re-assert the
     # values it pinned
-    assert idx_py.MAGIC == b"JSIX0002"
-    assert idx_py.HEADER_SIZE == 16 and idx_py.RECORD_SIZE == 72
+    assert idx_py.MAGIC == b"JSIX0003"
+    assert idx_py.HEADER_SIZE == 16 and idx_py.RECORD_SIZE == 88
     assert [int(s) for s in Status] == [0, 1, 2, 3, 4, 5]
 
     if not native_available():
