@@ -363,6 +363,39 @@ def _speculation_fields() -> dict:
     return out
 
 
+def _trace_fields() -> dict:
+    """Detail fields for lmr-trace (DESIGN §22): a small live paired
+    run of benchmarks/trace_bench (1 round, tracing off vs on on the
+    distributed coord-shaped wordcount), then the committed artifact's
+    numbers — tracing-on wall overhead (≤1.05 bar), the tracing-off
+    control ratio (≤1.02 bar; with no tracer the wrapper layer is not
+    stacked at all), and spans collected per committed job. Never
+    sinks the flagship metric."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = {}
+    try:
+        from benchmarks.trace_bench import run as trace_run
+        r = trace_run(rounds=1, n_docs=16)
+        out = {
+            "trace_overhead_live_1round": r["trace_overhead_ratio"],
+            "trace_identical_output": r["identical_output"],
+        }
+    except Exception as e:
+        out = {"trace_bench_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        with open(os.path.join(here, "benchmarks", "results",
+                               "trace.json")) as f:
+            art = json.load(f)
+        out["trace_overhead"] = art["trace_overhead_ratio"]
+        out["trace_overhead_cpu"] = art["trace_overhead_ratio_cpu"]
+        out["trace_off_overhead"] = art["trace_off_ratio"]
+        out["trace_spans_per_job"] = art["trace_spans_per_job"]
+    except Exception:
+        pass
+    return out
+
+
 def _analysis_fields() -> dict:
     """Detail fields for the analysis subsystem (DESIGN §18): the lint
     pass's wall time over the whole package (it gates test.sh, so its
@@ -505,6 +538,9 @@ def main() -> None:
         # fraction, and the speculation-idle overhead
         # (benchmarks/speculation_bench.py; DESIGN §21)
         **_speculation_fields(),
+        # lmr-trace: tracing-on overhead (≤1.05), tracing-off control
+        # (≤1.02), spans per job (benchmarks/trace_bench.py; DESIGN §22)
+        **_trace_fields(),
     }
     if on_tpu and "lm_train_mfu" in lm:
         # VERDICT r4 weak-1: the first number a reader (or the driver

@@ -1,0 +1,190 @@
+"""lmr-trace overhead bench (DESIGN §22).
+
+Two paired-rounds measurements on the DISTRIBUTED wordcount leg (an
+in-process MemJobStore server + 2-worker pool, batch_k=2 — the coord
+bench's shape, where the tracing layer's per-RPC spans actually cost):
+
+1. **Control** — tracing OFF vs OFF, order alternated inside each pair.
+   The pair ratio's distance from 1.0 is this box's run-to-run noise;
+   the acceptance bar for the tracing-OFF configuration is ≤ 1.02
+   (structurally expected: with no tracer active the wrapper layer is
+   simply not stacked, so "off" IS the seed path).
+2. **Overhead** — tracing OFF vs ON, same protocol. MEDIAN paired wall
+   ratio headlined; acceptance ≤ 1.05 (one span dict + buffer append
+   per store/coord op, flushed through the store at lease boundaries).
+
+Also recorded: ``trace_spans_per_job`` (spans collected / jobs
+executed) and a byte-compare of both halves' results — the tracing-on
+leg must be byte-identical, or the "observability, never bytes"
+contract is broken and no overhead number matters.
+
+Usage: python benchmarks/trace_bench.py [rounds] [n_docs]
+Artifact: benchmarks/results/trace.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RESULTS = os.path.join(REPO, "benchmarks", "results", "trace.json")
+
+TASK_MOD = "benchmarks._trace_bench_task"
+N_WORKERS = 2
+
+
+def _install_task(n_docs: int, vocab: int):
+    mod = sys.modules.get(TASK_MOD)
+    if mod is None:
+        mod = types.ModuleType(TASK_MOD)
+
+        def taskfn(emit):
+            for i in range(mod.n_docs):
+                emit(f"doc{i:05d}",
+                     " ".join(f"w{(i * 13 + j) % mod.vocab}"
+                              for j in range(40)))
+
+        def mapfn(key, value, emit):
+            for w in value.split():
+                emit(w, 1)
+
+        mod.taskfn = taskfn
+        mod.mapfn = mapfn
+        mod.partitionfn = lambda key: sum(key.encode()) % 4
+        mod.reducefn = lambda key, values: sum(values)
+        sys.modules[TASK_MOD] = mod
+    mod.n_docs = n_docs
+    mod.vocab = vocab
+    return mod
+
+
+def _leg(traced: bool, tag: str, n_docs: int, vocab: int) -> dict:
+    """One distributed wordcount run; returns wall seconds, result
+    bytes, and (traced legs) span/job counts."""
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.server import Server
+    from lua_mapreduce_tpu.engine.worker import Worker
+    from lua_mapreduce_tpu.store.router import get_storage_from
+    from lua_mapreduce_tpu.trace.collect import TraceCollection
+    from lua_mapreduce_tpu.trace.span import Tracer, install_tracer
+
+    _install_task(n_docs, vocab)
+    storage = f"mem:{tag}"
+    spec = TaskSpec(taskfn=TASK_MOD, mapfn=TASK_MOD, partitionfn=TASK_MOD,
+                    reducefn=TASK_MOD, storage=storage)
+    store = MemJobStore()
+    install_tracer(Tracer() if traced else None)
+    try:
+        server = Server(store, poll_interval=0.005,
+                        batch_k=2).configure(spec)
+        workers = [Worker(store).configure(max_iter=2000, max_sleep=0.01)
+                   for _ in range(N_WORKERS)]
+        threads = [threading.Thread(target=w.execute, daemon=True)
+                   for w in workers]
+        t0 = time.perf_counter()
+        c0 = time.process_time()
+        for t in threads:
+            t.start()
+        server.loop()
+        for t in threads:
+            t.join(timeout=60)
+        wall = time.perf_counter() - t0
+        cpu = time.process_time() - c0
+        raw = get_storage_from(storage)
+        result = {n: "".join(raw.lines(n))
+                  for n in raw.list("result.P*")
+                  if not n.startswith("_trace.")}
+        spans = jobs = 0
+        if traced:
+            col = TraceCollection.from_store(raw)
+            spans = len(col.spans)
+            jobs = sum(1 for s in col.spans
+                       if s["name"] == "commit")
+    finally:
+        install_tracer(None)
+    return {"wall_s": wall, "cpu_s": cpu, "result": result,
+            "spans": spans, "jobs": jobs}
+
+
+def _paired(rounds: int, n_docs: int, vocab: int, legs) -> dict:
+    """The established paired-rounds protocol (segment/faults bench):
+    order alternated inside each pair, median ratio headlined, cpu
+    ratio recorded as the contention-immune companion."""
+    ratios, cpu_ratios = [], []
+    identical = True
+    spans_per_job = 0.0
+    for rnd in range(rounds):
+        pair = {}
+        order = legs if rnd % 2 == 0 else legs[::-1]
+        for which, traced in order:
+            pair[which] = _leg(traced, f"trbench-{which}-{rnd}",
+                               n_docs, vocab)
+        identical = identical and (pair[legs[0][0]]["result"]
+                                   == pair[legs[1][0]]["result"])
+        ratios.append(pair[legs[1][0]]["wall_s"]
+                      / pair[legs[0][0]]["wall_s"])
+        cpu_ratios.append(pair[legs[1][0]]["cpu_s"]
+                          / max(pair[legs[0][0]]["cpu_s"], 1e-9))
+        traced_leg = next((pair[w] for w, tr in legs if tr), None)
+        if traced_leg and traced_leg["jobs"]:
+            spans_per_job = traced_leg["spans"] / traced_leg["jobs"]
+    return {"ratio": statistics.median(ratios),
+            "ratio_pairs": [round(r, 4) for r in ratios],
+            "ratio_cpu": statistics.median(cpu_ratios),
+            "identical_output": identical,
+            "spans_per_job": round(spans_per_job, 2)}
+
+
+def run(rounds: int = 5, n_docs: int = 48, vocab: int = 200) -> dict:
+    control = _paired(rounds, n_docs, vocab,
+                      [("off_a", False), ("off_b", False)])
+    overhead = _paired(rounds, n_docs, vocab,
+                       [("off", False), ("on", True)])
+    return {
+        # tracing-off control pair: pure run-to-run noise, the ≤1.02 bar
+        # for the off configuration (no tracer ⇒ no wrapper layer)
+        "trace_off_ratio": round(control["ratio"], 4),
+        "trace_off_ratio_pairs": control["ratio_pairs"],
+        # tracing-on over tracing-off: the ≤1.05 acceptance bar
+        "trace_overhead_ratio": round(overhead["ratio"], 4),
+        "trace_overhead_ratio_pairs": overhead["ratio_pairs"],
+        "trace_overhead_ratio_cpu": round(overhead["ratio_cpu"], 4),
+        "identical_output": control["identical_output"]
+        and overhead["identical_output"],
+        "trace_spans_per_job": overhead["spans_per_job"],
+        "config": {"rounds": rounds, "n_docs": n_docs, "vocab": vocab,
+                   "workers": N_WORKERS, "batch_k": 2,
+                   "protocol": "paired rounds, order alternated, "
+                               "median ratio"},
+    }
+
+
+def main() -> None:
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+    n_docs = int(sys.argv[2]) if len(sys.argv) > 2 else 48
+    out = run(rounds=rounds, n_docs=n_docs)
+    out["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+    with open(RESULTS, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+    ok = (out["trace_overhead_ratio"] <= 1.05
+          and out["trace_off_ratio"] <= 1.02
+          and out["identical_output"])
+    print(f"acceptance: overhead {out['trace_overhead_ratio']} <= 1.05, "
+          f"off {out['trace_off_ratio']} <= 1.02, "
+          f"identical={out['identical_output']} -> "
+          f"{'PASS' if ok else 'FAIL'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,10 @@ _LAZY = {
                             "PermanentStoreError"),
     "RetryPolicy": ("lua_mapreduce_tpu.faults.retry", "RetryPolicy"),
     "FaultPlan": ("lua_mapreduce_tpu.faults.plan", "FaultPlan"),
+    # lmr-trace (DESIGN §22)
+    "Tracer": ("lua_mapreduce_tpu.trace.span", "Tracer"),
+    "TraceCollection": ("lua_mapreduce_tpu.trace.collect",
+                        "TraceCollection"),
 }
 
 
@@ -66,6 +70,8 @@ __all__ = [
     "PermanentStoreError",
     "RetryPolicy",
     "FaultPlan",
+    "Tracer",
+    "TraceCollection",
     "tuples",
     "utest",
 ]
@@ -73,7 +79,7 @@ __all__ = [
 
 def utest():
     """Run every module's self-test (reference mapreduce/test.lua:30-39)."""
-    from lua_mapreduce_tpu import analysis, faults
+    from lua_mapreduce_tpu import analysis, faults, trace
     from lua_mapreduce_tpu.core import heap, merge, segment, serialize
     from lua_mapreduce_tpu.coord import jobstore, persistent_table
     from lua_mapreduce_tpu.engine import (contract, placement, premerge,
@@ -87,6 +93,6 @@ def utest():
     # the cpu-pinned pytest conftest instead (tests/test_q8.py etc.)
     for mod in (tuples, heap, serialize, segment, merge, jobstore, memfs,
                 contract, router, persistent_table, stats, placement,
-                premerge, worker, server, analysis, faults):
+                premerge, worker, server, analysis, faults, trace):
         if hasattr(mod, "utest"):
             mod.utest()

@@ -662,3 +662,42 @@ class JaxPurityRule(Rule):
                         ctx, m, f"{'.'.join(c)} inside traced function "
                         f"{n.name!r} is evaluated once at trace time — "
                         "use jax.random / pass values as arguments")
+
+
+# --- LMR010: trace/ span timing must use the injectable clock ---------------
+
+_DIRECT_CLOCK_CALLS = {"time", "monotonic", "perf_counter", "time_ns",
+                       "monotonic_ns", "perf_counter_ns"}
+
+
+class InjectableClockRule(Rule):
+    id = "LMR010"
+    severity = "error"
+    title = "trace code reads time only through the injectable clock"
+    rationale = (
+        "Every span timestamp in trace/ must flow through the Tracer's "
+        "injectable clock (self._clock / tracer.clock()), never a bare "
+        "time.time()/perf_counter() call: deterministic-trace tests "
+        "replay exact timelines on a virtual clock, and a single direct "
+        "wall-clock read silently splits the timeline into two time "
+        "bases that no collector can re-align (the LMR004 discipline, "
+        "extended from lock scopes to the whole tracing subsystem). "
+        "Binding time.time as a DEFAULT (clock=time.time) is the one "
+        "legal appearance — it is the injection point itself, a "
+        "reference, not a read. Engine job timing (JobTimes) predates "
+        "the tracer and stays on its own clock; the rule scopes to "
+        "trace/ where determinism is the contract.")
+    paths = ("trace/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            c = _chain(n.func)
+            if (c and len(c) == 2 and c[0] == "time"
+                    and c[1] in _DIRECT_CLOCK_CALLS):
+                yield self.finding(
+                    ctx, n,
+                    f"{'.'.join(c)}() in trace/ — route the read "
+                    "through the Tracer's injectable clock "
+                    "(self._clock() / tracer.clock())")

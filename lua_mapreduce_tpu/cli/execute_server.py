@@ -111,6 +111,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--speculation-cap", type=int, default=2,
                    help="max live speculative clones per namespace "
                         "(bounds wasted duplicate work)")
+    p.add_argument("--trace", action="store_true",
+                   help="lmr-trace (docs/DESIGN.md §22): record "
+                        "claim/body/publish/commit spans and per-op "
+                        "latencies, flushed into the task storage as "
+                        "_trace.* files; inspect with 'python -m "
+                        "lua_mapreduce_tpu.trace STORAGE'. Subprocess "
+                        "workers enable theirs via LMR_TRACE=1")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="wrap the run in utils/profiling.device_trace "
+                        "(JAX/XLA profile into DIR, TensorBoard-"
+                        "loadable). With --trace, span names are "
+                        "bridged into the device profile so host and "
+                        "TPU timelines correlate")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -132,6 +145,9 @@ def main(argv=None) -> int:
 
     if args.store_retries is not None or args.retry_base_ms is not None:
         configure_retry(args.store_retries, args.retry_base_ms)
+    if args.trace:
+        from lua_mapreduce_tpu.trace.span import Tracer, install_tracer
+        install_tracer(Tracer(annotate=bool(args.profile)))
 
     import os as _os
     storage = args.storage or (
@@ -174,7 +190,17 @@ def main(argv=None) -> int:
             if frac >= 1:
                 print(file=sys.stderr)
 
-    stats = server.loop(progress=report)
+    import contextlib
+    profile_ctx = contextlib.nullcontext()
+    if args.profile:
+        # backend-bootstrap-before-trace ordering: entering device_trace
+        # initializes the JAX backend, so it must come AFTER the
+        # force_cpu_if_unavailable probe at the top of main() — the
+        # documented train_lm discipline (utils/profiling.py)
+        from lua_mapreduce_tpu.utils.profiling import device_trace
+        profile_ctx = device_trace(args.profile)
+    with profile_ctx:
+        stats = server.loop(progress=report)
     last = stats.last
     if not args.quiet and last is not None:
         print(f"cluster_time={last.cluster_time:.2f}s "

@@ -47,6 +47,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--retry-base-ms", type=float, default=None,
                    help="decorrelated-jitter backoff base in ms "
                         "(default 25, or LMR_RETRY_BASE_MS)")
+    p.add_argument("--trace", action="store_true",
+                   help="lmr-trace (docs/DESIGN.md §22): record this "
+                        "worker's claim/body/publish/commit spans, "
+                        "flushed into the task storage as _trace.* "
+                        "files (also enabled fleet-wide via LMR_TRACE=1)")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="wrap execute() in utils/profiling.device_trace "
+                        "(JAX/XLA profile into DIR — today only "
+                        "train_lm had this). With --trace, span names "
+                        "are bridged into the device profile")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -65,6 +75,9 @@ def main(argv=None) -> int:
 
     if args.store_retries is not None or args.retry_base_ms is not None:
         configure_retry(args.store_retries, args.retry_base_ms)
+    if args.trace:
+        from lua_mapreduce_tpu.trace.span import Tracer, install_tracer
+        install_tracer(Tracer(annotate=bool(args.profile)))
     phases = tuple(s.strip() for s in args.phases.split(",") if s.strip())
     for ph in phases:
         if ph not in ("map", "reduce"):
@@ -79,7 +92,16 @@ def main(argv=None) -> int:
         worker.configure(segment_format=args.segment_format)
     if args.replication is not None:
         worker.configure(replication=args.replication)
-    worker.execute()
+    import contextlib
+    profile_ctx = contextlib.nullcontext()
+    if args.profile:
+        # backend-bootstrap-before-trace ordering: device_trace
+        # initializes the JAX backend, so it must come AFTER the
+        # force_cpu_if_unavailable probe above (utils/profiling.py)
+        from lua_mapreduce_tpu.utils.profiling import device_trace
+        profile_ctx = device_trace(args.profile)
+    with profile_ctx:
+        worker.execute()
     return 0
 
 

@@ -26,8 +26,14 @@ from lua_mapreduce_tpu.engine.premerge import (PremergeTracker,
                                                discover_pipelined,
                                                run_name_re)
 from lua_mapreduce_tpu.store.router import get_storage_from
+from lua_mapreduce_tpu.trace.span import active_tracer
 from lua_mapreduce_tpu.utils.stats import (IterationStats, TaskStats,
                                            overlap_fraction)
+
+# span namespaces, matching the distributed engine's job queues so one
+# collector (trace/collect.py) reads both executors' timelines alike
+_SPAN_NS = {"map": "map_jobs", "pre_merge": "pre_jobs",
+            "reduce": "red_jobs"}
 
 
 def collect_task_jobs(spec: TaskSpec) -> List[Tuple[Any, Any]]:
@@ -149,6 +155,30 @@ class LocalExecutor:
         self.stats = TaskStats()
         self.finished_value: Any = None
 
+    def _traced(self, label: str, job_id, fn):
+        """Run one job body under an lmr-trace span (DESIGN §22) — the
+        in-process analog of Worker._body_span, so the collector's
+        lifecycle view works on LocalExecutor runs too (claim/commit
+        spans don't exist here: no control plane). Zero-cost when
+        tracing is off."""
+        tracer = active_tracer()
+        if tracer is None:
+            return fn()
+        tracer.set_actor("local")     # pool threads each declare it
+        with tracer.span(f"{label}.body", ns=_SPAN_NS[label],
+                         job_id=job_id, attempt=0):
+            return fn()
+
+    def _trace_flush(self) -> None:
+        tracer = active_tracer()
+        if tracer is None:
+            return
+        try:
+            tracer.flush(self.store, force=True)
+        except Exception as exc:
+            print(f"[local] trace flush failed ({type(exc).__name__}: "
+                  f"{exc}); spans re-buffered", file=sys.stderr)
+
     def _run_jobs(self, fns) -> List[JobTimes]:
         if self.map_parallelism == 1 or len(fns) <= 1:
             return [fn() for fn in fns]
@@ -163,6 +193,9 @@ class LocalExecutor:
     def run_one_iteration(self, iteration: int) -> Any:
         """One map→shuffle→reduce→final cycle; returns finalfn's verdict."""
         spec = self.spec
+        tracer = active_tracer()
+        if tracer is not None:
+            tracer.set_iteration(iteration)
         it_stats = IterationStats(iteration=iteration)
         t0 = time.time()
         from lua_mapreduce_tpu.faults.retry import COUNTERS
@@ -183,19 +216,21 @@ class LocalExecutor:
             it_stats.reduce.fold(reduce_times)
         else:
             map_times = self._run_jobs([
-                (lambda k=k, v=v, i=i: run_map_job(
-                    spec, self.store, str(i), k, v,
-                    segment_format=self.segment_format,
-                    replication=self.replication))
+                (lambda k=k, v=v, i=i: self._traced(
+                    "map", i, lambda: run_map_job(
+                        spec, self.store, str(i), k, v,
+                        segment_format=self.segment_format,
+                        replication=self.replication)))
                 for i, (k, v) in enumerate(jobs)])
             it_stats.map.fold(map_times)
 
             parts = discover_partitions(self._view, spec.result_ns)
             reduce_times = self._run_jobs([
-                (lambda p=p, files=files: run_reduce_job(
-                    spec, self.store, self.result_store, str(p), files,
-                    result_file_name(spec.result_ns, p),
-                    replication=self.replication))
+                (lambda p=p, files=files: self._traced(
+                    "reduce", p, lambda: run_reduce_job(
+                        spec, self.store, self.result_store, str(p), files,
+                        result_file_name(spec.result_ns, p),
+                        replication=self.replication)))
                 for p, files in sorted(parts.items())])
             it_stats.reduce.fold(reduce_times)
 
@@ -204,27 +239,18 @@ class LocalExecutor:
         if spec.finalfn is not None:
             verdict = spec.finalfn(iter_results(self.result_store,
                                                 spec.result_ns))
-        # fault-plane traffic this iteration (DESIGN §19), same fold as
-        # the distributed server's
-        fd = COUNTERS.delta(faults0, COUNTERS.snapshot())
-        it_stats.store_retries = fd.get("retries", 0)
-        it_stats.store_faults = (fd.get("retry_exhausted", 0)
-                                 + fd.get("faults_injected", 0))
-        it_stats.degraded_reads = fd.get("degraded_reads", 0)
-        it_stats.failover_reads = fd.get("failover_reads", 0)
-        it_stats.replica_repairs = fd.get("replica_repairs", 0)
-        it_stats.map_reruns_avoided = fd.get("map_reruns_avoided", 0)
-        it_stats.map_reruns = fd.get("map_reruns", 0)
-        # speculation accounting (DESIGN §21): the in-process executor
-        # has no control plane to speculate over, but an in-process
-        # WORKER pool sharing this process's counters does — fold the
-        # same fields so both engines report one schema
-        it_stats.spec_launched = fd.get("spec_launched", 0)
-        it_stats.spec_wins = fd.get("spec_wins", 0)
-        it_stats.spec_cancelled = fd.get("spec_cancelled", 0)
-        it_stats.spec_wasted_s = float(fd.get("spec_wasted_s", 0.0))
+        # fault-plane traffic this iteration (DESIGN §19): the identical
+        # fold the distributed server runs — stats.COUNTER_FOLD is the
+        # ONE key→field mapping, so both executors surface the same
+        # counter schema by construction (speculation fields included:
+        # the in-process executor has no control plane to speculate
+        # over, but an in-process WORKER pool sharing this process's
+        # counters does)
+        it_stats.fold_fault_counters(
+            COUNTERS.delta(faults0, COUNTERS.snapshot()))
         it_stats.wall_time = time.time() - t0
         self.stats.iterations.append(it_stats)
+        self._trace_flush()
         return verdict
 
     def _run_pipelined(self, jobs) -> Tuple[List[JobTimes], List[JobTimes],
@@ -257,9 +283,12 @@ class LocalExecutor:
 
         def premerge_one(sp):
             try:
-                t = run_premerge_job(spec, self.store, sp.files, sp.name,
-                                     segment_format=self.segment_format,
-                                     replication=self.replication)
+                t = self._traced(
+                    "pre_merge", f"{sp.part}.{sp.seq}",
+                    lambda: run_premerge_job(
+                        spec, self.store, sp.files, sp.name,
+                        segment_format=self.segment_format,
+                        replication=self.replication))
             except Exception as e:
                 with lock:
                     pre_failed[0] += 1
@@ -275,9 +304,11 @@ class LocalExecutor:
                 tracker.spill_done(sp.part, sp.seq)
 
         def map_one(i, k, v):
-            t = run_map_job(spec, self.store, str(i), k, v,
-                            segment_format=self.segment_format,
-                            replication=self.replication)
+            t = self._traced(
+                "map", i, lambda: run_map_job(
+                    spec, self.store, str(i), k, v,
+                    segment_format=self.segment_format,
+                    replication=self.replication))
             produced = {}
             for name in self.store.list(
                     f"{spec.result_ns}.P*.M{map_keys[i]}"):
@@ -304,9 +335,11 @@ class LocalExecutor:
                 f.result()
             parts = discover_pipelined(self._view, spec.result_ns, map_keys)
             red_futs = [pool.submit(
-                run_reduce_job, spec, self.store, self.result_store, str(p),
-                files, result_file_name(spec.result_ns, p),
-                self.replication)
+                lambda p=p, files=files: self._traced(
+                    "reduce", p, lambda: run_reduce_job(
+                        spec, self.store, self.result_store, str(p),
+                        files, result_file_name(spec.result_ns, p),
+                        self.replication)))
                 for p, files in sorted(parts.items())]
             reduce_times = [f.result() for f in red_futs]
         finally:
@@ -329,6 +362,16 @@ class LocalExecutor:
         """Run iterations until finalfn stops looping (server.lua:466-611,
         387-403: "loop" → repeat; True → drop results; else keep)."""
         self.clean_namespace()
+        # purge a previous run's flushed spans (the server's fresh-start
+        # rule, DESIGN §22): flush files are append-safe across process
+        # restarts, so without this a re-run into the same store would
+        # present BOTH runs' timelines as one. Through the raw store —
+        # telemetry housekeeping must not consume FaultPlan occurrences.
+        from lua_mapreduce_tpu.faults.wrappers import unwrap
+        from lua_mapreduce_tpu.trace.span import TRACE_NS
+        raw = unwrap(self.store)
+        for name in raw.list(f"{TRACE_NS}.*"):
+            raw.remove(name)
         t0 = time.time()
         iteration = 1
         while iteration <= self.max_iterations:

@@ -34,6 +34,7 @@ from lua_mapreduce_tpu.engine.worker import MAP_NS, PRE_NS, RED_NS
 from lua_mapreduce_tpu.faults.retry import COUNTERS
 from lua_mapreduce_tpu.faults.wrappers import unwrap, wrap_jobstore
 from lua_mapreduce_tpu.store.router import get_storage_from
+from lua_mapreduce_tpu.trace.span import TRACE_NS, active_tracer
 from lua_mapreduce_tpu.utils.stats import (IterationStats, TaskStats,
                                            overlap_fraction)
 
@@ -231,6 +232,10 @@ class Server:
         skip_map = False
         iteration = 1
 
+        tracer = active_tracer()
+        if tracer is not None:
+            tracer.set_actor("server")
+
         task = self.store.get_task()
         if task is not None and "spec" in task:
             status = task.get("status")
@@ -302,6 +307,19 @@ class Server:
         # primary with a surviving replica stays discoverable and
         # sweeps fan out to every copy. r=1: both are the same object.
         self._data_store = get_storage_from(self.spec.storage)
+        if task is None:
+            # fresh start: purge a previous run's flushed spans so the
+            # collector never presents a stale timeline as this run's —
+            # UNCONDITIONALLY, not only when this run is traced: an
+            # untraced fresh run must not leave `python -m
+            # lua_mapreduce_tpu.trace` reporting the previous task.
+            # Through the RAW store — telemetry housekeeping must not
+            # consume FaultPlan occurrences or pay retry backoff (the
+            # flush-side rule); _trace.* removal can never touch result
+            # bytes (the prefix sits outside every engine namespace).
+            raw = unwrap(self._data_store)
+            for name in raw.list(f"{TRACE_NS}.*"):
+                raw.remove(name)
         store = reading_view(self._data_store, self.replication)
         result_store = (get_storage_from(self.spec.result_storage)
                         if self.spec.result_storage else self._data_store)
@@ -311,6 +329,8 @@ class Server:
             self._spec_taken_at.clear()
             self._spec_scan_at.clear()
             self._map_ids = None
+            if tracer is not None:
+                tracer.set_iteration(iteration)
             it_stats = IterationStats(iteration=iteration)
             it_t0 = time.time()
             rounds0 = self.store.round_counts()
@@ -319,10 +339,11 @@ class Server:
             if not skip_map:
                 delete_results(result_store, self.spec.result_ns)
                 n_map = self._prepare_map(store)
-                if self.pipeline:
-                    self._pipelined_map_phase(store, n_map, progress)
-                else:
-                    self._wait_phase(MAP_NS, n_map, "map", progress)
+                with self._phase_span("map", iteration):
+                    if self.pipeline:
+                        self._pipelined_map_phase(store, n_map, progress)
+                    else:
+                        self._wait_phase(MAP_NS, n_map, "map", progress)
                 map_times = self._phase_times(MAP_NS)
                 it_stats.map.fold(map_times,
                                   failed=self.store.counts(MAP_NS)[Status.FAILED])
@@ -337,7 +358,8 @@ class Server:
 
             n_red = self._prepare_reduce(store)
             if n_red:
-                self._wait_phase(RED_NS, n_red, "reduce", progress)
+                with self._phase_span("reduce", iteration):
+                    self._wait_phase(RED_NS, n_red, "reduce", progress)
             it_stats.reduce.fold(self._phase_times(RED_NS),
                                  failed=self.store.counts(RED_NS)[Status.FAILED])
 
@@ -354,24 +376,18 @@ class Server:
             # fault-plane traffic this iteration (process-global counter
             # deltas — same visibility contract as round_counts: an
             # in-process pool's whole retry/degradation story, a
-            # multi-process pool's server-side share)
-            fd = COUNTERS.delta(faults0, COUNTERS.snapshot())
-            it_stats.store_retries = fd.get("retries", 0)
-            it_stats.store_faults = (fd.get("retry_exhausted", 0)
-                                     + fd.get("faults_injected", 0))
-            it_stats.infra_releases = fd.get("infra_releases", 0)
-            it_stats.degraded_reads = fd.get("degraded_reads", 0)
-            it_stats.failover_reads = fd.get("failover_reads", 0)
-            it_stats.replica_repairs = fd.get("replica_repairs", 0)
-            it_stats.map_reruns_avoided = fd.get("map_reruns_avoided", 0)
-            it_stats.map_reruns = fd.get("map_reruns", 0)
-            it_stats.spec_launched = fd.get("spec_launched", 0)
-            it_stats.spec_wins = fd.get("spec_wins", 0)
-            it_stats.spec_cancelled = fd.get("spec_cancelled", 0)
-            it_stats.spec_wasted_s = float(fd.get("spec_wasted_s", 0.0))
+            # multi-process pool's server-side share). The key→field
+            # mapping lives in stats.COUNTER_FOLD, shared verbatim with
+            # LocalExecutor so the two executors cannot drift.
+            it_stats.fold_fault_counters(
+                COUNTERS.delta(faults0, COUNTERS.snapshot()))
             it_stats.wall_time = time.time() - it_t0
             self.stats.iterations.append(it_stats)
             self.store.update_task({"stats": it_stats.as_dict()})
+            # end-of-iteration trace drain: everything the in-process
+            # pool buffered this iteration lands in the store before the
+            # namespaces roll over (DESIGN §22)
+            self._trace_flush(force=True)
             self._log(f"iteration {iteration}: cluster_time="
                       f"{it_stats.cluster_time:.2f}s wall={it_stats.wall_time:.2f}s")
 
@@ -494,6 +510,34 @@ class Server:
                 self._recover_lost(sorted(set(lost)))
             if self._spill_repairs:
                 self._settle_spill_repairs()
+        # trace drain rides housekeeping (the errors-stream cadence):
+        # soft flush — nothing happens below the tracer's threshold
+        self._trace_flush()
+
+    # -- tracing hooks (lmr-trace, DESIGN §22) ------------------------------
+
+    def _phase_span(self, phase: str, iteration: int):
+        """A span over a whole phase barrier — the waterfall's top row.
+        No-op context when tracing is off."""
+        import contextlib
+        tracer = active_tracer()
+        if tracer is None:
+            return contextlib.nullcontext()
+        return tracer.span(f"phase.{phase}", iteration=iteration)
+
+    def _trace_flush(self, force: bool = False) -> None:
+        """Publish the process tracer's buffered spans through the task
+        storage. Covers the server's own spans AND (in-process pools)
+        any worker-thread residue below the workers' own flush
+        threshold. Best effort: telemetry never aborts an iteration."""
+        tracer = active_tracer()
+        if tracer is None or self._data_store is None:
+            return
+        try:
+            tracer.flush(self._data_store, force=force)
+        except Exception as exc:
+            self._log(f"trace flush failed ({type(exc).__name__}: {exc});"
+                      " spans re-buffered")
 
     # -- straggler detection (speculative execution, DESIGN §21) ------------
 

@@ -33,6 +33,7 @@ from lua_mapreduce_tpu.faults.errors import (classify_job_fault,
                                              is_transient_job_fault)
 from lua_mapreduce_tpu.faults.wrappers import wrap_jobstore
 from lua_mapreduce_tpu.store.router import get_storage_from
+from lua_mapreduce_tpu.trace.span import active_tracer
 
 _log = logging.getLogger(__name__)
 
@@ -130,6 +131,7 @@ class Worker:
         self._idle_count = 0
         self.jobs_executed = 0
         self._jobs_at_start = 0         # execute()'s bounded-lifetime base
+        self._last_spec = None          # trace-flush target (DESIGN §22)
 
     def configure(self, **params) -> "Worker":
         """Set max_iter / max_sleep / max_tasks; unknown keys are rejected
@@ -165,7 +167,13 @@ class Worker:
             return "finished"
 
         spec = self._get_spec(task["spec"])
+        self._last_spec = spec          # where trace flushes publish
         iteration = int(task.get("iteration", 1))
+        tracer = active_tracer()
+        if tracer is not None:
+            # job ids restart per iteration: spans must carry which
+            # iteration they belong to or the collector conflates chains
+            tracer.set_iteration(iteration)
         # the per-job infra-release budget is scoped to ONE iteration of
         # ONE task: namespaces are dropped and re-inserted per iteration,
         # so job ids restart at 0 — a stale budget would wrongly charge a
@@ -347,6 +355,10 @@ class Worker:
             # interval so a recovered store is re-beaten promptly.
             failures = 0
             delay = self.heartbeat_s
+            tracer = active_tracer()
+            if tracer is not None:
+                tracer.set_actor(self.name)    # beat spans carry the
+                #                                owning worker's name
             while not stop.wait(delay):
                 try:
                     n = self.store.heartbeat_batch(ns, jids, self.name)
@@ -472,6 +484,35 @@ class Worker:
     _BODIES = {MAP_NS: _map_body, PRE_NS: _premerge_body,
                RED_NS: _reduce_body}
 
+    # -- tracing hooks (lmr-trace, DESIGN §22) ------------------------------
+
+    def _body_span(self, ns: str, label: str, job: dict):
+        """The job-body span: the claim→body→commit chain's middle link,
+        and the parent every store op / retry attempt inside the body
+        hangs under. A no-op context when tracing is off."""
+        tracer = active_tracer()
+        if tracer is None:
+            return contextlib.nullcontext()
+        attrs = {"speculative": True} if job.get("speculative") else {}
+        return tracer.span(f"{label}.body", ns=ns, job_id=job["_id"],
+                           attempt=int(job.get("repetitions") or 0),
+                           **attrs)
+
+    def _trace_flush(self, force: bool = False) -> None:
+        """Publish buffered spans through the task's storage (the
+        errors-stream pattern: telemetry rides the store the data plane
+        already has). Soft cadence after each lease; forced on exit.
+        Best effort — a failed flush re-buffers and never sinks a job."""
+        tracer = active_tracer()
+        if tracer is None or self._last_spec is None:
+            return
+        try:
+            tracer.flush(get_storage_from(self._last_spec.storage),
+                         force=force)
+        except Exception as exc:
+            _log.warning("[%s] trace flush failed (%s: %s); spans "
+                         "re-buffered", self.name, type(exc).__name__, exc)
+
     def _execute_batch(self, spec: TaskSpec, ns: str,
                        jobs: List[dict]) -> None:
         """Execute a claimed lease back-to-back and retire it in one
@@ -505,8 +546,10 @@ class Worker:
                     # pays zero probes.
                     skipped.append(job["_id"])
                     continue
+                sp = None
                 try:
-                    times = body(self, spec, job)
+                    with self._body_span(ns, label, job) as sp:
+                        times = body(self, spec, job)
                 except Exception as exc:
                     committed = self.store.commit_batch(ns, self.name, done)
                     self._settle_committed(ns, committed)
@@ -524,9 +567,9 @@ class Worker:
                         # past this worker's per-job release budget —
                         # the liveness backstop) mark BROKEN below and
                         # count toward the scavenger.
-                        self._release_infra(ns, job["_id"], exc)
+                        self._release_infra(ns, job["_id"], exc, span=sp)
                     else:
-                        self._mark_broken(ns, job["_id"], exc)
+                        self._mark_broken(ns, job["_id"], exc, span=sp)
                     raise
                 self._note_duration(ns, times.real)
                 done.append((job["_id"], _times_dict(times)))
@@ -561,6 +604,7 @@ class Worker:
         if skipped:
             self._log(f"{label}: {len(skipped)} leased job(s) revoked "
                       "mid-lease (duplicate committed first); skipped")
+        self._trace_flush()
 
     # -- speculative execution (duplicate leases, DESIGN §21) ---------------
 
@@ -590,7 +634,8 @@ class Worker:
                                     f"{label} clone {jid}: decided before "
                                     "the body started")
                     return False
-                times = body_times = self._BODIES[ns](self, spec, job)
+                with self._body_span(ns, label, job):
+                    times = body_times = self._BODIES[ns](self, spec, job)
         except Exception as exc:
             self._spec_lost(ns, jid, time.time() - t0,
                             f"{label} clone {jid}: body failed "
@@ -615,6 +660,7 @@ class Worker:
             self._persist_ewma(ns)
             self._log(f"{label} clone {jid} WON the commit race "
                       f"({body_times.real:.3f}s)")
+            self._trace_flush()
             return True
         self._spec_lost(ns, jid, time.time() - t0,
                         f"{label} clone {jid}: lost the commit race "
@@ -632,6 +678,7 @@ class Worker:
         if wasted_s > 0:
             COUNTERS.bump("spec_wasted_s", wasted_s)
         self._log(msg)
+        self._trace_flush()
 
     def _persist_ewma(self, ns: str) -> None:
         """Fold this worker's per-namespace duration EWMA into the task
@@ -668,7 +715,8 @@ class Worker:
                 if jid not in self._affinity:
                     self._affinity.append(jid)
 
-    def _error_info(self, ns: str, jid: int, exc: Exception) -> dict:
+    def _error_info(self, ns: str, jid: int, exc: Exception,
+                    span: Optional[dict] = None) -> dict:
         """Structured post-mortem fields for an errors-stream entry:
         exception class, provenance-aware infra/user classification,
         and job context — so drained errors distinguish infra from
@@ -676,11 +724,18 @@ class Worker:
         Store faults that name a shuffle file additionally carry
         ``lost_files`` (logical names), the hook the server's scavenger
         acts on: repair the file from a surviving replica, or requeue
-        its producer when every copy is gone (DESIGN §20)."""
+        its producer when every copy is gone (DESIGN §20). Under
+        tracing, ``span`` is the job-body span that was live when the
+        fault fired — its deterministic id lands in the entry as
+        ``span_id``, so an error row resolves to its timeline in the
+        collected trace (DESIGN §22)."""
         info = {"exc_class": type(exc).__name__,
                 "exc_msg": str(exc)[:500],
                 "classification": classify_job_fault(exc),
                 "ns": ns, "job_id": jid}
+        if span is not None:
+            info["span_id"] = span["sid"]
+            info["span_worker"] = span["worker"]
         from lua_mapreduce_tpu.engine.placement import base_name
         from lua_mapreduce_tpu.faults.errors import StoreError
         lost = getattr(exc, "lost_files", None)
@@ -708,7 +763,8 @@ class Worker:
         self._infra_released[key] = n
         return n <= MAX_JOB_RETRIES
 
-    def _release_infra(self, ns: str, jid: int, exc: Exception) -> None:
+    def _release_infra(self, ns: str, jid: int, exc: Exception,
+                       span: Optional[dict] = None) -> None:
         """Transient-infra failure path: job → WAITING (no repetition
         bump — it never ran to a deterministic failure), error → errors
         stream tagged 'infra-transient'. Same ownership/status CAS
@@ -720,7 +776,8 @@ class Worker:
                                   expect_worker=self.name)
         COUNTERS.bump("infra_releases")
         self.store.insert_error(self.name, self._abbrev_tb(),
-                                info=self._error_info(ns, jid, exc))
+                                info=self._error_info(ns, jid, exc,
+                                                      span=span))
         self._log(f"job {jid}: transient infra fault "
                   f"({type(exc).__name__}) — released to WAITING, "
                   "no repetition charged")
@@ -737,7 +794,8 @@ class Worker:
         return "\n".join(lines)
 
     def _mark_broken(self, ns: str, jid: int,
-                     exc: Optional[Exception] = None) -> None:
+                     exc: Optional[Exception] = None,
+                     span: Optional[dict] = None) -> None:
         """Job → BROKEN (+1 repetition) and error → errors stream
         (reference job.lua:322-342, cnn.lua:62-66). CASed on ownership
         AND on the job still being RUNNING: if the claim was requeued
@@ -750,7 +808,8 @@ class Worker:
         self.store.set_job_status(ns, jid, Status.BROKEN,
                                   expect=(Status.RUNNING,),
                                   expect_worker=self.name)
-        info = self._error_info(ns, jid, exc) if exc is not None else None
+        info = (self._error_info(ns, jid, exc, span=span)
+                if exc is not None else None)
         self.store.insert_error(self.name, self._abbrev_tb(), info=info)
 
     # -- main loop ----------------------------------------------------------
@@ -776,11 +835,21 @@ class Worker:
         # inherit a stale name
         from lua_mapreduce_tpu.faults.plan import set_current_worker
         set_current_worker(self.name)
+        tracer = active_tracer()
+        if tracer is not None:
+            # span worker fields default to this thread's actor name
+            tracer.set_actor(self.name)
         try:
             return self._execute_loop(retries, infra_fails, idle_iters,
                                       tasks_done, sleep, saw_work)
         finally:
             set_current_worker(None)
+            # residual spans must outlive the worker (a multi-process
+            # fleet member flushes its own tail; in-process pools also
+            # get the server's end-of-iteration force flush)
+            self._trace_flush(force=True)
+            if tracer is not None:
+                tracer.set_actor(None)
 
     def _execute_loop(self, retries, infra_fails, idle_iters, tasks_done,
                       sleep, saw_work) -> int:

@@ -3,13 +3,18 @@
 Layering (router/engine wiring in store/router.py, engine/worker.py,
 engine/server.py)::
 
-    RetryingStore( FaultyStore( real Store ) )     — data plane
-    RetryingJobStore( FaultyJobStore( real JobStore ) )  — coord plane
+    RetryingStore( TracingStore( FaultyStore( real Store ) ) )
+    RetryingJobStore( TracingJobStore( FaultyJobStore( real JobStore ) ) )
 
 The Faulty* layer exists only when a :class:`FaultPlan` is installed
-(chaos suites, ``LMR_FAULT_PLAN`` env); the Retrying* layer exists
-whenever the retry budget is > 0 (the production default). Fault-free
-overhead is one bound-method delegation per op — the ≤2% bench budget.
+(chaos suites, ``LMR_FAULT_PLAN`` env); the Tracing* layer (DESIGN §22,
+lua_mapreduce_tpu/trace/) only when a tracer is active (``--trace`` /
+``LMR_TRACE``) — placed INSIDE the retry layer so every retry attempt
+records its own span, and OVER the injection layer so injected faults
+are visible as error-tagged attempt spans; the Retrying* layer exists
+whenever the retry budget is > 0 (the production default). Fault-free,
+trace-free overhead is one bound-method delegation per op — the ≤2%
+bench budget.
 
 Build/commit ambiguity: a transient error out of ``build`` may mean the
 publish DID land (error-after-write) or landed torn. The retrying
@@ -504,19 +509,26 @@ def wiring_token() -> tuple:
     import os
 
     from lua_mapreduce_tpu.faults.retry import config_generation
+    from lua_mapreduce_tpu.trace.span import trace_generation
     with _plan_lock:
         gen = _plan_generation
-    return (gen, config_generation(),
+    return (gen, config_generation(), trace_generation(),
             os.environ.get("LMR_FAULT_PLAN") or "")
 
 
 def wrap_store(store: Store) -> Store:
-    """The router's one wiring point: injection (if a plan is active)
-    under retry (if the budget is > 0)."""
+    """The router's one wiring point: injection (if a plan is active),
+    tracing (if a tracer is active — DESIGN §22), then retry (if the
+    budget is > 0), innermost to outermost."""
     from lua_mapreduce_tpu.faults.retry import default_policy
+    from lua_mapreduce_tpu.trace.span import active_tracer
     plan = active_plan()
     if plan is not None:
         store = FaultyStore(store, plan)
+    tracer = active_tracer()
+    if tracer is not None:
+        from lua_mapreduce_tpu.trace.wrappers import TracingStore
+        store = TracingStore(store, tracer)
     policy = default_policy()
     if policy.retries > 0:
         store = RetryingStore(store, policy)
@@ -526,12 +538,18 @@ def wrap_store(store: Store) -> Store:
 def wrap_jobstore(store):
     """Worker/Server wiring point for the coord plane. Idempotent — an
     already-wrapped store passes through."""
-    if isinstance(store, (RetryingJobStore, FaultyJobStore)):
+    from lua_mapreduce_tpu.trace.wrappers import TracingJobStore
+    if isinstance(store, (RetryingJobStore, FaultyJobStore,
+                          TracingJobStore)):
         return store
     from lua_mapreduce_tpu.faults.retry import default_policy
+    from lua_mapreduce_tpu.trace.span import active_tracer
     plan = active_plan()
     if plan is not None:
         store = FaultyJobStore(store, plan)
+    tracer = active_tracer()
+    if tracer is not None:
+        store = TracingJobStore(store, tracer)
     policy = default_policy()
     if policy.retries > 0:
         store = RetryingJobStore(store, policy)

@@ -54,10 +54,13 @@ def get_storage_from(spec: str) -> Store:
 
     Every returned store passes through the fault wiring
     (faults.wrap_store, DESIGN §19): a retry layer whenever the
-    process's retry budget is > 0 (the default), and deterministic
-    fault injection when a FaultPlan is installed (chaos suites /
-    ``LMR_FAULT_PLAN``). ``mem:tag`` wrappers are memoized per wiring
-    generation so the shared-instance identity contract holds.
+    process's retry budget is > 0 (the default), deterministic fault
+    injection when a FaultPlan is installed (chaos suites /
+    ``LMR_FAULT_PLAN``), and lmr-trace op spans when a tracer is
+    active (``--trace`` / ``LMR_TRACE``, DESIGN §22 — stacked between
+    injection and retry so every retry attempt is its own span).
+    ``mem:tag`` wrappers are memoized per wiring generation so the
+    shared-instance identity contract holds.
     """
     from lua_mapreduce_tpu.faults.wrappers import wiring_token, wrap_store
     backend, path = parse_storage(spec)

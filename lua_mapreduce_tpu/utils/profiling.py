@@ -6,7 +6,13 @@ accelerator the interesting time is INSIDE the jitted step — kernel
 schedules, collective overlap, HBM stalls — which only the XLA profiler
 sees. :func:`device_trace` wraps any region in a jax.profiler trace
 whose output TensorBoard (or xprof) renders; train_lm's ``--profile``
-flag wires it around the train loop.
+flag wires it around the train loop, and the distributed worker/server
+CLIs (cli/execute_worker.py, cli/execute_server.py) expose the same
+``--profile DIR`` around their execute/loop — always AFTER the
+jax_env.force_cpu_if_unavailable bootstrap, since entering the trace
+initializes the backend (the ordering note on device_trace below).
+:func:`maybe_annotate` bridges lmr-trace span names (DESIGN §22) into
+the device profile so host and TPU timelines correlate.
 """
 
 from __future__ import annotations
@@ -39,3 +45,18 @@ def annotate(name: str):
     import jax
 
     return jax.profiler.TraceAnnotation(name)
+
+
+def maybe_annotate(name: str):
+    """Best-effort :func:`annotate`: a no-op context when JAX (or the
+    profiler) is unavailable. This is the lmr-trace bridge (DESIGN §22):
+    a Tracer built with ``annotate=True`` — the ``--trace --profile``
+    combination on the worker/server CLIs — enters one of these per
+    span, so the SAME span names appear on the XLA profile's host rows
+    and the Perfetto timeline exported from the store, and the host and
+    device views correlate by name. Telemetry must never sink a job
+    body, hence the swallow-to-no-op shape."""
+    try:
+        return annotate(name)
+    except Exception:
+        return contextlib.nullcontext()

@@ -60,6 +60,26 @@ def overlap_fraction(map_times: List[JobTimes],
     return min(1.0, hidden / total)
 
 
+# the counter-key → IterationStats-field fold, shared by BOTH executors
+# (fold_fault_counters below). Grown by PRs 5-7; store_faults is the one
+# composite: un-absorbed transient bursts PLUS injected FaultPlan events.
+COUNTER_FOLD = {
+    "store_retries": ("retries",),
+    "store_faults": ("retry_exhausted", "faults_injected"),
+    "infra_releases": ("infra_releases",),
+    "degraded_reads": ("degraded_reads",),
+    "failover_reads": ("failover_reads",),
+    "replica_repairs": ("replica_repairs",),
+    "map_reruns_avoided": ("map_reruns_avoided",),
+    "map_reruns": ("map_reruns",),
+    "spec_launched": ("spec_launched",),
+    "spec_wins": ("spec_wins",),
+    "spec_cancelled": ("spec_cancelled",),
+    "spec_wasted_s": ("spec_wasted_s",),
+}
+_FLOAT_COUNTERS = frozenset({"spec_wasted_s"})
+
+
 @dataclasses.dataclass
 class IterationStats:
     """Stats for one map→reduce iteration (server.lua:536-601), plus the
@@ -125,6 +145,22 @@ class IterationStats:
     spec_wins: int = 0
     spec_cancelled: int = 0
     spec_wasted_s: float = 0.0
+
+    def fold_fault_counters(self, delta: Dict[str, float]
+                            ) -> "IterationStats":
+        """Fold a FaultCounters delta (COUNTERS.delta of per-iteration
+        snapshots) into the counter fields — the ONE place the
+        counter-key → stats-field mapping lives. Server.loop and
+        LocalExecutor.run_one_iteration both route through here, so the
+        two executors cannot drift apart in which counters they surface
+        (they did, briefly: the local executor silently never folded
+        infra_releases; the drift test in tests/test_trace.py pins the
+        key sets identical)."""
+        for field, keys in COUNTER_FOLD.items():
+            val = sum(delta.get(k, 0) for k in keys)
+            setattr(self, field,
+                    float(val) if field in _FLOAT_COUNTERS else int(val))
+        return self
 
     @property
     def cluster_time(self) -> float:
@@ -203,3 +239,11 @@ def utest() -> None:
            JobTimes(started=5.0, finished=6.0, written=7.0, cpu=0.1)]
     assert abs(overlap_fraction(times, pre) - 0.75) < 1e-9
     assert overlap_fraction([], pre) == 0.0 and overlap_fraction(times, []) == 0.0
+    # the shared counter fold: composite store_faults, float passthrough,
+    # zeroed absent keys, and every folded field present in as_dict
+    it2 = IterationStats(iteration=2).fold_fault_counters(
+        {"retries": 3, "faults_injected": 1, "retry_exhausted": 2,
+         "spec_wasted_s": 1.5})
+    assert it2.store_retries == 3 and it2.store_faults == 3
+    assert it2.spec_wasted_s == 1.5 and it2.infra_releases == 0
+    assert set(COUNTER_FOLD) <= set(it2.as_dict())

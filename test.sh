@@ -39,10 +39,17 @@ echo "replication smoke: r-1 replica kills absorbed with zero map re-runs"
 python -m pytest tests/test_chaos.py::test_speculation_smoke_straggler \
     tests/test_speculation.py -q
 echo "speculation smoke: straggler covered by a clone, zero rep bumps"
+# trace smoke gate (DESIGN §22): one traced run must yield body spans,
+# per-op histograms, and a schema-valid Chrome export — and a traced
+# twin must stay byte-identical to the tracing-off run (spans live
+# under the _trace. prefix, outside every engine namespace)
+python -m pytest tests/test_trace.py -q -k "smoke"
+echo "trace smoke: spans collected, exports valid, bytes unchanged"
 # lmr-analyze gate: the framework-aware lint pass must be clean against
 # the checked-in suppression baseline (analysis/baseline.json — shipped
 # EMPTY; LMR009 keeps every engine spill publish on the replication
-# helper), and the lease-protocol model checker must exhaustively pass
+# helper, LMR010 keeps trace/ timing on the injectable clock), and the
+# lease-protocol model checker must exhaustively pass
 # the 2-worker lifecycle (worker death included), the replica-recovery
 # (reconstruct-vs-requeue) edge, AND the speculation (duplicate-lease /
 # first-commit-wins / revoke) edge while re-finding all five seeded

@@ -365,6 +365,57 @@ def test_lmr009_replication_helper_and_other_paths_pass(tmp_path):
     assert all(f.rule != "LMR009" for f in got)
 
 
+# --- LMR010 injectable clock in trace/ --------------------------------------
+
+def test_lmr010_direct_clock_reads_in_trace_flagged(tmp_path):
+    got = _lint_snippet(tmp_path, "trace/fx.py", """\
+        import time
+
+        class Recorder:
+            def op(self, name):
+                t0 = time.time()
+                self._spans.append((name, t0, time.perf_counter()))
+
+        def stamp():
+            return time.monotonic_ns()
+        """)
+    assert [f.rule for f in got] == ["LMR010"] * 3
+    assert "injectable clock" in got[0].message
+
+
+def test_lmr010_injectable_clock_patterns_pass(tmp_path):
+    # the injection point itself (a default-arg REFERENCE to time.time)
+    # and reads routed through the injected clock are the legal shapes
+    got = _lint_snippet(tmp_path, "trace/fx.py", """\
+        import time
+
+        class Recorder:
+            def __init__(self, clock=time.time):
+                self._clock = clock
+
+            def op(self, name):
+                t0 = self._clock()
+                self._spans.append((name, t0, self._clock()))
+
+        def wait(tracer):
+            time.sleep(0.1)        # sleeping is not a timestamp read
+            return tracer.clock()
+        """)
+    assert got == []
+
+
+def test_lmr010_scoped_to_trace(tmp_path):
+    # engine job timing (JobTimes) predates the tracer and keeps its
+    # own clock — the rule must not fire outside trace/
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        import time
+
+        def run_job():
+            return time.time()
+        """)
+    assert all(f.rule != "LMR010" for f in got)
+
+
 # --- LMR007 jax purity -----------------------------------------------------
 
 def test_lmr007_impure_traced_functions_flagged(tmp_path):
@@ -445,7 +496,8 @@ def test_shipped_baseline_is_empty():
 
 def test_rule_catalog_complete():
     rules = lint_mod.all_rules()
-    assert [r.id for r in rules] == [f"LMR00{i}" for i in range(1, 10)]
+    assert [r.id for r in rules] == \
+        [f"LMR00{i}" for i in range(1, 10)] + ["LMR010"]
     for r in rules:
         assert r.title and r.rationale and r.severity in ("error", "warning")
 

@@ -1,0 +1,443 @@
+"""lmr-trace suite (DESIGN §22): span chains, histograms, export, and
+the tracing-off/on invariants.
+
+The acceptance legs:
+
+1. **byte-identity** — tracing-on runs produce byte-identical result
+   files to tracing-off twins (spans live under the ``_trace.`` prefix,
+   outside every engine namespace);
+2. **trace completeness under chaos** — with a seeded FaultPlan active,
+   every committed job still shows an unbroken claim → body → commit
+   span chain, retry attempts appear as error-tagged child spans, and
+   the Chrome trace-event export of the chaos run validates against the
+   schema oracle;
+3. **speculation chains** — a slow-plan straggler run shows exactly one
+   commit span per job (first-commit-wins), the clone's speculative
+   claim, and the loser's chain;
+4. **errors-stream linkage** — a chaos-injected fault's error entry
+   carries the span id of the job body that was live when it fired, and
+   that id resolves in the collected trace;
+5. **fold drift** — Server and LocalExecutor surface the identical
+   IterationStats counter key set through the one shared fold helper.
+
+The ``smoke`` legs are the test.sh trace gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from typing import Dict
+
+import pytest
+
+from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+from lua_mapreduce_tpu.core.constants import Status
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.local import LocalExecutor, iter_results
+from lua_mapreduce_tpu.engine.server import Server
+from lua_mapreduce_tpu.engine.worker import MAP_NS, PRE_NS, RED_NS, Worker
+from lua_mapreduce_tpu.faults import FaultPlan, install_fault_plan
+from lua_mapreduce_tpu.store.router import get_storage_from
+from lua_mapreduce_tpu.trace import (TraceCollection, Tracer, install_tracer,
+                                     validate_chrome)
+from lua_mapreduce_tpu.utils.stats import COUNTER_FOLD, IterationStats
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CORPUS = {
+    f"doc{i}": " ".join(f"w{(i * 5 + j) % 17}" for j in range(30))
+    for i in range(6)
+}
+GOLDEN: Dict[str, int] = {}
+for _text in CORPUS.values():
+    for _w in _text.split():
+        GOLDEN[_w] = GOLDEN.get(_w, 0) + 1
+
+_MOD = "tests._trace_wc"
+
+
+def _install_module():
+    import types
+
+    mod = sys.modules.get(_MOD)
+    if mod is None:
+        mod = types.ModuleType(_MOD)
+
+        def taskfn(emit):
+            for k, v in sorted(CORPUS.items()):
+                emit(k, v)
+
+        def mapfn(key, value, emit):
+            for w in value.split():
+                emit(w, 1)
+
+        mod.taskfn = taskfn
+        mod.mapfn = mapfn
+        mod.partitionfn = lambda key: sum(key.encode()) % 3
+        mod.reducefn = lambda key, values: sum(values)
+        sys.modules[_MOD] = mod
+    return mod
+
+
+def _storage(tmp_path, backend, tag):
+    return {"mem": f"mem:{tag}",
+            "shared": f"shared:{tmp_path}/shared-{tag}"}[backend]
+
+
+def _result_bytes(storage_spec, ns="result"):
+    """Final result files only — the byte-compare oracle (span files
+    live under _trace. and must never leak into the result namespace)."""
+    import re
+    store = get_storage_from(storage_spec)
+    keep = re.compile(rf"^{re.escape(ns)}\.P\d+$")
+    return {name: "".join(store.lines(name))
+            for name in store.list(f"{ns}.P*") if keep.match(name)}
+
+
+def _run_local(tmp_path, backend, tag, traced, pipeline=True, plan=None):
+    _install_module()
+    spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
+                    reducefn=_MOD, storage=_storage(tmp_path, backend, tag))
+    install_fault_plan(plan)
+    install_tracer(Tracer() if traced else None)
+    try:
+        ex = LocalExecutor(spec, map_parallelism=3, pipeline=pipeline,
+                           premerge_min_runs=2)
+        stats = ex.run()
+    finally:
+        install_tracer(None)
+        install_fault_plan(None)
+    assert {k: v[0] for k, v in ex.results()} == GOLDEN
+    return spec, stats
+
+
+def _run_distributed(tmp_path, backend, tag, traced, plan=None,
+                     n_workers=2, speculation=0.0, straggler=False,
+                     batch_k=2, pipeline=False):
+    _install_module()
+    spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
+                    reducefn=_MOD, storage=_storage(tmp_path, backend, tag))
+    store = MemJobStore()
+    install_fault_plan(plan)
+    install_tracer(Tracer() if traced else None)
+    try:
+        server = Server(store, poll_interval=0.01, pipeline=pipeline,
+                        premerge_min_runs=2, batch_k=batch_k,
+                        speculation=speculation).configure(spec)
+        names = ([f"healthy-{i}" for i in range(n_workers - 1)]
+                 + ["straggler-0"] if straggler else [None] * n_workers)
+        workers = [Worker(store, name=names[i]).configure(max_iter=800,
+                                                          max_sleep=0.02)
+                   for i in range(n_workers)]
+        threads = [threading.Thread(target=w.execute, daemon=True)
+                   for w in workers]
+        if straggler:
+            final = {}
+            st = threading.Thread(
+                target=lambda: final.setdefault("stats", server.loop()),
+                daemon=True)
+            st.start()
+            threads[-1].start()
+            _wait_for_claim(store)
+            for t in threads[:-1]:
+                t.start()
+            st.join(timeout=120)
+            assert not st.is_alive(), "server wedged under the straggler"
+            stats = final["stats"]
+        else:
+            for t in threads:
+                t.start()
+            stats = server.loop()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        install_tracer(None)
+        install_fault_plan(None)
+    got = {k: v[0]
+           for k, v in iter_results(get_storage_from(spec.storage),
+                                    "result")}
+    assert got == GOLDEN
+    return spec, store, stats, server
+
+
+def _wait_for_claim(store, timeout=30.0):
+    import time as _t
+    deadline = _t.time() + timeout
+    while _t.time() < deadline:
+        try:
+            if store.counts(MAP_NS)[Status.RUNNING] > 0:
+                return
+        except Exception:
+            pass
+        _t.sleep(0.005)
+    raise AssertionError("straggler never claimed a lease")
+
+
+def _committed(store):
+    return [(ns, d["_id"]) for ns in (MAP_NS, PRE_NS, RED_NS)
+            for d in store.jobs(ns) if d["status"] == Status.WRITTEN]
+
+
+# --- smoke legs: the test.sh trace gate --------------------------------------
+
+def test_trace_smoke_local_artifacts(tmp_path):
+    """One traced local pipelined run: body spans for every phase,
+    per-op histograms, a waterfall, and a schema-valid Chrome export."""
+    spec, _ = _run_local(tmp_path, "mem", "tr-smoke", traced=True)
+    col = TraceCollection.from_store(get_storage_from(spec.storage))
+    assert col.spans, "traced run flushed no spans"
+    phases = {r["phase"] for r in col.phase_waterfall()}
+    assert {"map", "reduce"} <= phases
+    ops = col.op_stats()
+    assert ops, "no op spans recorded"
+    for name, st in ops.items():
+        assert st["count"] > 0 and st["p50_ms"] <= st["p99_ms"] \
+            <= st["max_ms"] + 1e-9, (name, st)
+    assert any(n.startswith("store.") for n in ops)
+    doc = col.to_chrome()
+    assert validate_chrome(doc) == []
+    assert any(e["ph"] == "X" and e["name"] == "map.body"
+               for e in doc["traceEvents"])
+    assert col.slowest_jobs(3)
+
+
+def test_trace_smoke_off_on_byte_identical(tmp_path):
+    """The golden invariant: tracing changes observability, never
+    bytes. Off and on twins of the same task produce identical result
+    files; the traced store additionally holds _trace.* files and the
+    untraced one holds none."""
+    for backend in ("mem", "shared"):
+        _run_local(tmp_path, backend, f"tr-off-{backend}", traced=False)
+        _run_local(tmp_path, backend, f"tr-on-{backend}", traced=True)
+        off = _result_bytes(_storage(tmp_path, backend,
+                                     f"tr-off-{backend}"))
+        on = _result_bytes(_storage(tmp_path, backend,
+                                    f"tr-on-{backend}"))
+        assert off == on, f"{backend}: tracing changed result bytes"
+        off_store = get_storage_from(_storage(tmp_path, backend,
+                                              f"tr-off-{backend}"))
+        on_store = get_storage_from(_storage(tmp_path, backend,
+                                             f"tr-on-{backend}"))
+        assert off_store.list("_trace.*") == []
+        assert on_store.list("_trace.*") != []
+
+
+def test_trace_off_wiring_is_absent():
+    """With no tracer active the wrapper layer simply does not exist —
+    the overhead story is structural, not measured."""
+    from lua_mapreduce_tpu.faults.wrappers import (unwrap, wrap_jobstore,
+                                                   wrap_store)
+    from lua_mapreduce_tpu.store.memfs import MemStore
+    from lua_mapreduce_tpu.trace.wrappers import (TracingJobStore,
+                                                  TracingStore)
+    raw = MemStore()
+    layers = []
+    obj = wrap_store(raw)
+    while hasattr(obj, "_inner"):
+        layers.append(type(obj).__name__)
+        obj = obj._inner
+    assert "TracingStore" not in layers
+    js = MemJobStore()
+    wrapped = wrap_jobstore(js)
+    assert unwrap(wrapped) is js
+    layers = []
+    obj = wrapped
+    while hasattr(obj, "_inner"):
+        layers.append(type(obj).__name__)
+        obj = obj._inner
+    assert "TracingJobStore" not in layers
+    # and with a tracer installed, both layers appear
+    install_tracer(Tracer())
+    try:
+        obj = wrap_store(MemStore())
+        names = []
+        while hasattr(obj, "_inner"):
+            names.append(type(obj).__name__)
+            obj = obj._inner
+        assert "TracingStore" in names
+        obj = wrap_jobstore(MemJobStore())
+        names = []
+        while hasattr(obj, "_inner"):
+            names.append(type(obj).__name__)
+            obj = obj._inner
+        assert "TracingJobStore" in names
+        assert isinstance(wrap_jobstore(wrapped), type(wrapped))
+    finally:
+        install_tracer(None)
+
+
+# --- chaos-matrix legs -------------------------------------------------------
+
+def _chaos_plan(seed):
+    """The chaos-suite mix (test_chaos._plan's shape): transient +
+    error-after-write bursts, absorbable within the default retry
+    budget, so completeness is asserted under real retries."""
+    return FaultPlan(seed, transient=0.08, latency=0.05,
+                     error_after_write=0.3, latency_ms=1.0, max_per_key=2)
+
+
+@pytest.mark.parametrize("pipeline", [False, True],
+                         ids=["barrier", "pipelined"])
+def test_trace_completeness_under_chaos(tmp_path, pipeline):
+    """The acceptance gate: a traced chaos run keeps an unbroken
+    claim → body → commit chain for EVERY committed job, injected-fault
+    retry attempts appear as error-tagged child spans, and the Chrome
+    export of the whole chaos run validates."""
+    plan = _chaos_plan(29 + int(pipeline))
+    spec, store, stats, _ = _run_distributed(
+        tmp_path, "mem", f"tr-chaos-{int(pipeline)}", traced=True,
+        plan=plan, pipeline=pipeline)
+    assert plan.total_fired() > 0, "plan injected nothing"
+    committed = _committed(store)
+    assert committed
+    col = TraceCollection.from_store(get_storage_from(spec.storage))
+    problems = col.check_complete(committed)
+    assert problems == [], f"broken chains: {problems}"
+    # the injected faults are visible as error-tagged attempt spans,
+    # and at least one hangs under a job body (the causal link — the
+    # server's own housekeeping faults legitimately have no body parent)
+    errored = [s for s in col.spans
+               if s.get("attrs", {}).get("error", "").startswith("Injected")]
+    assert errored, "no injected-fault attempt spans recorded"
+    under_body = [s for s in errored
+                  if col.by_sid.get(s.get("parent"), {}).get(
+                      "name", "").endswith(".body")]
+    assert under_body, "no attempt span parented to a job body"
+    doc = col.to_chrome()
+    assert validate_chrome(doc) == []
+    # tracing-on chaos twin keeps golden bytes (checked in the runner)
+    # and zero repetition charges — tracing must not perturb recovery
+    for ns in (MAP_NS, PRE_NS, RED_NS):
+        for d in store.jobs(ns):
+            assert d["repetitions"] == 0
+
+
+def test_trace_speculation_winner_and_loser_chains(tmp_path):
+    """A slow-plan straggler with speculation on: the speculated job
+    shows exactly one commit span (first-commit-wins), the clone's
+    speculative claim span, and a loser chain — a second worker's body
+    with no commit, or a cancelled clone."""
+    plan = FaultPlan(91, slow_worker="straggler-*", slow_ms=120.0,
+                     slow_s=3600.0)
+    spec, store, stats, _ = _run_distributed(
+        tmp_path, "mem", "tr-spec", traced=True, plan=plan, n_workers=3,
+        speculation=3.0, straggler=True, batch_k=1)
+    it = stats.iterations[-1]
+    assert it.spec_launched >= 1 and it.spec_wins >= 1
+    col = TraceCollection.from_store(get_storage_from(spec.storage))
+    committed = _committed(store)
+    assert col.check_complete(committed) == []
+    outcomes = col.speculation_outcomes()
+    assert outcomes, "no speculative claim spans recorded"
+    assert all(o["commit_count"] == 1 for o in outcomes), \
+        "a commit race produced more than one commit span"
+    # at least one speculated job resolved with a visible loser:
+    # a second executor's body span, or a cancelled shadow lease
+    assert any(o["losers"] or o["cancelled"] for o in outcomes), outcomes
+    assert validate_chrome(col.to_chrome()) == []
+
+
+def test_error_entry_links_to_live_span(tmp_path):
+    """Satellite: a chaos-injected fault that releases a job writes an
+    errors-stream entry carrying the span id of the job body that was
+    live when it fired — and the id resolves to a real span (name and
+    job context match) in the collected trace."""
+    # transient faults pinned to ONE partition-0 run file, outlasting
+    # the retry budget (3): the reduce body exhausts, releases (zero
+    # reps), and the re-execution's occurrence indices advance past the
+    # faults. One file only — a per-file budget across the whole fan-in
+    # would burn the per-worker release budget and march P0 to FAILED
+    plan = FaultPlan(37, transient=1.0, pattern="result.P0.M00000000",
+                     max_per_key=4)
+    spec, store, stats, server = _run_distributed(
+        tmp_path, "mem", "tr-errlink", traced=True, plan=plan)
+    assert stats.iterations[-1].infra_releases >= 1
+    linked = [e for e in server.errors if e.get("span_id")]
+    assert linked, f"no error entry carries a span id: {server.errors}"
+    col = TraceCollection.from_store(get_storage_from(spec.storage))
+    for e in linked:
+        sp = col.by_sid.get(e["span_id"])
+        assert sp is not None, f"span {e['span_id']} not in the trace"
+        assert sp["name"].endswith(".body")
+        assert sp["ns"] == e["ns"] and sp["job"] == e["job_id"]
+        assert sp["worker"] == e["span_worker"] == e["worker"]
+        assert sp.get("attrs", {}).get("error")  # the failing body
+
+
+# --- counter-fold drift (satellite) ------------------------------------------
+
+def test_counter_fold_shared_and_key_sets_identical(tmp_path, monkeypatch):
+    """Both executors must route their per-iteration counter folding
+    through stats.IterationStats.fold_fault_counters and surface the
+    identical counter key set — the drift that motivated the helper
+    (LocalExecutor silently never folded infra_releases)."""
+    calls = []
+    orig = IterationStats.fold_fault_counters
+
+    def spy(self, delta):
+        calls.append(sorted(delta))
+        return orig(self, delta)
+
+    monkeypatch.setattr(IterationStats, "fold_fault_counters", spy)
+    _, local_stats = _run_local(tmp_path, "mem", "fold-local",
+                                traced=False, pipeline=False)
+    assert calls, "LocalExecutor bypassed the shared fold helper"
+    n_local = len(calls)
+    _, _, dist_stats, _ = _run_distributed(tmp_path, "mem", "fold-dist",
+                                           traced=False)
+    assert len(calls) > n_local, "Server bypassed the shared fold helper"
+
+    local_keys = set(local_stats.iterations[-1].as_dict())
+    dist_keys = set(dist_stats.iterations[-1].as_dict())
+    assert local_keys == dist_keys
+    # every fold-managed field is a real dataclass field AND surfaced
+    import dataclasses
+    fields = {f.name for f in dataclasses.fields(IterationStats)}
+    assert set(COUNTER_FOLD) <= fields
+    assert set(COUNTER_FOLD) <= local_keys
+
+
+# --- CLI ---------------------------------------------------------------------
+
+def test_trace_cli_report_and_export(tmp_path):
+    """``python -m lua_mapreduce_tpu.trace`` over a traced shared-store
+    run: the JSON report carries phases/ops, and --export writes
+    schema-valid Chrome trace-event JSON."""
+    _run_local(tmp_path, "shared", "tr-cli", traced=True)
+    storage = _storage(tmp_path, "shared", "tr-cli")
+    out = tmp_path / "chrome.json"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "lua_mapreduce_tpu.trace", storage,
+         "--export", str(out), "--format", "json"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["spans"] > 0 and rep["ops"]
+    assert {row["phase"] for row in rep["phases"]} >= {"map", "reduce"}
+    with open(out) as f:
+        doc = json.load(f)
+    assert validate_chrome(doc) == []
+    # an untraced store reports cleanly (exit 1, no crash)
+    r = subprocess.run(
+        [sys.executable, "-m", "lua_mapreduce_tpu.trace",
+         f"shared:{tmp_path}/empty-ns"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert r.returncode == 1 and "no _trace" in r.stderr
+
+
+def test_cli_parsers_accept_trace_and_profile():
+    """Satellite: --trace / --profile exist on BOTH distributed CLIs
+    (until now only train_lm had --profile)."""
+    from lua_mapreduce_tpu.cli.execute_server import \
+        build_parser as server_parser
+    from lua_mapreduce_tpu.cli.execute_worker import \
+        build_parser as worker_parser
+    a = server_parser().parse_args(
+        ["coord", "t", "m", "p", "r", "--trace", "--profile", "/tmp/prof"])
+    assert a.trace and a.profile == "/tmp/prof"
+    a = worker_parser().parse_args(["coord", "--trace", "--profile",
+                                    "/tmp/prof"])
+    assert a.trace and a.profile == "/tmp/prof"
