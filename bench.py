@@ -396,6 +396,49 @@ def _trace_fields() -> dict:
     return out
 
 
+def _sched_fields() -> dict:
+    """Detail fields for lmr-sched (DESIGN §23): a small live run of
+    the coord_bench sched legs (poll-vs-notify dispatch latency at a
+    dozen concurrent tenant tasks plus the fairness pair), then the
+    committed artifact's headline numbers — dispatch p50/p99 speedup
+    and jobs/sec at 100 concurrent small tasks vs the polling baseline,
+    and the starvation bound (a flooded barrier tenant's p99 as a
+    fraction of the FIFO flood drain). Never sinks the flagship
+    metric."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = {}
+    try:
+        from benchmarks.coord_bench import run_sched
+        r = run_sched(n_tenants=12, jobs_per_tenant=2, n_workers=4,
+                      rounds=1, submit_window_s=0.4)
+        out = {
+            "sched_dispatch_p50_speedup_live_1round":
+                r["dispatch_p50_speedup"],
+            "sched_fairness_gain_live": r["fairness_gain"],
+        }
+    except Exception as e:
+        out = {"sched_bench_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        with open(os.path.join(here, "benchmarks", "results",
+                               "sched.json")) as f:
+            art = json.load(f)
+        out["sched_dispatch_p50_speedup"] = art["dispatch_p50_speedup"]
+        out["dispatch_latency_p50_ms"] = art["dispatch_p50_ms_notify"]
+        out["dispatch_latency_p99_ms"] = art["dispatch_p99_ms_notify"]
+        out["dispatch_latency_p50_ms_poll"] = art["dispatch_p50_ms_poll"]
+        out["dispatch_latency_p99_ms_poll"] = art["dispatch_p99_ms_poll"]
+        out["sched_jobs_per_s_speedup_100t"] = art["jobs_per_s_speedup"]
+        out["sched_chain_jobs_per_s_speedup"] = \
+            art["chain_jobs_per_s_speedup"]
+        out["sched_fairness_gain"] = art["fairness_gain"]
+        out["sched_barrier_p99_vs_flood_drain"] = \
+            art["barrier_p99_vs_flood_drain"]
+    except Exception:
+        pass
+    return out
+
+
 def _analysis_fields() -> dict:
     """Detail fields for the analysis subsystem (DESIGN §18): the lint
     pass's wall time over the whole package (it gates test.sh, so its
@@ -520,6 +563,7 @@ def main() -> None:
         # single-claim protocol (benchmarks/coord_bench.py; >1.0 =
         # batching wins on a many-tiny-jobs FileJobStore workload)
         **_coord_batch_fields(),
+        **_sched_fields(),
         # host-side data plane encoding: v2 framed binary segments vs
         # v1 text lines (benchmarks/segment_bench.py; >1.0 = frames win
         # on the IO-bound shuffle leg, byte-identical outputs)

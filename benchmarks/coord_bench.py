@@ -332,7 +332,366 @@ def run(n_workers: int = 0, n_jobs: int = 300, batch_k: int = 16,
     return out
 
 
+# --------------------------------------------------------------------------
+# lmr-sched dispatch-latency + fairness legs (DESIGN §23)
+# --------------------------------------------------------------------------
+
+SCHED_RESULTS = os.path.join(REPO, "benchmarks", "results", "sched.json")
+
+_SCHED_MOD = "benchmarks.sched_task"
+
+
+def _pctl(xs, q):
+    from lua_mapreduce_tpu.trace.collect import percentile
+    return percentile(xs, q)
+
+
+def _sched_spec():
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    return TaskSpec(taskfn=_SCHED_MOD, mapfn=_SCHED_MOD,
+                    partitionfn=_SCHED_MOD, reducefn=_SCHED_MOD,
+                    storage="mem:sched_bench")
+
+
+def _with_notify(on: bool):
+    """Context manager pinning LMR_SCHED_NOTIFY for one leg."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        prev = os.environ.get("LMR_SCHED_NOTIFY")
+        os.environ["LMR_SCHED_NOTIFY"] = "1" if on else "0"
+        try:
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop("LMR_SCHED_NOTIFY", None)
+            else:
+                os.environ["LMR_SCHED_NOTIFY"] = prev
+    return ctx()
+
+
+def _start_fair_pool(store, tenants, n_workers, max_sleep):
+    import threading
+
+    from lua_mapreduce_tpu.sched import FairScheduler, FairWorker
+    sched = FairScheduler(tenants)
+    workers = [FairWorker(store, tenants, scheduler=sched,
+                          name=f"fw{i}", max_iter=100_000,
+                          max_sleep=max_sleep, heartbeat_s=None)
+               for i in range(n_workers)]
+    threads = [threading.Thread(target=w.execute, daemon=True)
+               for w in workers]
+    for t in threads:
+        t.start()
+    return sched, workers, threads
+
+
+def _drain(views, want, timeout_s=120.0):
+    """Block until every tenant view shows ``want`` WRITTEN map jobs."""
+    from lua_mapreduce_tpu.core.constants import Status
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        if all(v.counts("map_jobs")[Status.WRITTEN] >= want[v.tenant.name]
+               for v in views.values()):
+            return
+        time.sleep(0.005)
+    raise TimeoutError("sched bench: jobs did not drain in time")
+
+
+def _finish_all(store, views, threads):
+    from lua_mapreduce_tpu.core.constants import TaskStatus
+    from lua_mapreduce_tpu.sched.waiter import notify
+    for v in views.values():
+        v.update_task({"status": TaskStatus.FINISHED.value})
+    notify(store, "jobs")
+    for t in threads:
+        t.join(timeout=30)
+
+
+def _collect_dispatch(store, views):
+    """Per-tenant dispatch latencies + the throughput window from the
+    job records (insert stamp → claim stamp; written stamp closes the
+    window), so driver poll delays never count."""
+    from lua_mapreduce_tpu.sched import dispatch_latencies
+    lats = {}
+    t_first, t_last = float("inf"), 0.0
+    for name, v in views.items():
+        lats[name] = dispatch_latencies(store, name)
+        for doc in v.jobs("map_jobs"):
+            if doc.get("creation_time"):
+                t_first = min(t_first, doc["creation_time"])
+            if doc.get("times") and doc["times"].get("written"):
+                t_last = max(t_last, doc["times"]["written"])
+    return lats, max(1e-9, t_last - t_first)
+
+
+def _sched_leg(notify_on: bool, n_tenants: int, jobs_per_tenant: int,
+               n_workers: int, submit_window_s: float) -> dict:
+    """One dispatch-latency leg: ``n_tenants`` concurrent small tasks on
+    ONE shared MemJobStore, jobs inserted round-robin over the submit
+    window, a FairWorker pool draining them. The poll baseline
+    (notify off) is today's engine verbatim; the notify leg differs
+    ONLY in the wakeup channel."""
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore, make_job
+    from lua_mapreduce_tpu.core.constants import TaskStatus
+    from lua_mapreduce_tpu.sched import Tenant, TenantView
+    from lua_mapreduce_tpu.sched.waiter import notify
+
+    with _with_notify(notify_on):
+        store = MemJobStore()
+        tenants = [Tenant(f"t{i:03d}") for i in range(n_tenants)]
+        views = {t.name: TenantView(store, t) for t in tenants}
+        desc = _sched_spec().describe()
+        for v in views.values():
+            v.put_task({"_id": "unique", "status": TaskStatus.MAP.value,
+                        "iteration": 1, "spec": desc, "batch_k": 1})
+        _sched, _workers, threads = _start_fair_pool(
+            store, tenants, n_workers, max_sleep=0.6)
+        gap = submit_window_s / max(1, n_tenants * jobs_per_tenant)
+        for j in range(jobs_per_tenant):
+            for t in tenants:
+                views[t.name].insert_jobs("map_jobs",
+                                          [make_job(f"j{j}", j)])
+                # the bench plays the server's producer role: jobs
+                # land, then the wakeup fires (Server._prepare_map's
+                # order)
+                notify(store, "jobs")
+                time.sleep(gap)
+        _drain(views, {t.name: jobs_per_tenant for t in tenants})
+        lats, window_s = _collect_dispatch(store, views)
+        _finish_all(store, views, threads)
+    all_ms = [1000.0 * x for ls in lats.values() for x in ls]
+    total = n_tenants * jobs_per_tenant
+    return {"mode": "notify" if notify_on else "poll",
+            "tenants": n_tenants, "jobs": total,
+            "dispatch_p50_ms": round(_pctl(all_ms, 50), 3),
+            "dispatch_p99_ms": round(_pctl(all_ms, 99), 3),
+            "dispatch_max_ms": round(max(all_ms), 3) if all_ms else 0.0,
+            "jobs_per_s": round(total / window_s, 1),
+            "window_s": round(window_s, 3)}
+
+
+def _burst_leg(notify_on: bool, n_tenants: int, jobs_per_tenant: int,
+               n_workers: int) -> dict:
+    """Burst-absorption throughput at ``n_tenants`` concurrent tasks:
+    the pool settles into idle backoff, then every tenant's jobs land
+    at once — jobs/sec over the drain window (first insert → last
+    commit) measures how fast the fleet ABSORBS offered load, which is
+    dispatch-bound by construction."""
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore, make_job
+    from lua_mapreduce_tpu.core.constants import TaskStatus
+    from lua_mapreduce_tpu.sched import Tenant, TenantView
+    from lua_mapreduce_tpu.sched.waiter import notify
+
+    with _with_notify(notify_on):
+        store = MemJobStore()
+        tenants = [Tenant(f"t{i:03d}") for i in range(n_tenants)]
+        views = {t.name: TenantView(store, t) for t in tenants}
+        desc = _sched_spec().describe()
+        for v in views.values():
+            v.put_task({"_id": "unique", "status": TaskStatus.MAP.value,
+                        "iteration": 1, "spec": desc, "batch_k": 1})
+        _sched, _workers, threads = _start_fair_pool(
+            store, tenants, n_workers, max_sleep=0.6)
+        time.sleep(0.7)          # settle into deep idle backoff
+        for t in tenants:
+            views[t.name].insert_jobs(
+                "map_jobs",
+                [make_job(f"j{j}", j) for j in range(jobs_per_tenant)])
+        notify(store, "jobs")
+        _drain(views, {t.name: jobs_per_tenant for t in tenants})
+        lats, window_s = _collect_dispatch(store, views)
+        _finish_all(store, views, threads)
+    all_ms = [1000.0 * x for ls in lats.values() for x in ls]
+    total = n_tenants * jobs_per_tenant
+    return {"mode": "notify" if notify_on else "poll", "jobs": total,
+            "jobs_per_s": round(total / window_s, 1),
+            "dispatch_p50_ms": round(_pctl(all_ms, 50), 3),
+            "dispatch_p99_ms": round(_pctl(all_ms, 99), 3),
+            "window_s": round(window_s, 3)}
+
+
+def _chain_leg(notify_on: bool, n_jobs: int = 60,
+               n_workers: int = 2) -> dict:
+    """Chained-dispatch throughput: job i+1 is submitted only after job
+    i committed — the serverless invocation-chain shape where dispatch
+    latency IS the throughput bound (FaaSTube's fast-provisioning
+    argument, PAPERS.md). The driver detects commits on a tight probe
+    in both legs, so the measured difference is purely how fast an idle
+    worker learns about the next job."""
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore, make_job
+    from lua_mapreduce_tpu.core.constants import Status, TaskStatus
+    from lua_mapreduce_tpu.sched import Tenant, TenantView
+    from lua_mapreduce_tpu.sched.waiter import notify
+
+    with _with_notify(notify_on):
+        store = MemJobStore()
+        tenants = [Tenant("chain")]
+        views = {"chain": TenantView(store, tenants[0])}
+        views["chain"].put_task({"_id": "unique",
+                                 "status": TaskStatus.MAP.value,
+                                 "iteration": 1,
+                                 "spec": _sched_spec().describe(),
+                                 "batch_k": 1})
+        _sched, _workers, threads = _start_fair_pool(
+            store, tenants, n_workers, max_sleep=0.6)
+        time.sleep(0.3)          # let the idle pool back off first
+        v = views["chain"]
+        for i in range(n_jobs):
+            v.insert_jobs("map_jobs", [make_job(f"c{i}", i)])
+            notify(store, "jobs")
+            deadline = time.perf_counter() + 30.0
+            while v.counts("map_jobs")[Status.WRITTEN] <= i:
+                if time.perf_counter() > deadline:
+                    raise TimeoutError("chain leg: job did not commit")
+                time.sleep(0.001)
+        lats, window_s = _collect_dispatch(store, views)
+        _finish_all(store, views, threads)
+    ms = [1000.0 * x for x in lats["chain"]]
+    return {"mode": "notify" if notify_on else "poll", "jobs": n_jobs,
+            "jobs_per_s": round(n_jobs / window_s, 1),
+            "dispatch_p50_ms": round(_pctl(ms, 50), 3),
+            "window_s": round(window_s, 3)}
+
+
+def _fairness_leg(fair: bool, n_workers: int = 4, flood_jobs: int = 120,
+                  barrier_jobs: int = 8) -> dict:
+    """Starvation leg: a flood tenant dumps ``flood_jobs`` tiny jobs,
+    then a barrier tenant submits ``barrier_jobs``. ``fair=True`` runs
+    two weighted-fair tenants; ``fair=False`` is the no-tenancy
+    baseline — one FIFO queue where the barrier jobs ride behind the
+    whole flood backlog."""
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore, make_job
+    from lua_mapreduce_tpu.core.constants import TaskStatus
+    from lua_mapreduce_tpu.sched import Tenant, TenantView
+    from lua_mapreduce_tpu.sched.waiter import notify
+
+    store = MemJobStore()
+    if fair:
+        tenants = [Tenant("flood"), Tenant("barrier")]
+    else:
+        tenants = [Tenant("flood")]
+    views = {t.name: TenantView(store, t) for t in tenants}
+    desc = _sched_spec().describe()
+    for v in views.values():
+        v.put_task({"_id": "unique", "status": TaskStatus.MAP.value,
+                    "iteration": 1, "spec": desc, "batch_k": 1})
+    _sched, _workers, threads = _start_fair_pool(store, tenants,
+                                                 n_workers, max_sleep=0.6)
+    views["flood"].insert_jobs(
+        "map_jobs", [make_job(f"f{i}", i) for i in range(flood_jobs)])
+    barrier_view = views["barrier"] if fair else views["flood"]
+    first_barrier = 0 if fair else flood_jobs
+    barrier_view.insert_jobs(
+        "map_jobs", [make_job(f"b{i}", i) for i in range(barrier_jobs)])
+    notify(store, "jobs")
+    want = {"flood": flood_jobs + (0 if fair else barrier_jobs)}
+    if fair:
+        want["barrier"] = barrier_jobs
+    _drain(views, want)
+    lats, window_s = _collect_dispatch(store, views)
+    _finish_all(store, views, threads)
+    if fair:
+        barrier_ms = [1000.0 * x for x in lats["barrier"]]
+        flood_ms = [1000.0 * x for x in lats["flood"]]
+    else:
+        every = lats["flood"]
+        barrier_ms = [1000.0 * x for x in every[first_barrier:]]
+        flood_ms = [1000.0 * x for x in every[:first_barrier]]
+    return {"mode": "fair" if fair else "fifo",
+            "barrier_p50_ms": round(_pctl(barrier_ms, 50), 3),
+            "barrier_p99_ms": round(_pctl(barrier_ms, 99), 3),
+            "flood_p99_ms": round(_pctl(flood_ms, 99), 3),
+            "flood_drain_s": round(window_s, 3)}
+
+
+def run_sched(n_tenants: int = 100, jobs_per_tenant: int = 2,
+              n_workers: int = 8, rounds: int = 3,
+              submit_window_s: float = 1.5) -> dict:
+    """The sched artifact: paired poll-vs-notify dispatch rounds at
+    ``n_tenants`` concurrent tasks (order alternated per round, medians
+    reported) plus the fair-vs-FIFO starvation legs. Headline:
+    ``dispatch_p50_speedup`` / ``dispatch_p99_speedup`` (poll over
+    notify — higher is better for notify) and ``fairness_gain`` (the
+    FIFO baseline's barrier p99 over the fair one's)."""
+    legs = {"poll": [], "notify": []}
+    bursts = {"poll": [], "notify": []}
+    chains = {"poll": [], "notify": []}
+    for i in range(max(1, rounds)):
+        order = (False, True) if i % 2 == 0 else (True, False)
+        for notify_on in order:
+            leg = _sched_leg(notify_on, n_tenants, jobs_per_tenant,
+                             n_workers, submit_window_s)
+            legs[leg["mode"]].append(leg)
+            burst = _burst_leg(notify_on, n_tenants, jobs_per_tenant,
+                               n_workers)
+            bursts[burst["mode"]].append(burst)
+            chain = _chain_leg(notify_on)
+            chains[chain["mode"]].append(chain)
+    fair_legs = [_fairness_leg(True) for _ in range(max(1, rounds // 2))]
+    fifo_legs = [_fairness_leg(False) for _ in range(max(1, rounds // 2))]
+
+    def med(rows, key):
+        return _median([r[key] for r in rows])
+
+    out = {"n_tenants": n_tenants, "jobs_per_tenant": jobs_per_tenant,
+           "n_workers": n_workers, "rounds": rounds,
+           "poll": legs["poll"][len(legs["poll"]) // 2],
+           "notify": legs["notify"][len(legs["notify"]) // 2],
+           "dispatch_p50_ms_poll": med(legs["poll"], "dispatch_p50_ms"),
+           "dispatch_p50_ms_notify": med(legs["notify"],
+                                         "dispatch_p50_ms"),
+           "dispatch_p99_ms_poll": med(legs["poll"], "dispatch_p99_ms"),
+           "dispatch_p99_ms_notify": med(legs["notify"],
+                                         "dispatch_p99_ms"),
+           "jobs_per_s_offered_poll": med(legs["poll"], "jobs_per_s"),
+           "jobs_per_s_offered_notify": med(legs["notify"], "jobs_per_s"),
+           "burst_poll": bursts["poll"][len(bursts["poll"]) // 2],
+           "burst_notify": bursts["notify"][len(bursts["notify"]) // 2],
+           "jobs_per_s_poll": med(bursts["poll"], "jobs_per_s"),
+           "jobs_per_s_notify": med(bursts["notify"], "jobs_per_s"),
+           "chain_poll": chains["poll"][len(chains["poll"]) // 2],
+           "chain_notify": chains["notify"][len(chains["notify"]) // 2],
+           "chain_jobs_per_s_poll": med(chains["poll"], "jobs_per_s"),
+           "chain_jobs_per_s_notify": med(chains["notify"], "jobs_per_s"),
+           "fair": fair_legs[len(fair_legs) // 2],
+           "fifo": fifo_legs[len(fifo_legs) // 2]}
+    out["dispatch_p50_speedup"] = round(
+        out["dispatch_p50_ms_poll"]
+        / max(out["dispatch_p50_ms_notify"], 1e-6), 2)
+    out["dispatch_p99_speedup"] = round(
+        out["dispatch_p99_ms_poll"]
+        / max(out["dispatch_p99_ms_notify"], 1e-6), 2)
+    # jobs/sec at n_tenants concurrent tasks (burst absorption) and on
+    # the dispatch-gated sequential chain
+    out["jobs_per_s_speedup"] = round(
+        out["jobs_per_s_notify"] / max(out["jobs_per_s_poll"], 1e-9), 3)
+    out["chain_jobs_per_s_speedup"] = round(
+        out["chain_jobs_per_s_notify"]
+        / max(out["chain_jobs_per_s_poll"], 1e-9), 3)
+    out["fairness_gain"] = round(
+        med(fifo_legs, "barrier_p99_ms")
+        / max(med(fair_legs, "barrier_p99_ms"), 1e-6), 2)
+    # the starvation bound: under fairness, the flooded barrier
+    # tenant's p99 as a fraction of draining the WHOLE flood FIFO-style
+    out["barrier_p99_vs_flood_drain"] = round(
+        med(fair_legs, "barrier_p99_ms")
+        / max(1000.0 * med(fifo_legs, "flood_drain_s"), 1e-6), 4)
+    return out
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "sched":
+        tenants = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+        jpt = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+        result = run_sched(tenants, jpt)
+        print(json.dumps(result))
+        os.makedirs(os.path.dirname(SCHED_RESULTS), exist_ok=True)
+        with open(SCHED_RESULTS, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+        raise SystemExit(0)
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 0
     jobs = int(sys.argv[2]) if len(sys.argv) > 2 else 300
     k = int(sys.argv[3]) if len(sys.argv) > 3 else 16

@@ -1,0 +1,24 @@
+"""Near-noop task module for the sched dispatch-latency bench.
+
+Each map job does essentially nothing (one emitted pair, one tiny run
+publish), so the measured interval — payload insert to claim — is pure
+control plane: exactly the dispatch latency the lmr-sched watch/notify
+layer (DESIGN §23) exists to shrink. The task/reduce halves exist only
+so a stock TaskSpec validates; the bench drives job inserts directly.
+"""
+
+
+def taskfn(emit):
+    emit("0", 0)
+
+
+def mapfn(key, value, emit):
+    emit("k", 1)
+
+
+def partitionfn(key):
+    return 0
+
+
+def reducefn(key, values):
+    return sum(values)

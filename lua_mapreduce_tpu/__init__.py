@@ -43,6 +43,14 @@ _LAZY = {
     "Tracer": ("lua_mapreduce_tpu.trace.span", "Tracer"),
     "TraceCollection": ("lua_mapreduce_tpu.trace.collect",
                         "TraceCollection"),
+    # lmr-sched (DESIGN §23)
+    "Tenant": ("lua_mapreduce_tpu.sched.tenancy", "Tenant"),
+    "TenantView": ("lua_mapreduce_tpu.sched.tenancy", "TenantView"),
+    "FairWorker": ("lua_mapreduce_tpu.sched.tenancy", "FairWorker"),
+    "FairScheduler": ("lua_mapreduce_tpu.sched.tenancy", "FairScheduler"),
+    "AdmissionError": ("lua_mapreduce_tpu.sched.tenancy",
+                       "AdmissionError"),
+    "Waiter": ("lua_mapreduce_tpu.sched.waiter", "Waiter"),
 }
 
 
@@ -72,6 +80,12 @@ __all__ = [
     "FaultPlan",
     "Tracer",
     "TraceCollection",
+    "Tenant",
+    "TenantView",
+    "FairWorker",
+    "FairScheduler",
+    "AdmissionError",
+    "Waiter",
     "tuples",
     "utest",
 ]
@@ -79,7 +93,7 @@ __all__ = [
 
 def utest():
     """Run every module's self-test (reference mapreduce/test.lua:30-39)."""
-    from lua_mapreduce_tpu import analysis, faults, trace
+    from lua_mapreduce_tpu import analysis, faults, sched, trace
     from lua_mapreduce_tpu.core import heap, merge, segment, serialize
     from lua_mapreduce_tpu.coord import jobstore, persistent_table
     from lua_mapreduce_tpu.engine import (contract, placement, premerge,
@@ -93,6 +107,6 @@ def utest():
     # the cpu-pinned pytest conftest instead (tests/test_q8.py etc.)
     for mod in (tuples, heap, serialize, segment, merge, jobstore, memfs,
                 contract, router, persistent_table, stats, placement,
-                premerge, worker, server, analysis, faults, trace):
+                premerge, worker, server, analysis, faults, trace, sched):
         if hasattr(mod, "utest"):
             mod.utest()

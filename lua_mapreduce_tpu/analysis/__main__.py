@@ -54,6 +54,13 @@ def _protocol_suite(args):
     runs.append(("speculation", dataclasses.replace(
         base, n_workers=2, n_jobs=2,
         batch_k=min(args.batch_k, 2), allow_spec=True)))
+    # the watch/notify edge (DESIGN §23): sleep / notify-wake /
+    # timeout-fallback / lost-notification interleavings, exhaustively
+    # with worker death — on a 2-job box (the wakeup-bit dimension
+    # multiplies the space like the spec dimension does)
+    runs.append(("notify-wakeup", dataclasses.replace(
+        base, n_jobs=2, batch_k=min(args.batch_k, 2),
+        allow_notify=True)))
     if args.seed_bug:
         bugs = [args.seed_bug]
     else:
@@ -84,6 +91,11 @@ def _protocol_suite(args):
             # trace replayability, like the exhaustive run)
             extra = dict(n_workers=2, n_jobs=2,
                          batch_k=min(args.batch_k, 2), allow_spec=True)
+        elif bug in proto_mod.NOTIFY_BUGS:
+            # notify-edge bugs need the wakeup dimension plus at least
+            # one lost-notification event to be reachable
+            extra = dict(n_jobs=2, batch_k=min(args.batch_k, 2),
+                         allow_notify=True)
         cfg = dataclasses.replace(base, bug=bug, **extra)
         res = proto_mod.check_protocol(cfg)
         entry = {"run": f"seeded:{bug}", "states": res.states,

@@ -102,7 +102,29 @@ Seedable bugs (``ModelConfig(bug=...)``):
   winner's status CAS: a clone (or original) that lost the
   first-commit-wins race lands its commit anyway — the double-commit /
   illegal-WRITTEN-edge shape the one-transition arbitration exists to
-  prevent (requires ``allow_spec=True``).
+  prevent (requires ``allow_spec=True``);
+- ``"lost_wakeup_no_fallback"`` — a sleeping worker wakes ONLY on
+  notification, with no timeout fallback: one lost notification
+  (the budget-bounded ``lose_notify`` environment event) parks the
+  worker forever and claimable jobs strand — the hang the Waiter's
+  degradation ladder exists to prevent (requires
+  ``allow_notify=True``).
+
+**Watch/notify wakeups (DESIGN §23).** With
+``ModelConfig(allow_notify=True)`` each worker may go to SLEEP when its
+poll finds nothing claimable (arming the Waiter), and the state carries
+one pending-wakeup bit per worker: every claimable-work producer —
+release, stale requeue, mark-broken, the detector's speculate, the
+lost-data requeue — broadcasts the bits (the real channels are a bus),
+a sleeping worker consumes its bit via ``notify_wake``, ``timeout_wake``
+is always enabled (the poll fallback), and the budget-bounded
+``lose_notify`` adversary clears a pending bit — the lost-notification
+event. Three properties ride the existing invariant set: sleep/wake
+edges are state-transparent on every job (a stale or duplicate wakeup
+is a no-op by construction), the full lifecycle invariants survive
+every sleep/wake interleaving, and in the correct model no quiescent
+state strands a claimable job on a sleeping worker — delete the
+timeout fallback (the seeded bug) and exactly that hang is re-found.
 """
 
 from __future__ import annotations
@@ -131,13 +153,24 @@ _ALLOWED_EDGES = {
 
 KNOWN_BUGS = ("commit_skips_owner_cas", "requeue_ignores_finished",
               "scavenge_skips_lost_data", "lost_requeue_skips_written_cas",
-              "spec_commit_skips_winner_cas")
+              "spec_commit_skips_winner_cas", "lost_wakeup_no_fallback")
 
 # bugs living on the replica-recovery edge need loss events to surface
 LOSS_BUGS = ("scavenge_skips_lost_data", "lost_requeue_skips_written_cas")
 
 # bugs living on the duplicate-lease edge need speculation enabled
 SPEC_BUGS = ("spec_commit_skips_winner_cas",)
+
+# bugs living on the watch/notify edge need the wakeup layer enabled
+# (and a loss budget — a never-lost notification always wakes)
+NOTIFY_BUGS = ("lost_wakeup_no_fallback",)
+
+# notify/wait edges must be state-transparent on every job: going to
+# sleep, waking (by notification or timeout), and losing a wakeup may
+# never change a status or a retry budget — the stale-wakeup-is-a-no-op
+# rule of DESIGN §23
+_WAIT_PURE_OPS = frozenset({"sleep", "notify_wake", "timeout_wake",
+                            "lose_notify"})
 
 # job spec-lease state: none / OPEN / taken-by-worker-w (w = value - 10)
 _SP_NONE = 0
@@ -154,7 +187,7 @@ _D_UNDER = 1     # readable, but below full r-way redundancy
 _D_INTACT = 2    # full redundancy
 
 # environment events: enumerable, but never count as protocol progress
-_ENV_OPS = frozenset({"die", "lose_replica", "lose_all"})
+_ENV_OPS = frozenset({"die", "lose_replica", "lose_all", "lose_notify"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -168,6 +201,8 @@ class ModelConfig:
     allow_fail: bool = False
     data_loss_budget: int = 0
     allow_spec: bool = False
+    allow_notify: bool = False
+    notify_loss_budget: int = 1
     bug: Optional[str] = None
 
     def __post_init__(self):
@@ -195,6 +230,13 @@ class ModelConfig:
             raise ValueError(f"bug {self.bug!r} lives on the "
                              "duplicate-lease edge: it needs "
                              "allow_spec=True to be reachable")
+        if not (0 <= self.notify_loss_budget <= 3):
+            raise ValueError("notify_loss_budget must be in [0, 3]")
+        if self.bug in NOTIFY_BUGS and (
+                not self.allow_notify or self.notify_loss_budget < 1):
+            raise ValueError(f"bug {self.bug!r} lives on the watch/notify "
+                             "edge: it needs allow_notify=True and "
+                             "notify_loss_budget ≥ 1 to be reachable")
         if self.allow_spec and self.n_workers < 2:
             raise ValueError("allow_spec needs ≥ 2 workers: a shadow "
                              "lease is never taken by the job's own "
@@ -208,8 +250,14 @@ class ModelConfig:
 # state of the job's published output (_D_INTACT until a budgeted loss
 # event, restored by repair or by the re-run's commit); spec is the
 # duplicate-lease state (_SP_NONE | _SP_OPEN | _SP_TAKEN0 + w).  State:
-# (jobs, workers, commits, loss_budget).  Worker modes:
+# (jobs, workers, commits, loss_budget, wakes, notify_budget) — wakes
+# is one pending-wakeup bit per worker (set broadcast-style by every
+# claimable-work producer: release, requeue, mark-broken, speculate,
+# lost-data requeue; consumed by notify_wake; cleared by the
+# budget-bounded lose_notify environment event), notify_budget bounds
+# the lost-notification events.  Worker modes:
 #   ("I",)                                       idle (polling)
+#   ("S",)                                       asleep awaiting wakeup
 #   ("D",)                                       dead
 #   ("R", leased, pos, done)                     executing job bodies
 #   ("C", leased, entries, i, phase, tail, brk)  committing entry i
@@ -256,7 +304,9 @@ class LeaseModel:
                      for _ in range(self.cfg.n_jobs))
         workers = tuple(_IDLE for _ in range(self.cfg.n_workers))
         commits = (0,) * self.cfg.n_jobs
-        return (jobs, workers, commits, self.cfg.data_loss_budget)
+        return (jobs, workers, commits, self.cfg.data_loss_budget,
+                (0,) * self.cfg.n_workers,
+                self.cfg.notify_loss_budget if self.cfg.allow_notify else 0)
 
     # -- per-transition effects (each is ONE atomic store op or one
     # worker-local step, which is exactly the interleaving granularity
@@ -267,17 +317,27 @@ class LeaseModel:
 
     def transitions(self, state: tuple) -> List[Tuple[tuple, tuple]]:
         """[(label, next_state), ...] — every enabled step."""
-        jobs, workers, commits, budget = state
+        jobs, workers, commits, budget, wakes, nbudget = state
         out: List[Tuple[tuple, tuple]] = []
         cfg = self.cfg
 
         def repl_job(j, rec):
             return tuple(rec if i == j else r for i, r in enumerate(jobs))
 
-        def repl_w(w, mode, njobs=None, ncommits=None):
+        def repl_w(w, mode, njobs=None, ncommits=None, nwakes=None):
             nw = tuple(mode if i == w else m for i, m in enumerate(workers))
             return ((jobs if njobs is None else njobs), nw,
-                    (commits if ncommits is None else ncommits), budget)
+                    (commits if ncommits is None else ncommits), budget,
+                    (wakes if nwakes is None else nwakes), nbudget)
+
+        def woken(produced) -> tuple:
+            """Wake bits after a claimable-work producer: the notify
+            bus is a broadcast, so every worker's pending bit sets —
+            exactly what release/requeue/broken/speculate do through
+            the real channels (DESIGN §23)."""
+            if cfg.allow_notify and produced:
+                return (1,) * len(workers)
+            return wakes
 
         for w, mode in enumerate(workers):
             kind = mode[0]
@@ -285,6 +345,21 @@ class LeaseModel:
                 continue
             if cfg.allow_death:
                 out.append((("die", w), repl_w(w, _DEAD)))
+            if kind == "S":
+                # asleep in Waiter.wait. A pending notification wakes
+                # it (consuming this worker's bit — the cursor);
+                # TIMEOUT always wakes it too, pending bit or not —
+                # the degradation-ladder fallback that turns a lost
+                # notification into a plain poll instead of a hang.
+                # The seeded bug deletes exactly that edge.
+                if wakes[w]:
+                    nw = tuple(0 if i == w else b
+                               for i, b in enumerate(wakes))
+                    out.append((("notify_wake", w),
+                                repl_w(w, _IDLE, nwakes=nw)))
+                if cfg.bug != "lost_wakeup_no_fallback":
+                    out.append((("timeout_wake", w), repl_w(w, _IDLE)))
+                continue
             if kind == "I":
                 claimable = [j for j, rec in enumerate(jobs)
                              if rec[0] in (_WAIT, _BRK)]
@@ -314,6 +389,13 @@ class LeaseModel:
                         nj = repl_job(j, (s, r, o, a, d, _SP_TAKEN0 + w))
                         out.append((("claim_spec", w, j),
                                     repl_w(w, ("SR", j), nj)))
+                if cfg.allow_notify and not take:
+                    # polled, found nothing claimable: arm the Waiter.
+                    # The pending bit is NOT cleared — a notification
+                    # that raced the poll-then-arm window is consumed
+                    # by the next wait immediately (the per-waiter
+                    # cursor rule, sched/waiter.py)
+                    out.append((("sleep", w), repl_w(w, ("S",))))
             elif kind == "R":
                 _, leased, pos, done = mode
                 j = leased[pos]
@@ -362,7 +444,7 @@ class LeaseModel:
                         released.append(t)
                 out.append((("release", w, tail, tuple(released)),
                             repl_w(w, self._norm(("K", leased, brk)),
-                                   tuple(nj))))
+                                   tuple(nj), nwakes=woken(released))))
             elif kind == "K":
                 _, leased, brk = mode
                 s, r, o, a, d, sp = jobs[brk]
@@ -374,7 +456,7 @@ class LeaseModel:
                 nj = repl_job(brk, (_BRK, self._sat(r + 1), o, 0, d,
                                     _SP_NONE)) if ok else jobs
                 out.append((("mark_broken", w, brk, ok),
-                            repl_w(w, _IDLE, nj)))
+                            repl_w(w, _IDLE, nj, nwakes=woken(ok))))
             elif kind == "SR":
                 j = mode[1]
                 out.append((("spec_exec", w, j),
@@ -445,7 +527,8 @@ class LeaseModel:
                         s, r, o, _, d, sp = nj[t]
                         nj[t] = (s, r, o, 0, d, sp)
                     out.append((("beat", w, beaten),
-                                (tuple(nj), workers, commits, budget)))
+                                (tuple(nj), workers, commits, budget,
+                                 wakes, nbudget)))
             elif kind == "SR":
                 # the clone's beat thread: ownership through the shadow
                 # lease — this is what keeps a job whose ORIGINAL died
@@ -457,7 +540,8 @@ class LeaseModel:
                         and jobs[j][3] > 0):
                     nj = repl_job(j, jobs[j][:3] + (0,) + jobs[j][4:])
                     out.append((("beat", w, (j,)),
-                                (nj, workers, commits, budget)))
+                                (nj, workers, commits, budget,
+                                 wakes, nbudget)))
 
         # -- global (server/scavenger/clock) steps -----------------------
         if cfg.allow_spec:
@@ -469,9 +553,12 @@ class LeaseModel:
             # status, reps, owner, age all untouched.
             for j, rec in enumerate(jobs):
                 if rec[0] == _RUN and rec[5] == _SP_NONE:
+                    # opening a shadow lease wakes the idle fleet (the
+                    # detector's notify in Server._speculate_stragglers)
                     out.append((("speculate", j),
                                 (repl_job(j, rec[:5] + (_SP_OPEN,)),
-                                 workers, commits, budget)))
+                                 workers, commits, budget,
+                                 woken(True), nbudget)))
         aged = [j for j, rec in enumerate(jobs)
                 if rec[0] in (_RUN, _FIN) and rec[3] < self.cfg.stale_age]
         if aged:
@@ -479,7 +566,8 @@ class LeaseModel:
             for j in aged:
                 s, r, o, a, d, sp = nj[j]
                 nj[j] = (s, r, o, a + 1, d, sp)
-            out.append((("tick",), (tuple(nj), workers, commits, budget)))
+            out.append((("tick",), (tuple(nj), workers, commits, budget,
+                                    wakes, nbudget)))
 
         requeue_from = (_RUN,) if self.cfg.bug == "requeue_ignores_finished" \
             else (_RUN, _FIN)
@@ -493,7 +581,8 @@ class LeaseModel:
                 # requeue dissolves any shadow lease (unlease rule)
                 nj[j] = (_BRK, self._sat(r + 1), o, 0, d, _SP_NONE)
             out.append((("requeue", stale),
-                        (tuple(nj), workers, commits, budget)))
+                        (tuple(nj), workers, commits, budget,
+                         woken(True), nbudget)))
 
         failed = tuple(j for j, rec in enumerate(jobs)
                        if rec[0] == _BRK and rec[1] >= self.cfg.max_retries)
@@ -503,7 +592,8 @@ class LeaseModel:
                 s, r, o, a, d, sp = nj[j]
                 nj[j] = (_FAI, r, o, a, d, sp)
             out.append((("scavenge", failed),
-                        (tuple(nj), workers, commits, budget)))
+                        (tuple(nj), workers, commits, budget,
+                         wakes, nbudget)))
 
         # -- replica-aware data plane (DESIGN §20) -----------------------
         # environment loss events, budget-bounded: a published output
@@ -517,12 +607,12 @@ class LeaseModel:
                     out.append((
                         ("lose_replica", j),
                         (repl_job(j, (s, r, o, a, _D_UNDER, sp)), workers,
-                         commits, budget - 1)))
+                         commits, budget - 1, wakes, nbudget)))
                 if d != _D_LOST:
                     out.append((
                         ("lose_all", j),
                         (repl_job(j, (s, r, o, a, _D_LOST, sp)), workers,
-                         commits, budget - 1)))
+                         commits, budget - 1, wakes, nbudget)))
         # scavenger pass, reconstruct rung: every under-replicated
         # output is healed from a survivor — job state UNTOUCHED (the
         # whole point of the trade)
@@ -534,7 +624,8 @@ class LeaseModel:
                 s, r, o, a, _, sp = nj[j]
                 nj[j] = (s, r, o, a, _D_INTACT, sp)
             out.append((("repair", under),
-                        (tuple(nj), workers, commits, budget)))
+                        (tuple(nj), workers, commits, budget,
+                         wakes, nbudget)))
         # scavenger pass, requeue rung (last resort): producers of
         # wholly-lost output go back to WAITING via a status CAS on
         # WRITTEN, with NO repetition charge, opening a fresh commit
@@ -558,7 +649,21 @@ class LeaseModel:
                     nj[j] = (_WAIT, r, 0, 0, d, _SP_NONE)
                     nc[j] = 0
                 out.append((("rerun_requeue", lost),
-                            (tuple(nj), workers, tuple(nc), budget)))
+                            (tuple(nj), workers, tuple(nc), budget,
+                             woken(True), nbudget)))
+
+        # -- watch/notify adversary (DESIGN §23) -------------------------
+        # a pending wakeup evaporates (dropped wake write, crashed
+        # producer, cleared generation): budget-bounded so the space
+        # stays finite. The timeout fallback is what must absorb it.
+        if nbudget > 0:
+            for w, bit in enumerate(wakes):
+                if bit:
+                    nw = tuple(0 if i == w else b
+                               for i, b in enumerate(wakes))
+                    out.append((("lose_notify", w),
+                                (jobs, workers, commits, budget,
+                                 nw, nbudget - 1)))
         return out
 
     @staticmethod
@@ -583,13 +688,20 @@ class LeaseModel:
 
     def step_violation(self, old: tuple, new: tuple,
                        label: tuple) -> Optional[str]:
-        ojobs, _, ocommits, _ = old
-        njobs, _, ncommits, _ = new
+        ojobs, ocommits = old[0], old[2]
+        njobs, ncommits = new[0], new[2]
         for j, ((os_, or_, oo, _, _, osp), (ns_, nr, no, _, _, nsp)) in \
                 enumerate(zip(ojobs, njobs)):
             if nr < or_:
                 return (f"repetitions of job {j} decreased {or_}→{nr} "
                         f"on {label}")
+            if label[0] in _WAIT_PURE_OPS and (os_, or_, oo, osp) != \
+                    (ns_, nr, no, nsp):
+                # sleep/wake/lost-notify must be invisible to every job:
+                # a wakeup carries no payload, so a stale or duplicate
+                # one is a no-op by construction (DESIGN §23)
+                return (f"notify edge {label} touched job {j} state — "
+                        "sleep/wake transitions must be pure")
             if label[0] in _SPEC_PURE_OPS and (ns_ != os_ or nr != or_):
                 # the zero-charge rule of the speculation edges: marking,
                 # taking, or dissolving a shadow lease must be invisible
@@ -625,17 +737,24 @@ class LeaseModel:
         return None
 
     def quiescent_violation(self, state: tuple) -> Optional[str]:
-        jobs, workers, _, _ = state
+        jobs, workers = state[0], state[1]
         if all(m[0] == "D" for m in workers):
             return None              # a fully dead pool may strand work
         bad = {j: Status(s).name
                for j, (s, _, _, _, _, _) in enumerate(jobs)
                if s not in (_WRI, _FAI)}
         if bad:
-            return (f"lost/stuck jobs at quiescence with a live worker: "
-                    f"{bad} (every job must end WRITTEN or FAILED; a "
-                    "FINISHED entry here is the stuck-FINISHED+unclaimed "
-                    "gap)")
+            msg = (f"lost/stuck jobs at quiescence with a live worker: "
+                   f"{bad} (every job must end WRITTEN or FAILED; a "
+                   "FINISHED entry here is the stuck-FINISHED+unclaimed "
+                   "gap)")
+            asleep = [w for w, m in enumerate(workers) if m[0] == "S"]
+            if asleep:
+                msg += (f"; workers {asleep} are asleep awaiting a "
+                        "wakeup that will never arrive — the lost-wakeup"
+                        " hang the Waiter's timeout fallback exists to "
+                        "prevent (DESIGN §23)")
+            return msg
         stranded = [j for j, (s, _, _, _, d, _) in enumerate(jobs)
                     if s == _WRI and d == _D_LOST]
         if stranded:
@@ -763,9 +882,13 @@ def replay_trace(store, trace: Sequence[tuple], config: ModelConfig,
     for i, label in enumerate(trace):
         op = label[0]
         if op in ("exec", "exec_fail", "spec_exec", "die", "tick",
-                  "lose_replica", "lose_all", "repair"):
-            # loss events and replica repair live on the data plane
-            # (store files, faults/replicate.py) — no jobstore op
+                  "lose_replica", "lose_all", "repair",
+                  "sleep", "notify_wake", "timeout_wake", "lose_notify"):
+            # loss events / replica repair live on the data plane, and
+            # sleep/wake edges live in the Waiter layer (sched/waiter.py)
+            # — neither has a jobstore op to replay; the store-visible
+            # consequences (what was claimed, requeued, committed)
+            # replay through the surrounding protocol ops
             continue
         if op == "speculate":
             (_, j) = label
@@ -878,7 +1001,7 @@ def replay_trace(store, trace: Sequence[tuple], config: ModelConfig,
 
     result = {"ok": True, "steps": len(trace)}
     if final_state is not None:
-        jobs, _, _, _ = final_state
+        jobs = final_state[0]
         cap = config.max_retries + 1
         for j, (s, r, _, _, _, _) in enumerate(jobs):
             doc = store.get_job(ns, j)
@@ -950,3 +1073,20 @@ def utest() -> None:
     assert not rep3["ok"]
     assert rep3["label"][0].startswith(("commit", "claim_spec",
                                         "spec_cancel"))
+
+    # watch/notify edges (DESIGN §23): sleep/wake/lost-notification
+    # interleavings keep the whole invariant set, and deleting the
+    # timeout fallback re-finds the lost-wakeup hang, replayable: the
+    # store ops of the hang trace reproduce and land jobs exactly where
+    # the model stranded them
+    waked = dataclasses.replace(small, n_workers=2, allow_notify=True)
+    res4 = check_protocol(waked)
+    assert res4.ok and res4.states > res.states
+
+    hang = check_protocol(dataclasses.replace(
+        waked, bug="lost_wakeup_no_fallback"))
+    assert not hang.ok, "seeded lost-wakeup hang not found"
+    assert "asleep" in hang.violation.message
+    rep4 = replay_trace(MemJobStore(), hang.violation.trace, hang.config,
+                        final_state=hang.violation.state)
+    assert rep4["ok"], rep4    # the wedge reproduces on the real store

@@ -701,3 +701,37 @@ class InjectableClockRule(Rule):
                     f"{'.'.join(c)}() in trace/ — route the read "
                     "through the Tracer's injectable clock "
                     "(self._clock() / tracer.clock())")
+
+
+# --- LMR011: engine/coord waits go through the injectable Waiter ------------
+
+class IdleWaitRule(Rule):
+    id = "LMR011"
+    severity = "error"
+    title = "no bare time.sleep in coord/engine wait paths"
+    rationale = (
+        "Every wait in the coordination and engine planes — idle-poll "
+        "backoff, barrier polls, retry delays, lock contention — must "
+        "go through the sched Waiter (sched/waiter.py): a bare "
+        "time.sleep() is a wait that NOTHING can interrupt, so one "
+        "call silently re-opens the fixed-interval dispatch-latency "
+        "floor the watch/notify layer removed (DESIGN §23), and it "
+        "dodges the injectable-clock discipline virtual-time tests "
+        "rely on. Call waiter.wait(timeout) (the NullWaiter degrades "
+        "to exactly a sleep when notify is off); binding time.sleep as "
+        "a DEFAULT (sleep=time.sleep) is the injection point itself — "
+        "a reference, not a call — and stays legal.")
+    paths = ("coord/", "engine/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            c = _chain(n.func)
+            if c and len(c) == 2 and c[0] == "time" and c[1] == "sleep":
+                yield self.finding(
+                    ctx, n,
+                    "time.sleep() in a coord/engine wait path — wait "
+                    "through the injectable Waiter "
+                    "(sched.waiter.channel_for(store).waiter().wait / "
+                    "NullWaiter) so notifications can interrupt it")

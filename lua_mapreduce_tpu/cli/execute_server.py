@@ -57,6 +57,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--init-arg", action="append", metavar="K=V")
     p.add_argument("--inline-workers", type=int, default=0,
                    help="run N worker threads in this process")
+    p.add_argument("--idle-poll-ms", type=float, default=None,
+                   help="idle-poll CAP in ms for the inline workers "
+                        "(lmr-sched, DESIGN §23): bounds the "
+                        "lost-notification fallback latency; wakeup "
+                        "channels interrupt waits long before it "
+                        "(default: LMR_IDLE_POLL_MS, else the worker "
+                        "max_sleep; LMR_SCHED_NOTIFY=0 disables "
+                        "wakeups fleet-wide)")
     p.add_argument("--poll", type=float, default=0.1)
     p.add_argument("--stale-timeout", type=float, default=600.0,
                    help="requeue RUNNING jobs of silently-dead workers "
@@ -182,6 +190,8 @@ def main(argv=None) -> int:
 
     for _ in range(args.inline_workers):
         w = Worker(store).configure(max_iter=10_000)
+        if args.idle_poll_ms is not None:
+            w.configure(idle_poll_ms=args.idle_poll_ms)
         threading.Thread(target=w.execute, daemon=True).start()
 
     def report(phase: str, frac: float) -> None:

@@ -36,6 +36,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shuffle replication factor THIS worker publishes "
                         "and reads with (default: follow the task "
                         "document's fleet default — DESIGN §20)")
+    p.add_argument("--idle-poll-ms", type=float, default=None,
+                   help="idle-poll CAP in ms (lmr-sched, DESIGN §23): "
+                        "the longest an idle worker waits between "
+                        "claim-surface scans. Waits are capped jittered "
+                        "backoff that the store's wakeup channel "
+                        "interrupts, so this bounds only the "
+                        "lost-notification fallback latency (default: "
+                        "LMR_IDLE_POLL_MS, else --max-sleep; "
+                        "LMR_SCHED_NOTIFY=0 disables wakeups entirely)")
     p.add_argument("--phases", default="map,reduce",
                    help="comma list of phases this worker claims "
                         "(heterogeneous pools: dedicated mapper hosts "
@@ -88,6 +97,8 @@ def main(argv=None) -> int:
         max_tasks=args.max_tasks, phases=phases, max_jobs=args.max_jobs)
     if args.batch_k is not None:
         worker.configure(batch_k=args.batch_k)
+    if args.idle_poll_ms is not None:
+        worker.configure(idle_poll_ms=args.idle_poll_ms)
     if args.segment_format is not None:
         worker.configure(segment_format=args.segment_format)
     if args.replication is not None:

@@ -101,8 +101,16 @@ class PersistentTable:
 
     # -- advisory lock (persistent_table.lua:113-161) ----------------------
 
-    def lock(self, poll: float = 0.1, timeout: Optional[float] = None) -> None:
+    def lock(self, poll: float = 0.1, timeout: Optional[float] = None,
+             waiter=None) -> None:
         self._assert_writable()
+        # contention waits ride the injectable Waiter (lmr-sched,
+        # DESIGN §23 / lint LMR011): the default NullWaiter sleeps
+        # exactly like the old poll; callers on a notify-capable store
+        # may pass its channel's waiter for prompt handoff
+        if waiter is None:
+            from lua_mapreduce_tpu.sched.waiter import NullWaiter
+            waiter = NullWaiter()
         deadline = None if timeout is None else time.time() + timeout
         while True:
             doc = self._store.pt_get(self._name)
@@ -118,7 +126,7 @@ class PersistentTable:
                     return
             if deadline is not None and time.time() > deadline:
                 raise TimeoutError(f"lock({self._name!r}) timed out")
-            time.sleep(poll)
+            waiter.wait(poll)
 
     def unlock(self) -> None:
         self._assert_writable()

@@ -174,6 +174,26 @@ class Server:
         self._spill_repairs: Dict[str, tuple] = {}  # spill -> (part, a, b)
         self._spec_taken_at: Dict[tuple, float] = {}  # (ns, jid) -> seen
         self._spec_scan_at: Dict[str, float] = {}     # ns -> last scan
+        self._waiter_obj = None        # barrier wakeup cursor (DESIGN §23)
+        self._housekeep_at: Optional[float] = None    # throttle stamp
+
+    # -- wakeups (lmr-sched watch/notify, DESIGN §23) -----------------------
+
+    def _waiter(self):
+        """The barrier poll's cursor on the store's "done" channel:
+        workers bump it when commits land, so the poll wakes within
+        milliseconds of phase progress instead of a full interval
+        later. A lost notification times out into today's poll."""
+        if self._waiter_obj is None:
+            from lua_mapreduce_tpu.sched.waiter import channel_for
+            self._waiter_obj = channel_for(self.store, "done").waiter()
+        return self._waiter_obj
+
+    def _notify_jobs(self) -> None:
+        """Announce claimable work / a phase flip on the "jobs"
+        channel — the idle fleet's wakeup. Best-effort by contract."""
+        from lua_mapreduce_tpu.sched.waiter import notify
+        notify(self.store, "jobs")
 
     # -- configuration ------------------------------------------------------
 
@@ -273,6 +293,7 @@ class Server:
                     "segment_format": self.segment_format,
                     "replication": self.replication,
                     "speculation": self.speculation})
+                self._notify_jobs()
                 if status == TaskStatus.REDUCE.value:
                     skip_map = True
         if self.spec is None:
@@ -300,6 +321,7 @@ class Server:
                 "speculation": self.speculation,
                 "started": time.time(),
             })
+            self._notify_jobs()      # task appeared: wake waiting workers
 
         from lua_mapreduce_tpu.faults.replicate import reading_view
         # the plain store repairs copies individually (scavenge path);
@@ -398,10 +420,12 @@ class Server:
                 self.store.drop_ns(RED_NS)
                 self.store.update_task({"iteration": iteration,
                                         "status": TaskStatus.WAIT.value})
+                self._notify_jobs()
                 continue
 
             self.finished_value = verdict
             self.store.update_task({"status": TaskStatus.FINISHED.value})
+            self._notify_jobs()      # waiting workers see FINISHED now
             if verdict is True:
                 delete_results(result_store, self.spec.result_ns)
                 self._drop_everything()
@@ -429,6 +453,9 @@ class Server:
             self.store.insert_jobs(
                 MAP_NS, [make_job(k, v) for k, v in jobs])
         self.store.update_task({"status": TaskStatus.MAP.value})
+        # jobs AND the phase flip land before the wakeup, so a woken
+        # worker's very next poll finds claimable work (DESIGN §23)
+        self._notify_jobs()
         return len(jobs)
 
     def _clean_runs(self, store) -> None:
@@ -481,6 +508,7 @@ class Server:
         if docs:
             self.store.insert_jobs(RED_NS, docs)
         self.store.update_task({"status": TaskStatus.REDUCE.value})
+        self._notify_jobs()
         return len(docs)
 
     def _housekeep(self, *namespaces: str) -> None:
@@ -490,11 +518,24 @@ class Server:
         worker errors. Both the barrier wait and the pipelined wait call
         this so the recovery semantics cannot drift apart. With
         replication on, drained errors naming lost shuffle files feed
-        the reconstruct-vs-requeue scavenge path (DESIGN §20)."""
+        the reconstruct-vs-requeue scavenge path (DESIGN §20).
+
+        Throttled to the poll_interval cadence: the barrier waits now
+        wake on every worker commit (DESIGN §23), and housekeeping is
+        full-index-scan work per namespace — waking the DONE-count
+        check per commit is the point, re-scavenging per commit is
+        pure amplification (N tenant servers sharing one "done"
+        channel would make it O(N²))."""
+        now = time.time()
+        if self._housekeep_at is not None \
+                and now - self._housekeep_at < self.poll_interval:
+            return
+        self._housekeep_at = now
         for ns in namespaces:
             self.store.scavenge(ns, MAX_JOB_RETRIES)
             if self.stale_timeout_s is not None:
-                self.store.requeue_stale(ns, self.stale_timeout_s)
+                if self.store.requeue_stale(ns, self.stale_timeout_s):
+                    self._notify_jobs()   # requeued = claimable again
             if self.speculation:
                 self._speculate_stragglers(ns)
         lost: List[str] = []
@@ -610,6 +651,7 @@ class Server:
             key=lambda d: d["started_time"])
         for d in overdue[:budget]:
             if self.store.speculate(ns, d["_id"]):
+                self._notify_jobs()   # idle workers probe for the clone
                 COUNTERS.bump("spec_launched")
                 self._log(
                     f"straggler: {ns} job {d['_id']} RUNNING "
@@ -691,6 +733,8 @@ class Server:
                       "ns": MAP_NS, "job_id": jid, "file": why_file})
             self._log(f"scavenge: {why_file} unrecoverable — map job "
                       f"{jid} requeued for re-run")
+        if n:
+            self._notify_jobs()
         return n
 
     def _settle_spill_repairs(self) -> None:
@@ -726,6 +770,7 @@ class Server:
                 f"repair.{part}.{a}-{b}",
                 {"part": part, "seq": -1, "files": files,
                  "spill": spill})])
+            self._notify_jobs()
             self._spill_repairs.pop(spill)
             self._log(f"scavenge: republished pre_merge for lost spill "
                       f"{spill} ({len(files)} run(s))")
@@ -830,6 +875,7 @@ class Server:
                         for sp in spills])
                     for jid, sp in zip(ids, spills):
                         pre_ids[jid] = (sp.part, sp.seq)
+                    self._notify_jobs()
                     self._log(f"published {len(spills)} pre_merge job(s) "
                               f"({len(seen_committed)}/{n_map} maps done)")
 
@@ -859,7 +905,10 @@ class Server:
             if map_done and len(settled_pre) >= len(pre_ids):
                 self._finish_phase("map", self.store.counts(MAP_NS), n_map)
                 return
-            time.sleep(self.poll_interval)
+            # commit-interrupted wait (DESIGN §23): a worker's lease
+            # retirement wakes this poll in milliseconds; a lost
+            # notification times out into exactly the legacy interval
+            self._waiter().wait(self.poll_interval)
 
     def _wait_phase(self, ns: str, total: int, phase: str,
                     progress: Optional[Callable[[str, float], None]]) -> None:
@@ -883,7 +932,7 @@ class Server:
             if done >= total:
                 self._finish_phase(phase, counts, total)
                 return
-            time.sleep(self.poll_interval)
+            self._waiter().wait(self.poll_interval)
 
     # -- stats / cleanup ----------------------------------------------------
 

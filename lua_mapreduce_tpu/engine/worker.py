@@ -15,6 +15,7 @@ import contextlib
 import json
 import logging
 import os
+import random
 import threading
 import time
 import traceback
@@ -50,7 +51,24 @@ MAX_INFRA_POLL_FAILURES = 10
 
 _CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases",
                 "heartbeat_s", "batch_k", "batch_lease_s", "segment_format",
-                "replication")
+                "replication", "idle_poll_ms")
+
+
+def resolve_idle_poll_s(idle_poll_ms, max_sleep: float) -> float:
+    """The idle-poll CAP in seconds — the longest an idle worker waits
+    between claim-surface scans (the lost-notification fallback period,
+    DESIGN §23). Resolution order: explicit knob, else
+    ``LMR_IDLE_POLL_MS`` (the subprocess-fleet channel), else the
+    legacy ``max_sleep``. Never exceeds ``max_sleep`` (the worker's own
+    lifetime budget is denominated in polls of at most that length)."""
+    if idle_poll_ms is None:
+        env = os.environ.get("LMR_IDLE_POLL_MS")
+        idle_poll_ms = float(env) if env else None
+    if idle_poll_ms is None:
+        return max_sleep
+    if idle_poll_ms <= 0:
+        raise ValueError(f"idle_poll_ms must be > 0, got {idle_poll_ms}")
+    return min(max_sleep, idle_poll_ms / 1000.0)
 
 # EWMA smoothing for the observed per-job duration that drives adaptive
 # batch sizing (recent jobs dominate: a phase whose jobs suddenly get big
@@ -125,6 +143,7 @@ class Worker:
         self._speculation = 0.0          # task-doc factor (0 = off)
         self._spec_cache: Dict[str, TaskSpec] = {}
         self._infra_released: Dict[tuple, int] = {}  # (ns, jid) -> count
+        self._spec_by_id = None         # (desc object, spec) fast path
         self._release_gen = None        # (task spec, iteration) the
                                         # release budget belongs to
         self._affinity: list = []       # map-job ids this worker ran before
@@ -132,6 +151,16 @@ class Worker:
         self.jobs_executed = 0
         self._jobs_at_start = 0         # execute()'s bounded-lifetime base
         self._last_spec = None          # trace-flush target (DESIGN §22)
+        # idle-wait plumbing (lmr-sched, DESIGN §23): every wait between
+        # polls goes through the store's wakeup Waiter — capped jittered
+        # backoff that a job insert / phase flip interrupts in
+        # milliseconds, degrading to exactly the legacy poll when a
+        # notification is lost or notify is off. None = follow
+        # LMR_IDLE_POLL_MS, else max_sleep (the legacy cap).
+        self.idle_poll_ms = None
+        self._waiter_obj = None
+        self._null_waiter = None
+        self._jitter = random.Random(self.name)
 
     def configure(self, **params) -> "Worker":
         """Set max_iter / max_sleep / max_tasks; unknown keys are rejected
@@ -148,8 +177,54 @@ class Worker:
                 from lua_mapreduce_tpu.engine.placement import \
                     check_replication
                 check_replication(v)
+            if k == "idle_poll_ms" and v is not None and float(v) <= 0:
+                raise ValueError(f"idle_poll_ms must be > 0, got {v}")
             setattr(self, k, v)
         return self
+
+    # -- idle waits (lmr-sched watch/notify, DESIGN §23) --------------------
+
+    def _waiter(self):
+        """This worker's cursor on the store's "jobs" wakeup channel,
+        minted lazily (the store type routes the backend: in-process
+        event bus / dirmtime cursor / generation-stamped reads;
+        NullWaiter when notify is off or the store is unknown)."""
+        if self._waiter_obj is None:
+            from lua_mapreduce_tpu.sched.waiter import channel_for
+            self._waiter_obj = channel_for(self.store, "jobs").waiter()
+        return self._waiter_obj
+
+    def _idle_wait(self, sleep: float):
+        """One idle-backoff step between polls (sched.jittered_wait —
+        the ONE schedule Worker and FairWorker share). Returns
+        ``(woken, next_sleep)``: a notification means re-poll NOW
+        (dispatch latency is the point); a timeout is the
+        lost-notification fallback — exactly today's poll."""
+        from lua_mapreduce_tpu.sched.waiter import jittered_wait
+        return jittered_wait(self._waiter(), sleep, self._idle_cap(),
+                             self._jitter, floor_s=DEFAULT_SLEEP)
+
+    def _backoff_wait(self, delay: float) -> None:
+        """Failure-backoff sleep: deliberately UNINTERRUPTIBLE. The
+        infra-brownout and user-code-retry delays exist to guarantee
+        recovery TIME; letting a busy notify bus cut them short would
+        burn MAX_INFRA_POLL_FAILURES / MAX_WORKER_RETRIES in
+        milliseconds during exactly the churn the budgets must
+        outlive."""
+        if self._null_waiter is None:
+            from lua_mapreduce_tpu.sched.waiter import NullWaiter
+            self._null_waiter = NullWaiter()
+        self._null_waiter.wait(delay)
+
+    def _idle_cap(self) -> float:
+        return resolve_idle_poll_s(self.idle_poll_ms, self.max_sleep)
+
+    def _notify(self, topic: str) -> None:
+        """Best-effort producer bump: "jobs" when this worker returned
+        claimable work to the pool (release, broken), "done" when its
+        commits landed (the server's barrier wakeup)."""
+        from lua_mapreduce_tpu.sched.waiter import notify
+        notify(self.store, topic)
 
     # -- one poll ----------------------------------------------------------
 
@@ -553,7 +628,13 @@ class Worker:
                 except Exception as exc:
                     committed = self.store.commit_batch(ns, self.name, done)
                     self._settle_committed(ns, committed)
-                    self.store.release_batch(ns, self.name, jids[pos + 1:])
+                    if committed:
+                        self._notify("done")
+                    if self.store.release_batch(ns, self.name,
+                                                jids[pos + 1:]):
+                        # released tail is claimable again: wake the
+                        # idle fleet (DESIGN §23)
+                        self._notify("jobs")
                     if (is_transient_job_fault(exc)
                             and self._release_budget_ok(ns, job["_id"])):
                         # transient INFRA fault (a store burst that
@@ -580,6 +661,7 @@ class Worker:
         committed = self.store.commit_batch(ns, self.name, done)
         self._settle_committed(ns, committed)
         if committed:
+            self._notify("done")     # the server's barrier wakeup
             # only WINNING observations calibrate the fleet aggregate:
             # a straggler whose commits keep losing their races must
             # not inflate the very EWMA the detector compares it
@@ -653,6 +735,7 @@ class Worker:
         committed = self.store.commit_batch(ns, self.name,
                                             [(jid, _times_dict(times))])
         if committed:
+            self._notify("done")
             from lua_mapreduce_tpu.faults.retry import COUNTERS
             COUNTERS.bump("spec_wins")
             self._note_duration(ns, body_times.real)
@@ -771,9 +854,10 @@ class Worker:
         discipline as _mark_broken: a requeued/re-claimed job is left
         alone."""
         from lua_mapreduce_tpu.faults.retry import COUNTERS
-        self.store.set_job_status(ns, jid, Status.WAITING,
-                                  expect=(Status.RUNNING,),
-                                  expect_worker=self.name)
+        if self.store.set_job_status(ns, jid, Status.WAITING,
+                                     expect=(Status.RUNNING,),
+                                     expect_worker=self.name):
+            self._notify("jobs")     # claimable again: wake the fleet
         COUNTERS.bump("infra_releases")
         self.store.insert_error(self.name, self._abbrev_tb(),
                                 info=self._error_info(ns, jid, exc,
@@ -805,9 +889,10 @@ class Worker:
         scavenged in the meantime would resurrect a FAILED job back to
         claimable BROKEN (found by analysis/protocol.py: FAILED must be
         terminal)."""
-        self.store.set_job_status(ns, jid, Status.BROKEN,
-                                  expect=(Status.RUNNING,),
-                                  expect_worker=self.name)
+        if self.store.set_job_status(ns, jid, Status.BROKEN,
+                                     expect=(Status.RUNNING,),
+                                     expect_worker=self.name):
+            self._notify("jobs")     # BROKEN is claimable: wake the fleet
         info = (self._error_info(ns, jid, exc, span=span)
                 if exc is not None else None)
         self.store.insert_error(self.name, self._abbrev_tb(), info=info)
@@ -883,13 +968,13 @@ class Worker:
                                  "fault (%dx: %s: %s); retrying in %.2fs",
                                  self.name, infra_fails,
                                  type(exc).__name__, exc, delay)
-                    time.sleep(delay)
+                    self._backoff_wait(delay)
                     continue
                 retries += 1
                 if retries >= MAX_WORKER_RETRIES:
                     self._log(f"giving up after {retries} failures")
                     raise
-                time.sleep(DEFAULT_SLEEP)
+                self._backoff_wait(DEFAULT_SLEEP)
                 continue
             retries = 0
             infra_fails = 0
@@ -904,22 +989,40 @@ class Worker:
                 # a phase-restricted worker waiting out the other phase
                 # (a dedicated reducer during a long map) must NOT burn
                 # its idle budget and die before its phase ever opens
-                time.sleep(sleep)
-                sleep = min(sleep * 1.5, self.max_sleep)
+                _woken, sleep = self._idle_wait(sleep)
             else:
-                idle_iters += 1
-                time.sleep(sleep)
-                sleep = min(sleep * 1.5, self.max_sleep)  # worker.lua:100-102
+                # capped jittered backoff the Waiter interrupts: a
+                # wakeup resets the interval so the next fallback poll
+                # is prompt again (worker.lua:100-102's growth, now
+                # bounded by the idle-poll cap instead of max_sleep
+                # alone). Only TIMED-OUT waits drain the idle budget:
+                # the budget is denominated in quiet full-length polls,
+                # and a busy shared notify bus (another tenant's
+                # traffic on the same store) must not be able to idle
+                # this worker out in wall-clock milliseconds.
+                woken, sleep = self._idle_wait(sleep)
+                if not woken:
+                    idle_iters += 1
         return self.jobs_executed
 
     # -- helpers ------------------------------------------------------------
 
     def _get_spec(self, desc: dict) -> TaskSpec:
+        # identity fast path: in-process stores hand back the SAME
+        # nested spec dict every poll, so the serialize-to-key step —
+        # which dominates an idle poll at many-tenant scale (one
+        # json.dumps per tenant per poll) — only runs when the object
+        # actually changed. The keyed cache below stays the truth for
+        # file-backed stores, which parse a fresh dict per read.
+        cached = self._spec_by_id
+        if cached is not None and cached[0] is desc:
+            return cached[1]
         key = json.dumps(desc, sort_keys=True, default=str)
         spec = self._spec_cache.get(key)
         if spec is None:
             spec = TaskSpec.from_description(desc)
             self._spec_cache[key] = spec
+        self._spec_by_id = (desc, spec)
         return spec
 
     def _log(self, msg: str) -> None:

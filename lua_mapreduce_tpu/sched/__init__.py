@@ -1,0 +1,36 @@
+"""lmr-sched: event-driven, multi-tenant control plane (DESIGN §23).
+
+Two coupled layers over the existing claim protocol:
+
+- **watch/notify** (:mod:`sched.waiter`) — per-backend wakeup channels
+  (in-process event bus, dirmtime cursors, generation-stamped
+  conditional reads) behind one :class:`Waiter` abstraction, so job
+  inserts and phase flips wake idle pool members in milliseconds while
+  the jittered long-interval poll stays as the lost-notification
+  fallback;
+- **multi-tenancy** (:mod:`sched.tenancy`) — many concurrent tasks per
+  store under per-tenant namespaces, with weighted-fair-share claim
+  ordering (stride scheduling) and admission quotas, so one tenant's
+  many-tiny-jobs flood cannot starve another's barrier.
+"""
+
+from lua_mapreduce_tpu.sched.tenancy import (AdmissionError, FairScheduler,
+                                             FairWorker, Tenant, TenantView,
+                                             dispatch_latencies, tenant_ns)
+from lua_mapreduce_tpu.sched.waiter import (Channel, DirChannel, LocalChannel,
+                                            NullChannel, NullWaiter,
+                                            StoreChannel, Waiter, channel_for,
+                                            notify, notify_enabled)
+
+__all__ = [
+    "AdmissionError", "FairScheduler", "FairWorker", "Tenant", "TenantView",
+    "dispatch_latencies", "tenant_ns",
+    "Channel", "DirChannel", "LocalChannel", "NullChannel", "NullWaiter",
+    "StoreChannel", "Waiter", "channel_for", "notify", "notify_enabled",
+]
+
+
+def utest() -> None:
+    from lua_mapreduce_tpu.sched import tenancy, waiter
+    waiter.utest()
+    tenancy.utest()

@@ -86,11 +86,13 @@ class TraceCollection:
 
     def op_stats(self) -> Dict[str, dict]:
         """{op name: {count, p50_ms, p95_ms, p99_ms, max_ms, total_s}}
-        for every ``store.*`` / ``coord.*`` op span."""
+        for every ``store.*`` / ``coord.*`` op span, plus ``dispatch``
+        (insert→claim per job, lmr-sched DESIGN §23) so job-dispatch
+        latency reports in the same histogram table as the RPCs."""
         buckets: Dict[str, List[float]] = {}
         for s in self.spans:
             name = s["name"]
-            if name.startswith(("store.", "coord.")):
+            if name.startswith(("store.", "coord.")) or name == "dispatch":
                 buckets.setdefault(name, []).append(s["t1"] - s["t0"])
         out = {}
         for name, durs in sorted(buckets.items()):
@@ -102,6 +104,12 @@ class TraceCollection:
                          "max_ms": round(max(ms), 3),
                          "total_s": round(sum(durs), 4)}
         return out
+
+    def dispatch_stats(self) -> Optional[dict]:
+        """The ``dispatch`` histogram row (insert→claim per job) — the
+        control plane's dispatch-latency p50/p99, or None for a run
+        with no dispatch spans (untraced claims, virtual-clock runs)."""
+        return self.op_stats().get("dispatch")
 
     # -- per-job lifecycle chains -------------------------------------------
 
@@ -339,6 +347,7 @@ def utest() -> None:
     spans = [
         sp("coord.claim_batch", 0.0, 0.1, ns=None, job=None),
         sp("claim", 0.0, 0.1),
+        sp("dispatch", -0.4, 0.1),     # insert→claim (DESIGN §23)
         sp("map.body", 0.2, 1.0),
         sp("store.build", 0.8, 0.9, file="result.P0.M0"),
         sp("commit", 1.1, 1.2),
@@ -374,6 +383,9 @@ def utest() -> None:
     ops = col.op_stats()
     assert ops["coord.claim_batch"]["count"] == 1
     assert abs(ops["store.build"]["p50_ms"] - 100.0) < 1e-6
+    # dispatch (insert→claim) reports in the same histogram table
+    assert abs(col.dispatch_stats()["p50_ms"] - 500.0) < 1e-6
+    assert TraceCollection([]).dispatch_stats() is None
 
     # overlap is computed over the LAST iteration only — iteration 2
     # ran no pre-merge, so the full collection reports None, while a

@@ -172,6 +172,19 @@ class TracingJobStore:
                 job_id=doc.get("_id"),
                 attempt=int(doc.get("repetitions") or 0),
                 parent=sp["sid"])
+            # the DISPATCH span (lmr-sched, DESIGN §23): insert→claim
+            # per job, from the payload's insert stamp to this claim's
+            # close — the latency the watch/notify layer exists to
+            # shrink, reported natively by the collector's per-op
+            # histograms. Guarded against clock mismatch: a virtual-
+            # clock tracer cannot be compared to the doc's wall stamp.
+            ct = doc.get("creation_time")
+            if isinstance(ct, (int, float)) and ct <= sp["t1"]:
+                self._tracer.add(
+                    "dispatch", float(ct), sp["t1"], ns=args[0],
+                    job_id=doc.get("_id"),
+                    attempt=int(doc.get("repetitions") or 0),
+                    parent=sp["sid"])
 
     def _post_claim_spec(self, sp, args, out):
         if out is not None:
@@ -309,6 +322,11 @@ def utest() -> None:
     names = [s["name"] for s in spans]
     assert names.count("claim") == 2
     assert names.count("commit") == 2
+    # every claimed doc derives a dispatch span (insert→claim) whose
+    # window opens at the job's insert stamp
+    dispatches = [s for s in spans if s["name"] == "dispatch"]
+    assert len(dispatches) == 2
+    assert all(s["t1"] >= s["t0"] for s in dispatches)
     claims = {s["job"]: s for s in spans if s["name"] == "claim"}
     assert set(claims) == {0, 1} and claims[0]["ns"] == "map_jobs"
     rpc = [s for s in spans if s["name"] == "coord.claim_batch"]

@@ -45,14 +45,25 @@ echo "speculation smoke: straggler covered by a clone, zero rep bumps"
 # under the _trace. prefix, outside every engine namespace)
 python -m pytest tests/test_trace.py -q -k "smoke"
 echo "trace smoke: spans collected, exports valid, bytes unchanged"
+# sched smoke gate (DESIGN §23): notify conformance across all three
+# store backends (wakeup fires, lost notification falls back to the
+# poll, stale wakeup is a no-op), the flood-vs-barrier fairness
+# regression, and the notify-off byte-equivalence control; the LMR011
+# (Waiter-routed waits) + notify-edge protocol gates ride the
+# lmr-analyze line below
+python -m pytest tests/test_sched.py -q \
+    -k "conformance or starvation or notify_off or wakes"
+echo "sched smoke: wakeups fire, lost notifies degrade, fairness holds"
 # lmr-analyze gate: the framework-aware lint pass must be clean against
 # the checked-in suppression baseline (analysis/baseline.json — shipped
 # EMPTY; LMR009 keeps every engine spill publish on the replication
-# helper, LMR010 keeps trace/ timing on the injectable clock), and the
+# helper, LMR010 keeps trace/ timing on the injectable clock, LMR011
+# keeps every coord/engine wait on the sched Waiter), and the
 # lease-protocol model checker must exhaustively pass
 # the 2-worker lifecycle (worker death included), the replica-recovery
-# (reconstruct-vs-requeue) edge, AND the speculation (duplicate-lease /
-# first-commit-wins / revoke) edge while re-finding all five seeded
+# (reconstruct-vs-requeue) edge, the speculation (duplicate-lease /
+# first-commit-wins / revoke) edge, AND the watch/notify (sleep /
+# wake / lost-notification) edge while re-finding all six seeded
 # races. Machine output: add --format json.
 python -m lua_mapreduce_tpu.analysis --fail-on-findings
 echo "lmr-analyze: lint clean + lease protocol model-checked"

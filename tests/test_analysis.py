@@ -416,6 +416,59 @@ def test_lmr010_scoped_to_trace(tmp_path):
     assert all(f.rule != "LMR010" for f in got)
 
 
+# --- LMR011 waiter-routed waits in coord/engine -----------------------------
+
+def test_lmr011_bare_sleep_in_engine_flagged(tmp_path):
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        import time
+
+        def idle_loop(self):
+            while True:
+                time.sleep(self.poll)
+        """)
+    assert [f.rule for f in got] == ["LMR011"]
+    assert "Waiter" in got[0].message
+
+
+def test_lmr011_bare_sleep_in_coord_flagged(tmp_path):
+    got = _lint_snippet(tmp_path, "coord/fx.py", """\
+        import time
+
+        def lock(self, poll):
+            while not self.try_lock():
+                time.sleep(poll)
+        """)
+    assert [f.rule for f in got] == ["LMR011"]
+
+
+def test_lmr011_waiter_patterns_pass(tmp_path):
+    # the legal shapes: waits routed through a Waiter, and time.sleep
+    # bound as a DEFAULT (the injection point — a reference, not a call)
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        import time
+
+        def idle_loop(self, waiter):
+            while True:
+                woken = waiter.wait(self.poll)
+
+        def make_waiter(sleep=time.sleep):
+            return sleep
+        """)
+    assert all(f.rule != "LMR011" for f in got)
+
+
+def test_lmr011_scoped_to_coord_engine(tmp_path):
+    # the sched Waiter itself (and stores, benches, tests) legitimately
+    # sleeps — the rule scopes to the coord/engine wait paths
+    got = _lint_snippet(tmp_path, "sched/fx.py", """\
+        import time
+
+        def wait(self, timeout):
+            time.sleep(timeout)
+        """)
+    assert all(f.rule != "LMR011" for f in got)
+
+
 # --- LMR007 jax purity -----------------------------------------------------
 
 def test_lmr007_impure_traced_functions_flagged(tmp_path):
@@ -497,7 +550,7 @@ def test_shipped_baseline_is_empty():
 def test_rule_catalog_complete():
     rules = lint_mod.all_rules()
     assert [r.id for r in rules] == \
-        [f"LMR00{i}" for i in range(1, 10)] + ["LMR010"]
+        [f"LMR00{i}" for i in range(1, 10)] + ["LMR010", "LMR011"]
     for r in rules:
         assert r.title and r.rationale and r.severity in ("error", "warning")
 

@@ -1,0 +1,562 @@
+"""lmr-sched test suite (DESIGN §23): watch/notify conformance across
+backends, end-to-end wakeup dispatch, notify-off byte-equivalence,
+multi-tenant fairness/starvation/admission, the protocol checker's
+notify edges, the dispatch trace span, and a SIGKILL-churn leg with
+notify on (heavy).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import Counter
+
+import pytest
+
+from lua_mapreduce_tpu.coord.filestore import FileJobStore
+from lua_mapreduce_tpu.coord.jobstore import MemJobStore, make_job
+from lua_mapreduce_tpu.core.constants import Status, TaskStatus
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.worker import Worker, resolve_idle_poll_s
+from lua_mapreduce_tpu.sched import (AdmissionError, FairScheduler,
+                                     FairWorker, Tenant, TenantView,
+                                     channel_for, dispatch_latencies,
+                                     tenant_ns)
+from lua_mapreduce_tpu.sched.waiter import (DirChannel, LocalChannel,
+                                            NullChannel, StoreChannel)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHED_MOD = "benchmarks.sched_task"
+
+BACKENDS = ("mem", "shared", "object", "fake-gcs")
+
+
+def _make_channel(kind, tmp_path):
+    """One wakeup channel per backend kind; returns (channel, cleanup)."""
+    if kind == "mem":
+        return channel_for(MemJobStore()), lambda: None
+    if kind == "shared":
+        return channel_for(FileJobStore(str(tmp_path / "coord"))), \
+            lambda: None
+    if kind == "object":
+        from lua_mapreduce_tpu.store.objectfs import ObjectStore
+        return channel_for(ObjectStore(str(tmp_path / "obj"))), \
+            lambda: None
+    from lua_mapreduce_tpu.store.fake_gcs import (install_fake_gcs,
+                                                  uninstall_fake_gcs)
+    from lua_mapreduce_tpu.store.objectfs import ObjectStore
+    prev = install_fake_gcs()
+    return channel_for(ObjectStore("gs://sched-test/pfx")), \
+        lambda: uninstall_fake_gcs(prev)
+
+
+# --------------------------------------------------------------------------
+# notify conformance across backends
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_notify_conformance_wakeup_fires(kind, tmp_path):
+    """A blocked waiter returns True promptly when the producer
+    notifies — on every backend's channel implementation."""
+    ch, cleanup = _make_channel(kind, tmp_path)
+    try:
+        w = ch.waiter()
+        got = []
+        t = threading.Thread(target=lambda: got.append(w.wait(10.0)))
+        t.start()
+        time.sleep(0.05)
+        t0 = time.perf_counter()
+        ch.notify()
+        t.join(timeout=10.0)
+        took = time.perf_counter() - t0
+        assert got == [True]
+        assert took < 2.0, f"{kind}: wakeup took {took:.3f}s"
+    finally:
+        cleanup()
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_notify_conformance_lost_notification_times_out(kind, tmp_path):
+    """No notification → the wait times out (returns False) after about
+    the requested interval: the poll fallback, never a hang."""
+    ch, cleanup = _make_channel(kind, tmp_path)
+    try:
+        w = ch.waiter()
+        t0 = time.perf_counter()
+        assert w.wait(0.15) is False
+        assert time.perf_counter() - t0 >= 0.1
+    finally:
+        cleanup()
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_notify_conformance_stale_wakeup_is_noop(kind, tmp_path):
+    """A notification is consumed exactly once; pre-history absorbed at
+    waiter creation never wakes; a raced notify (fired between waits)
+    IS delivered by the next wait — the cursor contract."""
+    ch, cleanup = _make_channel(kind, tmp_path)
+    try:
+        ch.notify()                      # pre-history
+        w = ch.waiter()
+        assert w.wait(0.05) is False     # absorbed as the baseline
+        ch.notify()                      # raced between waits
+        assert w.wait(2.0) is True       # delivered by the NEXT wait
+        assert w.wait(0.05) is False     # consumed exactly once
+    finally:
+        cleanup()
+
+
+def test_notify_off_switch_routes_null(monkeypatch):
+    monkeypatch.setenv("LMR_SCHED_NOTIFY", "0")
+    ch = channel_for(MemJobStore())
+    assert isinstance(ch, NullChannel)
+    t0 = time.perf_counter()
+    assert ch.waiter().wait(0.05) is False
+    assert time.perf_counter() - t0 >= 0.04
+
+
+def test_channel_routing_by_backend(tmp_path):
+    assert isinstance(channel_for(MemJobStore()), LocalChannel)
+    assert isinstance(channel_for(FileJobStore(str(tmp_path / "c"))),
+                      DirChannel)
+    from lua_mapreduce_tpu.store.objectfs import ObjectStore
+    assert isinstance(channel_for(ObjectStore(str(tmp_path / "o"))),
+                      StoreChannel)
+    # wrapper stacks unwrap to the shared concrete store: one bus
+    from lua_mapreduce_tpu.faults.wrappers import wrap_jobstore
+    js = MemJobStore()
+    assert channel_for(wrap_jobstore(js)) is channel_for(js)
+    assert channel_for(TenantView(js, Tenant("t"))) is channel_for(js)
+
+
+# --------------------------------------------------------------------------
+# end-to-end: inserts wake an idle worker in far less than the poll cap
+# --------------------------------------------------------------------------
+
+
+def _put_map_task(view_or_store):
+    desc = TaskSpec(taskfn=SCHED_MOD, mapfn=SCHED_MOD,
+                    partitionfn=SCHED_MOD, reducefn=SCHED_MOD,
+                    storage="mem:sched_test").describe()
+    view_or_store.put_task({"_id": "unique",
+                            "status": TaskStatus.MAP.value,
+                            "iteration": 1, "spec": desc, "batch_k": 1})
+
+
+@pytest.mark.parametrize("coord", ("mem", "shared"))
+def test_insert_wakes_idle_worker(coord, tmp_path):
+    """With a 5s poll cap, dispatch must ride the wakeup channel: the
+    claim lands within a small fraction of the cap."""
+    store = MemJobStore() if coord == "mem" \
+        else FileJobStore(str(tmp_path / "coord"))
+    _put_map_task(store)
+    w = Worker(store, name="wake-test").configure(
+        max_iter=10 ** 6, max_sleep=5.0, heartbeat_s=None)
+    t = threading.Thread(target=w.execute, daemon=True)
+    t.start()
+    time.sleep(0.4)                      # worker backs off into a wait
+    from lua_mapreduce_tpu.sched.waiter import notify
+    store.insert_jobs("map_jobs", [make_job("k", 0)])
+    notify(store, "jobs")
+    deadline = time.perf_counter() + 2.0
+    while time.perf_counter() < deadline:
+        if store.counts("map_jobs")[Status.WRITTEN]:
+            break
+        time.sleep(0.005)
+    doc = store.get_job("map_jobs", 0)
+    assert doc["status"] == Status.WRITTEN, \
+        f"job not dispatched within 2s (cap was 5s): {doc['status']}"
+    lat = doc["started_time"] - doc["creation_time"]
+    assert lat < 1.5, f"dispatch latency {lat:.3f}s — wakeup did not fire"
+    store.update_task({"status": TaskStatus.FINISHED.value})
+    notify(store, "jobs")
+    t.join(timeout=10.0)
+
+
+def test_server_barrier_wakes_on_commit():
+    """The server's "done" channel: one worker's commit wakes the
+    barrier poll long before its interval elapses — the whole
+    wordcount finishes in a fraction of the 2s poll interval."""
+    import types
+
+    from lua_mapreduce_tpu.engine.server import Server
+
+    mod = types.ModuleType("_sched_barrier_mod")
+    mod.taskfn = lambda emit: [emit(str(i), i) for i in range(3)]
+    mod.mapfn = lambda key, value, emit: emit("n", value)
+    mod.partitionfn = lambda key: 0
+    mod.reducefn = lambda key, values: sum(values)
+    mod.finalfn = lambda pairs: None
+    sys.modules["_sched_barrier_mod"] = mod
+    try:
+        store = MemJobStore()
+        spec = TaskSpec(taskfn="_sched_barrier_mod",
+                        mapfn="_sched_barrier_mod",
+                        partitionfn="_sched_barrier_mod",
+                        reducefn="_sched_barrier_mod",
+                        finalfn="_sched_barrier_mod",
+                        storage="mem:_sched_barrier")
+        server = Server(store, poll_interval=2.0).configure(spec)
+        w = Worker(store).configure(max_iter=10 ** 6, max_sleep=2.0,
+                                    heartbeat_s=None)
+        t = threading.Thread(target=w.execute, daemon=True)
+        t.start()
+        t0 = time.perf_counter()
+        server.loop()
+        wall = time.perf_counter() - t0
+        t.join(timeout=10.0)
+        # two phases × 2s interval would cost ≥4s on pure polling
+        assert wall < 3.0, f"barrier wall {wall:.2f}s — commit wakeups " \
+                           "did not reach the server"
+    finally:
+        del sys.modules["_sched_barrier_mod"]
+
+
+def test_notify_off_output_identical(monkeypatch):
+    """The notify-off path must produce byte-identical results to the
+    notify-on path (the degradation ladder's rung 3 — today's engine
+    verbatim)."""
+    import types
+
+    from lua_mapreduce_tpu.engine.server import Server
+    from lua_mapreduce_tpu.store.router import get_storage_from
+
+    mod = types.ModuleType("_sched_equiv_mod")
+    mod.taskfn = lambda emit: [emit(str(i), list(range(i + 1)))
+                               for i in range(4)]
+
+    def mapfn(key, values, emit):
+        for v in values:
+            emit(f"w{v % 3}", v)
+    mod.mapfn = mapfn
+    mod.partitionfn = lambda key: hash(key) % 2
+    mod.reducefn = lambda key, values: sum(values)
+    mod.finalfn = lambda pairs: None
+    sys.modules["_sched_equiv_mod"] = mod
+
+    def run(tag, notify_on):
+        monkeypatch.setenv("LMR_SCHED_NOTIFY", "1" if notify_on else "0")
+        store = MemJobStore()
+        spec = TaskSpec(taskfn="_sched_equiv_mod",
+                        mapfn="_sched_equiv_mod",
+                        partitionfn="_sched_equiv_mod",
+                        reducefn="_sched_equiv_mod",
+                        finalfn="_sched_equiv_mod",
+                        storage=f"mem:_sched_equiv_{tag}")
+        server = Server(store, poll_interval=0.01).configure(spec)
+        w = Worker(store).configure(max_iter=800, max_sleep=0.02)
+        t = threading.Thread(target=w.execute, daemon=True)
+        t.start()
+        server.loop()
+        t.join(timeout=10.0)
+        st = get_storage_from(f"mem:_sched_equiv_{tag}")
+        return {n: "".join(st.lines(n)) for n in st.list("result.P*")}
+
+    try:
+        on = run("on", True)
+        off = run("off", False)
+        assert on and {k.rsplit(".", 1)[-1]: v for k, v in on.items()} \
+            == {k.rsplit(".", 1)[-1]: v for k, v in off.items()}
+    finally:
+        del sys.modules["_sched_equiv_mod"]
+
+
+# --------------------------------------------------------------------------
+# multi-tenancy: admission, weighted share, starvation regression
+# --------------------------------------------------------------------------
+
+
+def test_admission_quota_refuses_flood():
+    store = MemJobStore()
+    v = TenantView(store, Tenant("q", max_pending=5))
+    v.insert_jobs("map_jobs", [make_job(f"k{i}", i) for i in range(5)])
+    with pytest.raises(AdmissionError):
+        v.insert_jobs("map_jobs", [make_job("k5", 5)])
+    assert v.admission == {"admitted": 5, "rejected": 1}
+    # AdmissionError is a PERMANENT store fault: the retry layer must
+    # not burn backoff on a full queue
+    from lua_mapreduce_tpu.faults.errors import classify_exception
+    assert classify_exception(AdmissionError("full")) is False
+
+
+def test_tenant_namespaces_and_task_docs_are_isolated():
+    store = MemJobStore()
+    a, b = TenantView(store, Tenant("a")), TenantView(store, Tenant("b"))
+    _put_map_task(a)
+    assert b.get_task() is None
+    a.insert_jobs("map_jobs", [make_job("k", 1)])
+    assert b.counts("map_jobs")[Status.WAITING] == 0
+    assert store.counts(tenant_ns("a", "map_jobs"))[Status.WAITING] == 1
+    # errors stream is shared but tenant-tagged
+    a.insert_error("w", "boom", info={"ns": "map_jobs"})
+    (err,) = store.drain_errors()
+    assert err["tenant"] == "a"
+
+
+def test_weighted_fair_share_converges():
+    """Two backlogged tenants, one shared FairWorker: committed work
+    converges to the 2:1 weight ratio (stride scheduling)."""
+    store = MemJobStore()
+    tenants = [Tenant("heavy", weight=2.0), Tenant("light", weight=1.0)]
+    views = {t.name: TenantView(store, t) for t in tenants}
+    for v in views.values():
+        _put_map_task(v)
+        v.insert_jobs("map_jobs",
+                      [make_job(f"k{i}", i) for i in range(40)])
+    fw = FairWorker(store, tenants, max_iter=5, heartbeat_s=None)
+    for _ in range(36):
+        assert fw.poll_once() == "executed"
+    snap = fw.scheduler.snapshot()
+    ratio = snap["heavy"]["charged"] / max(1, snap["light"]["charged"])
+    assert 1.4 <= ratio <= 2.8, snap
+
+
+def test_starvation_regression_flood_vs_barrier():
+    """The acceptance leg: a flood tenant's tiny-job backlog cannot
+    starve the barrier tenant. Fair two-tenant scheduling must beat
+    the FIFO (no-tenancy) baseline on the barrier's dispatch p99 by a
+    wide margin, and the barrier tenant must finish long before the
+    flood drains."""
+    from lua_mapreduce_tpu.trace.collect import percentile
+
+    def leg(fair):
+        store = MemJobStore()
+        tenants = [Tenant("flood"), Tenant("barrier")] if fair \
+            else [Tenant("flood")]
+        views = {t.name: TenantView(store, t) for t in tenants}
+        for v in views.values():
+            _put_map_task(v)
+        flood_jobs, barrier_jobs = 150, 8
+        views["flood"].insert_jobs(
+            "map_jobs", [make_job(f"f{i}", i) for i in range(flood_jobs)])
+        bview = views["barrier"] if fair else views["flood"]
+        bview.insert_jobs(
+            "map_jobs", [make_job(f"b{i}", i) for i in range(barrier_jobs)])
+        sched = FairScheduler(tenants)
+        workers = [FairWorker(store, tenants, scheduler=sched,
+                              max_iter=100000, max_sleep=0.05,
+                              heartbeat_s=None) for _ in range(3)]
+        threads = [threading.Thread(target=w.execute, daemon=True)
+                   for w in workers]
+        for t in threads:
+            t.start()
+        deadline = time.perf_counter() + 60.0
+        total = flood_jobs + barrier_jobs
+        while time.perf_counter() < deadline:
+            done = sum(v.counts("map_jobs")[Status.WRITTEN]
+                       for v in views.values())
+            if done >= total:
+                break
+            time.sleep(0.005)
+        for v in views.values():
+            v.update_task({"status": TaskStatus.FINISHED.value})
+        from lua_mapreduce_tpu.sched.waiter import notify
+        notify(store, "jobs")
+        for t in threads:
+            t.join(timeout=20.0)
+        if fair:
+            barrier = dispatch_latencies(store, "barrier")
+            flood = dispatch_latencies(store, "flood")
+        else:
+            every = dispatch_latencies(store, "flood")
+            barrier, flood = every[flood_jobs:], every[:flood_jobs]
+        assert len(barrier) == barrier_jobs
+        return (percentile(barrier, 99), percentile(flood, 99))
+
+    fair_p99, fair_flood_p99 = leg(fair=True)
+    fifo_p99, _ = leg(fair=False)
+    # fairness bound: the flooded barrier tenant's p99 stays well under
+    # the FIFO baseline (where it rides behind the whole flood), and
+    # under the flood tenant's own p99
+    assert fair_p99 < 0.6 * fifo_p99, (fair_p99, fifo_p99)
+    assert fair_p99 <= fair_flood_p99 * 1.5 + 0.005, \
+        (fair_p99, fair_flood_p99)
+
+
+# --------------------------------------------------------------------------
+# protocol checker: notify edges
+# --------------------------------------------------------------------------
+
+
+def test_protocol_notify_edges_hold_invariants():
+    from lua_mapreduce_tpu.analysis.protocol import (ModelConfig,
+                                                     check_protocol)
+    res = check_protocol(ModelConfig(n_workers=2, n_jobs=2,
+                                     allow_notify=True))
+    assert res.ok, res.violation and res.violation.message
+    base = check_protocol(ModelConfig(n_workers=2, n_jobs=2))
+    assert res.states > base.states      # the wakeup dimension is real
+
+
+def test_protocol_lost_wakeup_race_refound_and_replayable(tmp_path):
+    """The seeded lost-wakeup bug (no timeout fallback) must be
+    re-found as a hang with a sleeping worker, and its trace must
+    REPLAY against the real stores: the store ops reproduce and land
+    every job exactly where the model stranded it."""
+    from lua_mapreduce_tpu.analysis.protocol import (ModelConfig,
+                                                     check_protocol,
+                                                     replay_trace)
+    bug = check_protocol(ModelConfig(n_workers=2, n_jobs=2,
+                                     allow_notify=True,
+                                     bug="lost_wakeup_no_fallback"))
+    assert not bug.ok
+    assert "asleep" in bug.violation.message
+    for store in (MemJobStore(),
+                  FileJobStore(str(tmp_path / "replay"))):
+        rep = replay_trace(store, bug.violation.trace, bug.config,
+                           final_state=bug.violation.state)
+        assert rep["ok"], rep
+
+
+def test_protocol_notify_bug_requires_notify_dimension():
+    from lua_mapreduce_tpu.analysis.protocol import ModelConfig
+    with pytest.raises(ValueError):
+        ModelConfig(bug="lost_wakeup_no_fallback")   # allow_notify off
+
+
+# --------------------------------------------------------------------------
+# dispatch span (lmr-trace integration)
+# --------------------------------------------------------------------------
+
+
+def test_dispatch_span_reports_in_histograms():
+    from lua_mapreduce_tpu.trace.collect import TraceCollection
+    from lua_mapreduce_tpu.trace.span import Tracer
+    from lua_mapreduce_tpu.trace.wrappers import TracingJobStore
+
+    tr = Tracer()
+    tr.set_actor("w")
+    store = TracingJobStore(MemJobStore(), tr)
+    store.insert_jobs("map_jobs", [make_job("k", 1)])
+    time.sleep(0.02)
+    got = store.claim_batch("map_jobs", "w", 1)
+    assert len(got) == 1
+    col = TraceCollection(tr.drain())
+    d = col.dispatch_stats()
+    assert d is not None and d["count"] == 1
+    assert d["p50_ms"] >= 15.0           # covers the insert→claim gap
+    assert "dispatch" in col.op_stats()
+
+
+# --------------------------------------------------------------------------
+# idle-poll knob plumbing
+# --------------------------------------------------------------------------
+
+
+def test_idle_poll_resolution(monkeypatch):
+    monkeypatch.delenv("LMR_IDLE_POLL_MS", raising=False)
+    assert resolve_idle_poll_s(None, 20.0) == 20.0
+    assert resolve_idle_poll_s(500, 20.0) == 0.5
+    assert resolve_idle_poll_s(500, 0.2) == 0.2     # max_sleep still caps
+    monkeypatch.setenv("LMR_IDLE_POLL_MS", "250")
+    assert resolve_idle_poll_s(None, 20.0) == 0.25
+    with pytest.raises(ValueError):
+        resolve_idle_poll_s(-1, 20.0)
+    with pytest.raises(ValueError):
+        Worker(MemJobStore()).configure(idle_poll_ms=0)
+
+
+def test_cli_expose_idle_poll_ms():
+    from lua_mapreduce_tpu.cli.execute_server import \
+        build_parser as server_parser
+    from lua_mapreduce_tpu.cli.execute_worker import \
+        build_parser as worker_parser
+    wa = worker_parser().parse_args(["/tmp/x", "--idle-poll-ms", "250"])
+    assert wa.idle_poll_ms == 250
+    sa = server_parser().parse_args(
+        ["/tmp/x", "a", "b", "c", "d", "--idle-poll-ms", "250"])
+    assert sa.idle_poll_ms == 250
+
+
+# --------------------------------------------------------------------------
+# SIGKILL churn with notify on (heavy)
+# --------------------------------------------------------------------------
+
+
+def _env():
+    ambient = os.environ.get("PYTHONPATH", "")
+    path = REPO + os.pathsep + ambient if ambient else REPO
+    return dict(os.environ, PYTHONPATH=path, LMR_SCHED_NOTIFY="1",
+                LMR_IDLE_POLL_MS="200")
+
+
+@pytest.mark.heavy
+def test_sigkill_churn_with_notify_on(tmp_path):
+    """The churn contract survives the event-driven plane: a worker is
+    SIGKILLed mid-map with notify enabled; the stale requeue (whose
+    notify wakes the healthy fleet) recovers its job, zero FAILED,
+    golden-equal output."""
+    from examples.wordcount_big import corpus
+    from lua_mapreduce_tpu.engine.local import iter_results
+    from lua_mapreduce_tpu.engine.server import Server
+    from lua_mapreduce_tpu.store.router import get_storage_from
+
+    coord = str(tmp_path / "coord")
+    spill = str(tmp_path / "spill")
+    corpus_dir = str(tmp_path / "corpus")
+    corpus.build(corpus_dir, n_splits=4)
+    golden = Counter()
+    for i in range(4):
+        with open(corpus.split_path(corpus_dir, i)) as f:
+            golden.update(f.read().split())
+
+    stall = (
+        "import examples.wordcount_big.bigtask as bt\n"
+        "import time\n"
+        "def stall(k, v, emit):\n"
+        "    print('CLAIMED', flush=True)\n"
+        "    time.sleep(3600)\n"
+        "bt.mapfn = stall\n"
+        "import lua_mapreduce_tpu.core.native_wcmap as nw\n"
+        "nw.native_available = lambda: False\n")
+    code = (
+        "import sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "{extra}"
+        "from lua_mapreduce_tpu import FileJobStore, Worker\n"
+        f"w = Worker(FileJobStore({coord!r})).configure(\n"
+        "    max_iter=2000, max_sleep=0.5)\n"
+        "w.execute()\n")
+    victim = subprocess.Popen(
+        [sys.executable, "-c", code.format(extra=stall)], env=_env(),
+        stdout=subprocess.PIPE, text=True)
+    healthy = []
+    try:
+        spec = TaskSpec(taskfn="examples.wordcount_big.bigtask",
+                        mapfn="examples.wordcount_big.bigtask",
+                        partitionfn="examples.wordcount_big.bigtask",
+                        reducefn="examples.wordcount_big.bigtask",
+                        init_args={"corpus_dir": corpus_dir,
+                                   "n_splits": 4},
+                        storage=f"shared:{spill}")
+        server = Server(FileJobStore(coord), poll_interval=0.05,
+                        stale_timeout_s=2.0, strict=True).configure(spec)
+        done = threading.Event()
+        stats_box = {}
+
+        def run_server():
+            stats_box["stats"] = server.loop()
+            done.set()
+
+        st = threading.Thread(target=run_server, daemon=True)
+        st.start()
+        assert "CLAIMED" in victim.stdout.readline()
+        victim.kill()
+        victim.wait()
+        healthy = [subprocess.Popen(
+            [sys.executable, "-c", code.format(extra="")], env=_env())
+            for _ in range(2)]
+        assert done.wait(timeout=120.0), "task did not complete"
+        it = stats_box["stats"].iterations[-1]
+        assert it.map.failed == 0 and it.reduce.failed == 0
+        store = get_storage_from(f"shared:{spill}")
+        got = Counter({k: v[0] for k, v in iter_results(store, "result")})
+        assert got == golden
+    finally:
+        victim.kill()
+        for p in healthy:
+            p.kill()
