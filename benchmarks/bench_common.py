@@ -1,10 +1,22 @@
 """Shared scaffolding for the host-side benchmarks (shuffle_bench,
-coord_bench): helpers whose behavior is load-bearing for the headline
-ratios and must not drift between scripts."""
+coord_bench, sort_bench): helpers whose behavior is load-bearing for
+the headline ratios and must not drift between scripts.
+
+The **paired-rounds median protocol** lives here (it was duplicated
+across shuffle_bench and coord_bench before sort_bench made it a
+three-way copy): each round runs its legs back-to-back in the same
+host-contention window with the order ALTERNATED between rounds (so
+neither leg systematically inherits the other's page-cache warmth or
+writeback tax), the per-round paired ratio is what carries meaning on
+a drifting shared host, and the headline is the MEDIAN paired ratio —
+storms degrade individual rounds asymmetrically, and the median
+neither cherry-picks the best pair nor lets one storm bury the signal.
+Every round's ratio is always recorded next to the headline."""
 
 from __future__ import annotations
 
 import re
+from typing import Dict, List, Sequence
 
 
 def result_bytes(spill_dir: str, result_ns: str = "result") -> dict:
@@ -15,3 +27,60 @@ def result_bytes(spill_dir: str, result_ns: str = "result") -> dict:
     pat = re.compile(rf"^{re.escape(result_ns)}\.P(\d+)$")
     return {n: "".join(st.lines(n)) for n in st.list(f"{result_ns}.P*")
             if pat.match(n)}
+
+
+def median(xs: Sequence[float]) -> float:
+    """Plain median (even counts average the middle pair)."""
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def median_index(ratios: Sequence[float]) -> int:
+    """Index of the round carrying the median ratio — benches report
+    THAT round's raw leg rows next to the headline, so the detail
+    numbers and the headline come from the same contention window.
+    With an EVEN round count the headline (``median``) averages the
+    two middle rounds while this picks the upper-middle one — the
+    detail rows are then representative, not exactly the headline;
+    run an odd round count (the benches' defaults) when the two must
+    coincide."""
+    order = sorted(range(len(ratios)), key=lambda i: ratios[i])
+    return order[len(order) // 2]
+
+
+def paired_ratios(base_rows: List[dict], treat_rows: List[dict],
+                  key: str, higher_is_better: bool = False) -> List[float]:
+    """Per-round treatment-over-baseline speedups from paired leg rows:
+    ``base/treat`` for lower-is-better metrics (wall seconds),
+    ``treat/base`` for higher-is-better ones (jobs/sec) — >1 always
+    means the treatment won its round."""
+    out = []
+    for b, t in zip(base_rows, treat_rows):
+        if higher_is_better:
+            out.append(t[key] / max(b[key], 1e-9))
+        else:
+            out.append(b[key] / max(t[key], 1e-9))
+    return out
+
+
+def leg_order(legs: Sequence, round_idx: int) -> tuple:
+    """The alternating leg order of one paired round: forward on even
+    rounds, reversed on odd — the shared de-biasing rule."""
+    legs = tuple(legs)
+    return legs if round_idx % 2 == 0 else legs[::-1]
+
+
+def paired_speedup(base_rows: List[dict], treat_rows: List[dict],
+                   key: str, higher_is_better: bool = False
+                   ) -> Dict[str, object]:
+    """The whole protocol in one call: per-round ratios, the median
+    headline, the median round's index, and the best round (recorded
+    for context, never headlined)."""
+    ratios = paired_ratios(base_rows, treat_rows, key, higher_is_better)
+    return {
+        "speedup": round(median(ratios), 3),
+        "per_round": [round(r, 3) for r in ratios],
+        "median_round": median_index(ratios),
+        "best": round(max(ratios), 3),
+    }

@@ -242,6 +242,9 @@ def _leg(mode: str, batch_k: int, pipeline: bool, n_workers: int, files,
     }
 
 
+from benchmarks.bench_common import leg_order  # noqa: E402
+from benchmarks.bench_common import median as _median  # noqa: E402
+from benchmarks.bench_common import paired_speedup  # noqa: E402
 from benchmarks.bench_common import result_bytes as _result_bytes  # noqa: E402
 
 
@@ -253,12 +256,6 @@ def _warmup(files) -> None:
     for path in files:
         with open(path, "rb") as f:
             f.read()
-
-
-def _median(xs):
-    xs = sorted(xs)
-    n = len(xs)
-    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
 
 
 def run(n_workers: int = 0, n_jobs: int = 300, batch_k: int = 16,
@@ -290,8 +287,7 @@ def run(n_workers: int = 0, n_jobs: int = 300, batch_k: int = 16,
     try:
         for i in range(max(1, rounds)):
             for pipeline in (False, True):
-                order = modes if i % 2 == 0 else modes[::-1]
-                for mode in order:
+                for mode in leg_order(modes, i):
                     r = _leg(mode, batch_k, pipeline, n_workers, files,
                              scratch)
                     got = _result_bytes(r.pop("_spill_dir"))
@@ -309,20 +305,18 @@ def run(n_workers: int = 0, n_jobs: int = 300, batch_k: int = 16,
             v1 = legs[("v1", pipeline)]
             k1 = legs[("lease_k1", pipeline)]
             batched = legs[("lease", pipeline)]
-            ratios = [b["jobs_per_s"] / max(s["jobs_per_s"], 1e-9)
-                      for s, b in zip(v1, batched)]
-            med = sorted(range(len(ratios)),
-                         key=lambda j: ratios[j])[len(ratios) // 2]
+            # the hoisted paired-rounds median protocol (bench_common)
+            sp = paired_speedup(v1, batched, "jobs_per_s",
+                                higher_is_better=True)
+            med = sp["median_round"]
             out[f"{pmode}_v1_single"] = v1[med]
             out[f"{pmode}_lease_k1"] = k1[med]
             out[f"{pmode}_batched"] = batched[med]
-            out[f"coord_batch_speedup_{pmode}"] = round(_median(ratios), 3)
-            out[f"coord_batch_speedup_{pmode}_per_round"] = [
-                round(r, 3) for r in ratios]
-            out[f"coord_batch_speedup_{pmode}_best"] = round(max(ratios), 3)
-            out[f"coord_lease_k1_speedup_{pmode}"] = round(_median(
-                [k["jobs_per_s"] / max(s["jobs_per_s"], 1e-9)
-                 for s, k in zip(v1, k1)]), 3)
+            out[f"coord_batch_speedup_{pmode}"] = sp["speedup"]
+            out[f"coord_batch_speedup_{pmode}_per_round"] = sp["per_round"]
+            out[f"coord_batch_speedup_{pmode}_best"] = sp["best"]
+            out[f"coord_lease_k1_speedup_{pmode}"] = paired_speedup(
+                v1, k1, "jobs_per_s", higher_is_better=True)["speedup"]
         # headline: batched lease vs the seed's single-claim protocol
         # under barrier semantics (the reference's own shape); the
         # pipelined ratio shows composition with PR 1

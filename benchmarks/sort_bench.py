@@ -1,0 +1,293 @@
+"""External-sort benchmark: the push shuffle's wall-clock proof.
+
+CloudSort shape (Exoshuffle-CloudSort, PAPERS.md; ROADMAP item 1): a
+multi-GB synthetic uniform keyspace, records far larger than the push
+layer's memory budget, sorted end-to-end through the full
+map→shuffle→reduce cycle on a true multi-process worker fleet
+(FileJobStore coordination, shared-dir spill). Two legs, paired rounds
+(benchmarks/bench_common.py protocol — alternated order, median paired
+ratio headlined, every round recorded):
+
+- ``staged`` — the paper's stage-and-pull shuffle exactly as the engine
+  ships it: barrier semantics, whole-run text spills, reducers start
+  merging only after the last map commits.
+- ``push``   — the streaming shuffle (DESIGN §24): maps push JSEG0001
+  frames into per-partition reducer inboxes under the memory budget,
+  the incremental inbox merge consolidates committed frames WHILE the
+  map phase runs, and the reduce merges {spills + frame tails}.
+
+Both legs run the generic (pure-Python) data plane — LMR_DISABLE_NATIVE
+pins it for BOTH equally — and both run traced (LMR_TRACE, identical
+overhead), because the acceptance bar demands the map/merge overlap be
+PROVEN from lmr-trace span chains: ``overlap_fraction`` here is the
+fraction of pre-merge (inbox-merge) body-span time that lies before the
+last map body span ends, computed by trace/collect.py from the spans
+the fleet actually flushed — not inferred from wall clocks.
+
+Outputs are byte-compared across legs AND checked globally sorted (the
+range partitioner makes partition order the total order).
+
+Usage: python benchmarks/sort_bench.py [--smoke] [n_workers] [total_mb] [rounds]
+Artifact: benchmarks/results/sort.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks.bench_common import (leg_order, median, paired_speedup,
+                                     result_bytes)  # noqa: E402
+
+RESULTS = os.path.join(REPO, "benchmarks", "results", "sort.json")
+
+MOD = "examples.extsort.sorttask"
+
+
+def _spawn_workers(coord: str, n: int, budget_mb: float):
+    # each worker prints its process-global fault-counter snapshot on
+    # exit: push_frames/push_evictions happen in the WORKER processes,
+    # so the bench aggregates them explicitly (the coord_bench pattern
+    # for claim/commit rounds)
+    code = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from lua_mapreduce_tpu import FileJobStore, Worker\n"
+        "from lua_mapreduce_tpu.faults.retry import COUNTERS\n"
+        f"w = Worker(FileJobStore({coord!r})).configure(\n"
+        "    max_iter=100000, max_sleep=0.05, max_tasks=1,\n"
+        f"    push_budget_mb={budget_mb!r})\n"
+        "w.execute()\n"
+        "print(json.dumps({'counters': COUNTERS.snapshot(),\n"
+        "                  'jobs': w.jobs_executed}), flush=True)\n")
+    env = dict(os.environ, PYTHONPATH=REPO, LMR_TRACE="1",
+               LMR_DISABLE_NATIVE="1", JAX_PLATFORMS="cpu")
+    return [subprocess.Popen([sys.executable, "-c", code], env=env,
+                             stdout=subprocess.PIPE, text=True)
+            for _ in range(n)]
+
+
+def _leg(push: bool, n_workers: int, init_args: dict, scratch: str,
+         budget_mb: float, premerge_min_runs: int = 4,
+         premerge_max_runs: int = 16) -> dict:
+    from lua_mapreduce_tpu.coord.filestore import FileJobStore
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.server import Server
+    from lua_mapreduce_tpu.store.router import get_storage_from
+    from lua_mapreduce_tpu.trace.collect import TraceCollection
+
+    coord = tempfile.mkdtemp(prefix="sortb-coord", dir=scratch)
+    spill = tempfile.mkdtemp(prefix="sortb-spill", dir=scratch)
+    spec = TaskSpec(taskfn=MOD, mapfn=MOD, partitionfn=MOD, reducefn=MOD,
+                    init_args=init_args, storage=f"shared:{spill}")
+    procs = _spawn_workers(coord, n_workers, budget_mb)
+    t0 = time.perf_counter()
+    try:
+        server = Server(FileJobStore(coord), poll_interval=0.05,
+                        pipeline=push, push=push,
+                        segment_format="v2" if push else "v1",
+                        premerge_min_runs=premerge_min_runs,
+                        premerge_max_runs=premerge_max_runs).configure(spec)
+        stats = server.loop()
+        wall = time.perf_counter() - t0
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    fleet = {"push_frames": 0, "push_evictions": 0}
+    for p in procs:
+        try:
+            # workers exit on their own at FINISHED (max_tasks=1) and
+            # print their counter snapshots
+            out, _ = p.communicate(timeout=30)
+            tail = out.strip().rsplit("\n", 1)[-1] if out.strip() else ""
+            counters = json.loads(tail)["counters"]
+            for k in fleet:
+                fleet[k] += int(counters.get(k, 0))
+        except Exception:
+            p.kill()    # wedged straggler: counters undercount, never wrong
+    it = stats.iterations[-1]
+    n_jobs = it.map.count + it.reduce.count
+    row = {
+        "mode": "push" if push else "staged",
+        "wall_s": round(wall, 2),
+        "jobs": n_jobs,
+        "jobs_per_s": round(n_jobs / wall, 2),
+        "map_cluster_s": round(it.map.cluster_time, 2),
+        "reduce_cluster_s": round(it.reduce.cluster_time, 2),
+        "premerge_jobs": it.premerge.count,
+        "push_frames": fleet["push_frames"],
+        "push_evictions": fleet["push_evictions"],
+        "failed": it.map.failed + it.reduce.failed,
+        "overlap_fraction_stats": round(it.overlap_fraction, 3),
+        "_spill_dir": spill,
+    }
+    # span-measured overlap: the acceptance criterion's proof — from
+    # the spans the fleet flushed into the task storage, not JobTimes
+    try:
+        col = TraceCollection.from_store(get_storage_from(spec.storage))
+        ov = col.premerge_overlap()
+        row["overlap_fraction_spans"] = (round(ov, 3)
+                                         if ov is not None else None)
+        row["spans"] = len(col.spans)
+    except Exception as exc:                       # pragma: no cover
+        row["overlap_fraction_spans"] = None
+        row["trace_error"] = f"{type(exc).__name__}: {exc}"
+    return row
+
+
+def _check_sorted(spill_dir: str) -> dict:
+    """Global-order oracle: partition files in index order must carry
+    nondecreasing keys, and the last key of P(i) must precede the
+    first of P(i+1) — the range partitioner's promise."""
+    import re
+
+    from lua_mapreduce_tpu.store.sharedfs import SharedStore
+    st = SharedStore(spill_dir)
+    pat = re.compile(r"^result\.P(\d+)$")
+    names = sorted((n for n in st.list("result.P*") if pat.match(n)),
+                   key=lambda n: int(pat.match(n).group(1)))
+    records = 0
+    prev = ""
+    for name in names:
+        for line in st.lines(name):
+            line = line.strip()
+            if not line:
+                continue
+            key = json.loads(line)[0]
+            if key < prev:
+                return {"sorted": False, "at": name, "records": records}
+            prev = key
+            records += 1
+    return {"sorted": True, "partitions": len(names), "records": records}
+
+
+def run(n_workers: int = 16, total_mb: int = 2048, rounds: int = 3,
+        n_jobs: int = 64, n_partitions: int = 32,
+        budget_mb: float = 8.0, frame_kb: int = 1024) -> dict:
+    """Paired staged-vs-push rounds over one dataset shape. The push
+    budget is deliberately tiny against the dataset (records >> the
+    push layer's memory), so the bench exercises the budgeted-buffer
+    path a real records-larger-than-RAM sort lives in; the artifact
+    records both sizes so the claim is checkable. ``frame_kb`` sizes
+    the inbox frames (LMR_PUSH_FRAME_KB round-trip): GB-scale sorts
+    want ~1MB units — fewer publishes and footer reads per byte —
+    exactly Exoshuffle's block-granularity argument."""
+    from examples.extsort import sorttask
+    total_bytes = int(total_mb) << 20
+    probe = dict(n_jobs=n_jobs, records_per_job=1, n_partitions=n_partitions)
+    sorttask.init(probe)
+    line_bytes = sorttask.total_bytes() // n_jobs
+    records_per_job = max(1, total_bytes // (n_jobs * line_bytes))
+    init_args = {"n_jobs": n_jobs, "records_per_job": records_per_job,
+                 "n_partitions": n_partitions}
+    sorttask.init(init_args)
+    data_bytes = sorttask.total_bytes()
+
+    os.environ["LMR_TRACE"] = "1"            # span-proven overlap
+    os.environ["LMR_DISABLE_NATIVE"] = "1"   # generic plane, both legs
+    os.environ["LMR_PUSH_FRAME_KB"] = str(frame_kb)
+    scratch = tempfile.mkdtemp(prefix="sort-bench")
+    legs = {False: [], True: []}
+    identical = True
+    sorted_ok = None
+    try:
+        for i in range(max(1, rounds)):
+            pair = {}
+            for push in leg_order((False, True), i):
+                pair[push] = _leg(push, n_workers, init_args, scratch,
+                                  budget_mb)
+            if sorted_ok is None:
+                sorted_ok = _check_sorted(pair[True]["_spill_dir"])
+            identical = identical and (
+                result_bytes(pair[False].pop("_spill_dir"))
+                == result_bytes(pair[True].pop("_spill_dir")))
+            legs[False].append(pair[False])
+            legs[True].append(pair[True])
+            print(f"round {i}: staged {pair[False]['wall_s']}s, "
+                  f"push {pair[True]['wall_s']}s", flush=True)
+        sp = paired_speedup(legs[False], legs[True], "jobs_per_s",
+                            higher_is_better=True)
+        med = sp["median_round"]
+        overlaps = [r["overlap_fraction_spans"] for r in legs[True]
+                    if r.get("overlap_fraction_spans") is not None]
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+        os.environ.pop("LMR_TRACE", None)
+        os.environ.pop("LMR_DISABLE_NATIVE", None)
+        os.environ.pop("LMR_PUSH_FRAME_KB", None)
+
+    return {
+        "workload": "cloudsort-style synthetic external sort "
+                    "(examples/extsort)",
+        "data_bytes": data_bytes,
+        "data_gb": round(data_bytes / (1 << 30), 3),
+        "records": n_jobs * records_per_job,
+        "record_bytes": line_bytes,
+        "push_budget_mb": budget_mb,
+        "push_frame_kb": frame_kb,
+        "records_vs_budget_x": round(data_bytes / (budget_mb * (1 << 20)),
+                                     1),
+        "n_workers": n_workers,
+        "n_jobs": n_jobs,
+        "n_partitions": n_partitions,
+        "rounds": rounds,
+        "n_cores": os.cpu_count(),
+        "staged": legs[False][med],
+        "push": legs[True][med],
+        "sort_speedup": sp["speedup"],
+        "sort_speedup_per_round": sp["per_round"],
+        "sort_speedup_best": sp["best"],
+        "overlap_fraction": round(median(overlaps), 3) if overlaps else None,
+        "overlap_fraction_per_round": overlaps,
+        "identical_output": identical,
+        "sorted_check": sorted_ok,
+        "sort_mb_per_s_push": round(
+            data_bytes / (1 << 20) / legs[True][med]["wall_s"], 2),
+        "sort_mb_per_s_staged": round(
+            data_bytes / (1 << 20) / legs[False][med]["wall_s"], 2),
+        "all_rounds_wall_s": {
+            "staged": [r["wall_s"] for r in legs[False]],
+            "push": [r["wall_s"] for r in legs[True]]},
+    }
+
+
+def smoke() -> dict:
+    """The test.sh external-sort gate: a tiny end-to-end sort, push vs
+    staged, byte-identical + globally sorted + frames actually pushed.
+    Fast (<~1 min) and assertive — no artifact written."""
+    out = run(n_workers=2, total_mb=6, rounds=1, n_jobs=8,
+              n_partitions=4, budget_mb=0.25)
+    assert out["identical_output"], "push output differs from staged"
+    assert out["sorted_check"]["sorted"], out["sorted_check"]
+    assert out["push"]["push_frames"] > 0, "no frames were pushed"
+    assert out["push"]["failed"] == 0 and out["staged"]["failed"] == 0
+    return out
+
+
+if __name__ == "__main__":
+    args = [a for a in sys.argv[1:] if a != "--smoke"]
+    if "--smoke" in sys.argv[1:]:
+        res = smoke()
+        print(json.dumps({k: res[k] for k in
+                          ("data_bytes", "sort_speedup", "identical_output",
+                           "sorted_check", "overlap_fraction")}))
+        print("extsort smoke: push == staged bytes, globally sorted")
+        raise SystemExit(0)
+    n = int(args[0]) if len(args) > 0 else 16
+    mb = int(args[1]) if len(args) > 1 else 2048
+    rounds = int(args[2]) if len(args) > 2 else 3
+    result = run(n, mb, rounds)
+    print(json.dumps(result))
+    os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+    with open(RESULTS, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")

@@ -97,7 +97,7 @@ def utest():
     from lua_mapreduce_tpu.core import heap, merge, segment, serialize
     from lua_mapreduce_tpu.coord import jobstore, persistent_table
     from lua_mapreduce_tpu.engine import (contract, placement, premerge,
-                                          server, worker)
+                                          push, server, worker)
     from lua_mapreduce_tpu.store import memfs, router
     from lua_mapreduce_tpu.utils import stats
 
@@ -107,6 +107,7 @@ def utest():
     # the cpu-pinned pytest conftest instead (tests/test_q8.py etc.)
     for mod in (tuples, heap, serialize, segment, merge, jobstore, memfs,
                 contract, router, persistent_table, stats, placement,
-                premerge, worker, server, analysis, faults, trace, sched):
+                premerge, push, worker, server, analysis, faults, trace,
+                sched):
         if hasattr(mod, "utest"):
             mod.utest()

@@ -735,3 +735,70 @@ class IdleWaitRule(Rule):
                     "through the injectable Waiter "
                     "(sched.waiter.channel_for(store).waiter().wait / "
                     "NullWaiter) so notifications can interrupt it")
+
+
+# --- LMR012: inbox publishes go through spill_writer ------------------------
+
+# literal markers of push-plane names (engine/push.py): inbox frame /
+# tail fragments and the PUSH manifest namespace
+_PUSH_NAME_MARKERS = ("INBOX", ".PUSH.")
+
+
+class PushInboxPublishRule(Rule):
+    id = "LMR012"
+    severity = "error"
+    title = "inbox publishes in engine/ must go through spill_writer"
+    rationale = (
+        "Every push-shuffle publish — inbox frames, eviction tails, "
+        "PUSH manifests — must be built by a writer obtained from "
+        "faults.replicate.spill_writer (DESIGN §24): it is the one "
+        "place the negotiated replication factor becomes an r-way "
+        "fanout at the placement addresses, and the failover/repair/"
+        "blackout machinery assumes every inbox copy exists where the "
+        "placement function says. A raw store builder (store.builder()"
+        ".build(...)) publishing an INBOX-/PUSH-named file lands a "
+        "single unreplicated copy that one lost target silently "
+        "erases. Heuristic scope (the documented analysis limits): "
+        "builds whose name argument carries a literal INBOX/.PUSH. "
+        "part, receivers resolved within one function scope.")
+    paths = ("engine/",)
+
+    @staticmethod
+    def _literal_parts(node) -> str:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.JoinedStr):
+            return "".join(v.value for v in node.values
+                           if isinstance(v, ast.Constant)
+                           and isinstance(v.value, str))
+        return ""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for _scope, body in _scopes(ctx.tree):
+            ok: Set[Tuple[str, ...]] = set()
+            for n in _own_walk(body):
+                if isinstance(n, ast.Assign) \
+                        and isinstance(n.value, ast.Call):
+                    c = _chain(n.value.func)
+                    if c and c[-1] == "spill_writer":
+                        for t in n.targets:
+                            tc = _chain(t)
+                            if tc:
+                                ok.add(tc)
+            for call in _calls(body):
+                if not (isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "build" and call.args):
+                    continue
+                text = self._literal_parts(call.args[0])
+                if not any(m in text for m in _PUSH_NAME_MARKERS):
+                    continue
+                recv = _chain(call.func.value)
+                if recv is not None and recv in ok:
+                    continue
+                yield self.finding(
+                    ctx, call,
+                    "inbox/manifest publish built outside spill_writer "
+                    "— a raw builder lands ONE unreplicated copy; "
+                    "route the publish through "
+                    "faults.replicate.spill_writer so the negotiated "
+                    "replication factor applies")

@@ -119,6 +119,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--speculation-cap", type=int, default=2,
                    help="max live speculative clones per namespace "
                         "(bounds wasted duplicate work)")
+    p.add_argument("--push", action="store_true", default=None,
+                   help="push-based streaming shuffle (docs/DESIGN.md "
+                        "§24), written to the task doc as the fleet "
+                        "default: maps push JSEG frames into "
+                        "per-partition reducer inboxes as they fill, "
+                        "gated by per-map manifests; the reduce side "
+                        "merges them incrementally behind the map "
+                        "phase. Default off, or LMR_PUSH=1 (the "
+                        "subprocess-fleet round-trip); byte-identical "
+                        "output either way")
+    p.add_argument("--push-budget-mb", type=float, default=None,
+                   help="push buffer-pool memory budget in MB for the "
+                        "inline workers (default 64, or "
+                        "LMR_PUSH_BUDGET_MB): over-budget partitions "
+                        "evict to the staged spill path — graceful "
+                        "degradation instead of OOM (counted "
+                        "push_evictions)")
     p.add_argument("--trace", action="store_true",
                    help="lmr-trace (docs/DESIGN.md §22): record "
                         "claim/body/publish/commit spans and per-op "
@@ -186,12 +203,15 @@ def main(argv=None) -> int:
                     segment_format=args.segment_format,
                     replication=args.replication,
                     speculation=args.speculation_factor,
-                    speculation_cap=args.speculation_cap).configure(spec)
+                    speculation_cap=args.speculation_cap,
+                    push=args.push).configure(spec)
 
     for _ in range(args.inline_workers):
         w = Worker(store).configure(max_iter=10_000)
         if args.idle_poll_ms is not None:
             w.configure(idle_poll_ms=args.idle_poll_ms)
+        if args.push_budget_mb is not None:
+            w.configure(push_budget_mb=args.push_budget_mb)
         threading.Thread(target=w.execute, daemon=True).start()
 
     def report(phase: str, frac: float) -> None:

@@ -45,6 +45,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "lost-notification fallback latency (default: "
                         "LMR_IDLE_POLL_MS, else --max-sleep; "
                         "LMR_SCHED_NOTIFY=0 disables wakeups entirely)")
+    p.add_argument("--push", action="store_true", default=None,
+                   help="push-based streaming shuffle for THIS worker "
+                        "(docs/DESIGN.md §24; default: follow the task "
+                        "document's fleet default — which LMR_PUSH=1 "
+                        "round-trips to subprocess fleets): map output "
+                        "lands as manifest-gated JSEG inbox frames "
+                        "instead of staged run files")
+    p.add_argument("--push-budget-mb", type=float, default=None,
+                   help="push buffer-pool memory budget in MB (default "
+                        "64, or LMR_PUSH_BUDGET_MB): over-budget "
+                        "partitions evict to the staged spill path "
+                        "instead of OOMing (counted push_evictions)")
     p.add_argument("--phases", default="map,reduce",
                    help="comma list of phases this worker claims "
                         "(heterogeneous pools: dedicated mapper hosts "
@@ -103,6 +115,10 @@ def main(argv=None) -> int:
         worker.configure(segment_format=args.segment_format)
     if args.replication is not None:
         worker.configure(replication=args.replication)
+    if args.push is not None:
+        worker.configure(push=args.push)
+    if args.push_budget_mb is not None:
+        worker.configure(push_budget_mb=args.push_budget_mb)
     import contextlib
     profile_ctx = contextlib.nullcontext()
     if args.profile:

@@ -153,11 +153,17 @@ class SegmentWriter:
         self._b.write_bytes(MAGIC)
 
     def add(self, key: Any, values: Any) -> None:
+        self.add_line(key, dump_record(key, values))
+
+    def add_line(self, key: Any, line: str) -> None:
+        """Append a pre-serialized record line (no trailing newline) —
+        the push buffer's re-serialization-free path (engine/push.py
+        holds lines, not records): ``key`` still feeds the footer's
+        first-key index and the str_keys merge promise."""
         if type(key) is not str:
             self._str_keys = False
         if self._first_key is None:
             self._first_key = dump_key(key)
-        line = dump_record(key, values)
         self._lines.append(line)
         self._size += len(line) + 1
         if self._size >= self._frame_bytes:
@@ -178,6 +184,14 @@ class SegmentWriter:
                             self._first_key])
         self._off += _FRAME_HDR.size + len(data)
         self._lines, self._size, self._first_key = [], 0, None
+
+    @property
+    def compressed_frames(self) -> int:
+        """How many closed frames actually shrank under the codec —
+        the adaptive-codec signal (engine/push.py): a writer whose
+        frames keep falling back to raw is paying compression CPU for
+        nothing, which a GB-scale incompressible sort cannot afford."""
+        return sum(1 for _off, enc, dec, _k in self._index if enc < dec)
 
     def build(self, name: str) -> None:
         self._close_frame()
@@ -214,6 +228,11 @@ class TextWriter:
     def add(self, key: Any, values: Any) -> None:
         self._b.write(dump_record(key, values) + "\n")
 
+    def add_line(self, key: Any, line: str) -> None:
+        """Pre-serialized-line twin of ``add`` (SegmentWriter parity —
+        push writers switch format by construction alone)."""
+        self._b.write(line + "\n")
+
     def build(self, name: str) -> None:
         self._b.build(name)
 
@@ -236,14 +255,54 @@ def writer_for(store, segment_format: str = "v1", codec: str = "zlib"):
     return TextWriter(store.builder())
 
 
+# parsed-footer cache: the incremental inbox merge (engine/push.py,
+# DESIGN §24) opens the same frame files repeatedly — pre-merge input
+# probes, reduce pull-integrity plus merge, failover re-opens — and
+# every SegmentReader construction paid the trailer + footer ranged
+# reads again. The parsed footer is cached per (name, size) ON the
+# innermost store object (lifetime tied to the store: no cross-store
+# collisions, no stale id reuse), bounded FIFO. Safe under the engine's
+# deterministic-overwrite contract (duplicate publishes write identical
+# bytes — job.py's stated assumption), and the size key evicts any
+# honest rewrite that changed length.
+_FOOTER_CACHE_CAP = 1024
+FOOTER_READS_SAVED = 0          # regression-test observability
+
+
+def _footer_cache(store) -> Optional[dict]:
+    from lua_mapreduce_tpu.faults.wrappers import unwrap
+    host = unwrap(store)
+    cache = getattr(host, "_jseg_footers", None)
+    if cache is None:
+        try:
+            cache = host._jseg_footers = {}
+        except Exception:       # slotted third-party store: skip caching
+            return None
+    return cache
+
+
+def purge_footer_cache(store) -> None:
+    """Drop every cached footer of ``store`` — the iteration-rollover
+    hook: loop tasks REUSE run/fragment names with different contents,
+    and fixed-width records (a sort keyspace) can reproduce the exact
+    byte size, so the (name, size) key alone cannot catch the rewrite.
+    Both engines call this from their iteration-start cleanup
+    (Server._clean_runs, LocalExecutor.run_one_iteration)."""
+    from lua_mapreduce_tpu.faults.wrappers import unwrap
+    cache = getattr(unwrap(store), "_jseg_footers", None)
+    if cache:
+        cache.clear()
+
+
 class SegmentReader:
     """Lazy frame decoder over a store's ranged-read surface.
 
-    The footer index is read once (two small ranged reads: trailer, then
-    footer); ``iter_records`` walks frames in order, batching consecutive
-    frames into ~``readahead`` ranged reads and batch-parsing each frame
-    with one ``json.loads``. Nothing beyond one read batch is ever
-    resident.
+    The footer index is read once per FILE, not per reader: two small
+    ranged reads (trailer, then footer) on first open, a per-store
+    parsed-footer cache hit on every re-open (see ``_footer_cache``).
+    ``iter_records`` walks frames in order, batching consecutive frames
+    into ~``readahead`` ranged reads and batch-parsing each frame with
+    one ``json.loads``. Nothing beyond one read batch is ever resident.
     """
 
     def __init__(self, store, name: str, head: Optional[bytes] = None):
@@ -257,15 +316,28 @@ class SegmentReader:
             head = store.read_range(name, 0, len(MAGIC))
         if head[:len(MAGIC)] != MAGIC:
             raise ValueError(f"{name}: not a JSEG0001 segment")
-        trailer = self._ranged(size - _TRAILER.size, _TRAILER.size)
-        foot_off, foot_len, foot_crc, magic = _TRAILER.unpack(trailer)
-        if magic != MAGIC:
-            raise ValueError(f"{name}: segment trailer magic mismatch "
-                             "(truncated or corrupt)")
-        footer = self._ranged(foot_off, foot_len)
-        if zlib.crc32(footer) & 0xFFFFFFFF != foot_crc:
-            raise ValueError(f"{name}: segment footer CRC mismatch")
-        meta = json.loads(footer)
+        cache = _footer_cache(store)
+        meta = cache.get((name, size)) if cache is not None else None
+        if meta is not None:
+            global FOOTER_READS_SAVED
+            FOOTER_READS_SAVED += 2        # trailer + footer skipped
+        else:
+            trailer = self._ranged(size - _TRAILER.size, _TRAILER.size)
+            foot_off, foot_len, foot_crc, magic = _TRAILER.unpack(trailer)
+            if magic != MAGIC:
+                raise ValueError(f"{name}: segment trailer magic mismatch "
+                                 "(truncated or corrupt)")
+            footer = self._ranged(foot_off, foot_len)
+            if zlib.crc32(footer) & 0xFFFFFFFF != foot_crc:
+                raise ValueError(f"{name}: segment footer CRC mismatch")
+            meta = json.loads(footer)
+            if cache is not None:
+                try:
+                    if len(cache) >= _FOOTER_CACHE_CAP:
+                        cache.pop(next(iter(cache)))    # FIFO bound
+                except (KeyError, StopIteration):
+                    pass        # concurrent evictor won the race: fine
+                cache[(name, size)] = meta
         self.frames: List[list] = meta["frames"]   # [off, enc, dec, key]
         self.records: int = meta.get("records", 0)
         self.decoded_bytes: int = meta.get("decoded_bytes", 0)

@@ -94,7 +94,10 @@ def map_output_name(result_ns: str, part: int, map_key: Any) -> str:
 def run_map_job(spec: TaskSpec, store: Store, job_id: str,
                 map_key: Any, map_value: Any,
                 segment_format: str = "v1",
-                replication: int = 1) -> JobTimes:
+                replication: int = 1,
+                push: bool = False,
+                push_pool=None,
+                spec_lineage: str = None) -> JobTimes:
     """Execute one map job and write per-partition sorted run files.
 
     Mirrors job.lua:154-228: run user mapfn with the grouping emit; sort
@@ -116,6 +119,15 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
     namespace are always valid. ``replication`` (DESIGN §20, negotiated
     the same way) fans each run file out to r placement copies; r=1 is
     byte-identical to the unreplicated path.
+
+    ``push`` (DESIGN §24) switches the publish side to the streaming
+    shuffle: each partition's records land as JSEG0001 frame files in
+    the per-partition reducer inbox the moment a frame fills, bounded
+    by ``push_pool``'s memory budget (over-budget partitions evict to
+    a staged tail spill), gated by the manifest published last.
+    ``spec_lineage`` quarantines a speculative clone's pushes under
+    its spec identity until its commit wins (engine/push.py). Output
+    records and their canonical merge order are identical either way.
     """
     check_format(segment_format)
     times = JobTimes(started=time.time())
@@ -145,7 +157,20 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
     spec.mapfn(map_key, map_value, emit)
     times.finished = time.time()
 
+    # one emit loop for BOTH publish modes — validation (combiner fold,
+    # serializability, partitionfn range) must never diverge between
+    # push-on and push-off runs, or byte-identity silently breaks. Only
+    # the per-record sink differs: staged accumulates per-partition
+    # writers built at the end; push streams frames as buffers fill
+    # (DESIGN §24: the manifest publishes last, so a crash at any point
+    # leaves only invisible orphans).
+    pw = None
     writers: Dict[int, Any] = {}
+    if push:
+        from lua_mapreduce_tpu.engine.push import PushWriter
+        pw = PushWriter(store, spec.result_ns, map_key_str(job_id),
+                        replication=replication, pool=push_pool,
+                        lineage=spec_lineage)
     try:
         for key in sorted_keys(result.keys()):
             values = result[key]
@@ -157,18 +182,26 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
             if part < 0:
                 raise ValueError(
                     f"partitionfn({key!r}) returned negative {part}")
+            if pw is not None:
+                pw.add(part, key, values)
+                continue
             w = writers.get(part)
             if w is None:
                 w = writers[part] = spill_writer(store, segment_format,
                                                  replication)
             w.add(key, values)
 
-        for part, w in writers.items():
-            w.build(map_output_name(spec.result_ns, part, job_id))
+        if pw is not None:
+            pw.finish()
+        else:
+            for part, w in writers.items():
+                w.build(map_output_name(spec.result_ns, part, job_id))
     finally:
         # deterministic release of any unbuilt builder (failed user code
         # / partitionfn): writer threads, fds, and tempfiles must not
         # wait for GC on a long-lived elastic worker
+        if pw is not None:
+            pw.close()
         for w in writers.values():
             w.close()
 

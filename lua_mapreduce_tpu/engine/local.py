@@ -122,7 +122,9 @@ class LocalExecutor:
                  max_iterations: int = 1000, pipeline: bool = False,
                  premerge_min_runs: int = 4, premerge_max_runs: int = 8,
                  batch_k: int = 1, segment_format: str = "v1",
-                 replication: Optional[int] = None):
+                 replication: Optional[int] = None,
+                 push: Optional[bool] = None,
+                 push_budget_mb: Optional[float] = None):
         self.spec = spec
         self.map_parallelism = max(1, map_parallelism)
         self.max_iterations = max_iterations
@@ -145,6 +147,15 @@ class LocalExecutor:
         # r=1 (the default) is byte-identical to the unreplicated path.
         from lua_mapreduce_tpu.engine.placement import resolve_replication
         self.replication = resolve_replication(replication)
+        # push-based streaming shuffle (DESIGN §24): map output lands as
+        # manifest-gated inbox frames under ONE shared memory-budgeted
+        # buffer pool (the executor's map threads are its "worker").
+        # Off (the default) is byte-identical to the staged path.
+        from lua_mapreduce_tpu.engine.push import (BufferPool, resolve_push,
+                                                   resolve_push_budget)
+        self.push = resolve_push(push)
+        self._push_pool = BufferPool(resolve_push_budget(push_budget_mb)) \
+            if self.push else None
         from lua_mapreduce_tpu.faults.replicate import reading_view
         self.store = get_storage_from(spec.storage)
         # discovery/cleanup address LOGICAL files through the failover
@@ -205,6 +216,17 @@ class LocalExecutor:
         # data this iteration must not leak last iteration's results
         # (reference drops collections per iteration, server.lua:331-345)
         delete_results(self.result_store, spec.result_ns)
+        # iteration rollover reuses run/fragment names with new
+        # contents; a same-size rewrite would slip past the footer
+        # cache's (name, size) key (Server._clean_runs does the same)
+        from lua_mapreduce_tpu.core.segment import purge_footer_cache
+        purge_footer_cache(self.store)
+        if self.push:
+            # iteration hygiene (the server's _clean_runs analog): a
+            # stale canonical manifest would win this iteration's
+            # publish-if-absent race and name consumed files
+            from lua_mapreduce_tpu.engine.push import sweep_push_files
+            sweep_push_files(self._view, spec.result_ns)
 
         jobs = collect_task_jobs(spec)
         if self.pipeline:
@@ -220,11 +242,19 @@ class LocalExecutor:
                     "map", i, lambda: run_map_job(
                         spec, self.store, str(i), k, v,
                         segment_format=self.segment_format,
-                        replication=self.replication)))
+                        replication=self.replication,
+                        push=self.push, push_pool=self._push_pool)))
                 for i, (k, v) in enumerate(jobs)])
             it_stats.map.fold(map_times)
 
-            parts = discover_partitions(self._view, spec.result_ns)
+            if self.push:
+                from lua_mapreduce_tpu.engine.push import discover_push
+                parts = discover_push(
+                    self._view, spec.result_ns,
+                    [map_key_str(i) for i in range(len(jobs))],
+                    replication=self.replication)
+            else:
+                parts = discover_partitions(self._view, spec.result_ns)
             reduce_times = self._run_jobs([
                 (lambda p=p, files=files: self._traced(
                     "reduce", p, lambda: run_reduce_job(
@@ -308,13 +338,22 @@ class LocalExecutor:
                 "map", i, lambda: run_map_job(
                     spec, self.store, str(i), k, v,
                     segment_format=self.segment_format,
-                    replication=self.replication))
+                    replication=self.replication,
+                    push=self.push, push_pool=self._push_pool))
             produced = {}
-            for name in self.store.list(
-                    f"{spec.result_ns}.P*.M{map_keys[i]}"):
-                m = run_re.match(name)
-                if m and m.group(2) == map_keys[i]:
-                    produced[int(m.group(1))] = name
+            if self.push:
+                from lua_mapreduce_tpu.engine.push import (
+                    ensure_canonical, manifest_files_by_part)
+                man = ensure_canonical(self.store, spec.result_ns,
+                                       map_keys[i], self.replication)
+                if man is not None:
+                    produced = manifest_files_by_part(man)
+            if not produced:
+                for name in self.store.list(
+                        f"{spec.result_ns}.P*.M{map_keys[i]}"):
+                    m = run_re.match(name)
+                    if m and m.group(2) == map_keys[i]:
+                        produced[int(m.group(1))] = name
             with lock:
                 map_times.append(t)
                 tracker.note_map_committed(map_keys[i], produced)
@@ -333,7 +372,9 @@ class LocalExecutor:
                 f.result()
             for f in list(pre_futs):
                 f.result()
-            parts = discover_pipelined(self._view, spec.result_ns, map_keys)
+            parts = discover_pipelined(self._view, spec.result_ns, map_keys,
+                                       push=self.push,
+                                       replication=self.replication)
             red_futs = [pool.submit(
                 lambda p=p, files=files: self._traced(
                     "reduce", p, lambda: run_reduce_job(

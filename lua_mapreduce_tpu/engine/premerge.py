@@ -119,18 +119,26 @@ class PremergeTracker:
     # -- events -------------------------------------------------------------
 
     def note_map_committed(self, map_key: str,
-                           runs_by_part: Dict[int, str]) -> None:
+                           runs_by_part: Dict[int, object]) -> None:
         """Map job ``map_key`` reached its terminal state; ``runs_by_part``
         lists the run files it left behind (empty for FAILED jobs —
-        their partitions simply see it as absent)."""
+        their partitions simply see it as absent). A value may be one
+        run-file name (the staged shuffle) or an ordered LIST of files
+        — a pushed map's inbox fragments + eviction tail (DESIGN §24):
+        one canonical position then carries several files whose
+        internal order is the map's own record order, so consolidating
+        them in position order stays byte-compatible."""
         p = self.pos.get(str(map_key))
         if p is None or p in self.committed:
             return
         self.committed.add(p)
-        for part, name in runs_by_part.items():
+        for part, names in runs_by_part.items():
             if p in self.covered.get(part, {}):
                 continue   # resume leftover: a spill already consumed it
-            self.runs.setdefault(int(part), {})[p] = name
+            if isinstance(names, str):
+                names = [names]
+            if names:
+                self.runs.setdefault(int(part), {})[p] = list(names)
 
     def note_existing_spill(self, part: int, a: int, b: int,
                             name: str) -> None:
@@ -205,11 +213,11 @@ class PremergeTracker:
         return out
 
     def _make_spill(self, part: int, chunk: List[int],
-                    runmap: Dict[int, str]) -> SpillJob:
+                    runmap: Dict[int, List[str]]) -> SpillJob:
         seq, self._seq = self._seq, self._seq + 1
         a, b = chunk[0], chunk[-1]
         sp = SpillJob(part, seq, a, b, list(chunk),
-                      [runmap.pop(p) for p in chunk],
+                      [f for p in chunk for f in runmap.pop(p)],
                       spill_name(self.ns, part, a, b))
         cov = self.covered.setdefault(part, {})
         for p in range(a, b + 1):
@@ -223,7 +231,9 @@ class PremergeTracker:
 
 
 def discover_pipelined(store, result_ns: str,
-                       map_keys: Iterable[str]) -> Dict[int, List[str]]:
+                       map_keys: Iterable[str],
+                       push: bool = False,
+                       replication: int = 1) -> Dict[int, List[str]]:
     """Partition → ordered reduce input list, rebuilt from storage alone.
 
     The pipelined analog of local.discover_partitions: spills slot in at
@@ -233,6 +243,13 @@ def discover_pipelined(store, result_ns: str,
     already carries their data, so they are dropped (and swept, best
     effort). The returned order is exactly the barrier merge order, so
     reduce output is byte-identical.
+
+    With ``push`` (DESIGN §24) a map's position may carry several files
+    — its manifest-named inbox fragments in seq order plus the eviction
+    tail — resolved through the canonical-manifest gate (classic runs
+    stay the fallback for push-off fleet members); orphan fragments no
+    canonical lineage names are swept here, the one place every map is
+    known terminal.
     """
     order = sorted(str(k) for k in map_keys)
     run_re = run_name_re(result_ns)
@@ -274,21 +291,39 @@ def discover_pipelined(store, result_ns: str,
                 "de-duplicate at file granularity; clear the stale "
                 "spill files and re-run the iteration")
         for a, b, name in accepted:
-            items.setdefault(part, []).append(((a, 0, name), name))
+            items.setdefault(part, []).append(((a, 0, 0, name), name))
             covered.setdefault(part, []).append((a, b))
-    for name in store.list(f"{result_ns}.P*.M*"):
-        m = run_re.match(name)
-        if not m:
-            continue
-        part, key = int(m.group(1)), m.group(2)
-        p = bisect.bisect_left(order, key)
-        if any(a <= p <= b for a, b in covered.get(part, ())):
-            try:
-                store.remove(name)   # consumed leftover; sweep
-            except Exception:
-                pass
-            continue
-        items.setdefault(part, []).append(((p, 1, key), name))
+    if push:
+        from lua_mapreduce_tpu.engine.push import (push_file_lists,
+                                                   sweep_unreferenced)
+        lists, referenced = push_file_lists(store, result_ns, order,
+                                            replication)
+        for p, key in enumerate(order):
+            for part, files in lists.get(key, {}).items():
+                if any(a <= p <= b for a, b in covered.get(part, ())):
+                    for f in files:     # consumed leftovers; sweep
+                        try:
+                            store.remove(f)
+                        except Exception:
+                            pass
+                    continue
+                items.setdefault(part, []).extend(
+                    ((p, 1, i, f), f) for i, f in enumerate(files))
+        sweep_unreferenced(store, result_ns, referenced, order)
+    else:
+        for name in store.list(f"{result_ns}.P*.M*"):
+            m = run_re.match(name)
+            if not m:
+                continue
+            part, key = int(m.group(1)), m.group(2)
+            p = bisect.bisect_left(order, key)
+            if any(a <= p <= b for a, b in covered.get(part, ())):
+                try:
+                    store.remove(name)   # consumed leftover; sweep
+                except Exception:
+                    pass
+                continue
+            items.setdefault(part, []).append(((p, 1, 0, name), name))
     return {part: [n for _, n in sorted(lst)] for part, lst in items.items()}
 
 

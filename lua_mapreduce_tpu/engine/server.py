@@ -127,7 +127,8 @@ class Server:
                  segment_format: str = "v1",
                  replication: Optional[int] = None,
                  speculation: Optional[float] = None,
-                 speculation_cap: int = 2):
+                 speculation_cap: int = 2,
+                 push: Optional[bool] = None):
         # coord RPCs ride the transient-fault retry layer (DESIGN §19);
         # the scavenge/requeue/drain housekeeping must not abort an
         # iteration over one store blip
@@ -165,6 +166,14 @@ class Server:
         # unspeculative fleet pays zero extra round trips
         self.speculation = resolve_speculation(speculation)
         self.speculation_cap = max(1, int(speculation_cap))
+        # push-based streaming shuffle (DESIGN §24; None = LMR_PUSH env,
+        # else off): map output lands as manifest-gated inbox frames the
+        # reduce side merges incrementally. Task-doc deployed like
+        # pipeline/replication, and STICKY on resume for the same
+        # reason: a crashed push run's data lives behind manifests a
+        # push-off resume's discovery would not consult.
+        from lua_mapreduce_tpu.engine.push import resolve_push
+        self.push = resolve_push(push)
         self.spec: Optional[TaskSpec] = None
         self.stats = TaskStats()
         self.finished_value: Any = None
@@ -275,6 +284,10 @@ class Server:
                 # on the doc marker, so a doc that predates it must not
                 # leave published pre_merge jobs unclaimable
                 self.pipeline = bool(task.get("pipeline", self.pipeline))
+                # push shares the pipeline rule: manifests gate a push
+                # run's data visibility, so a push-off resume would
+                # silently drop everything the crashed run pushed
+                self.push = bool(task.get("push", self.push))
                 # replication shares the pipeline rule: a crashed r>1
                 # run may hold data ONLY in replica copies (primary lost
                 # mid-crash) — an r=1 resume could not see it, so the
@@ -289,6 +302,7 @@ class Server:
                 # the resuming server's configuration wins over the doc's
                 self.store.update_task({
                     "pipeline": self.pipeline,
+                    "push": self.push,
                     "batch_k": self.batch_k,
                     "segment_format": self.segment_format,
                     "replication": self.replication,
@@ -307,6 +321,9 @@ class Server:
                 # workers gate their pre_jobs probe on this marker, so
                 # barrier deployments pay zero extra claim round-trips
                 "pipeline": self.pipeline,
+                # workers gate their map-publish mode on this marker:
+                # push-off fleets pay zero push-layer overhead
+                "push": self.push,
                 # the fleet's default claim-lease size; workers with no
                 # explicit batch_k of their own follow this
                 "batch_k": self.batch_k,
@@ -460,12 +477,24 @@ class Server:
 
     def _clean_runs(self, store) -> None:
         """Drop every intermediate run file of this namespace — raw
-        mapper runs (``ns.P*.M*``) AND pipelined spill runs
-        (``ns.P*.SPILL-*``) — the map-side analog of delete_results."""
+        mapper runs (``ns.P*.M*``), pipelined spill runs
+        (``ns.P*.SPILL-*``), and push inbox fragments (``ns.P*.INBOX-*``;
+        the ``.M*`` glob already matches the ``ns.PUSH.M*`` manifests,
+        which MUST go too — a stale canonical manifest would win the
+        publish-if-absent race against this iteration's fresh lineage)
+        — the map-side analog of delete_results."""
+        from lua_mapreduce_tpu.engine.push import INBOX_TAG
         for pattern in (f"{self.spec.result_ns}.P*.M*",
-                        f"{self.spec.result_ns}.P*.{SPILL_TAG}-*"):
+                        f"{self.spec.result_ns}.P*.{SPILL_TAG}-*",
+                        f"{self.spec.result_ns}.P*.{INBOX_TAG}-*"):
             for name in store.list(pattern):
                 store.remove(name)
+        # names just swept will be REUSED by this iteration's maps with
+        # different contents — and fixed-width records can reproduce
+        # the exact byte size, so the footer cache's (name, size) key
+        # cannot catch the rewrite on its own
+        from lua_mapreduce_tpu.core.segment import purge_footer_cache
+        purge_footer_cache(store)
 
     def _prepare_reduce(self, store) -> int:
         """Discover map-output partitions and insert one reduce job per
@@ -485,7 +514,17 @@ class Server:
             # a crash/resume, where the tracker state is gone
             map_keys = [map_key_str(d["_id"])
                         for d in self.store.jobs(MAP_NS)]
-            parts = discover_pipelined(store, self.spec.result_ns, map_keys)
+            parts = discover_pipelined(store, self.spec.result_ns, map_keys,
+                                       push=self.push,
+                                       replication=self.replication)
+        elif self.push:
+            # barrier + push: inbox fragments slot in at their map's
+            # canonical position through the manifest gate (DESIGN §24)
+            from lua_mapreduce_tpu.engine.push import discover_push
+            map_keys = [map_key_str(d["_id"])
+                        for d in self.store.jobs(MAP_NS)]
+            parts = discover_push(store, self.spec.result_ns, map_keys,
+                                  replication=self.replication)
         else:
             parts = discover_partitions(store, self.spec.result_ns)
         producer_by_id = {map_key_str(jid): w
@@ -693,11 +732,34 @@ class Server:
         fault) so the pool regenerates the data during the reduce
         phase (Worker's replication-gated map probe). A lost SPILL
         additionally needs its pre-merge republished once the covering
-        map jobs land — tracked in ``_spill_repairs``."""
+        map jobs land — tracked in ``_spill_repairs``. A lost push
+        FRAGMENT (or manifest) requeues its producer too, after the
+        stale canonical manifest is invalidated so the re-run's fresh
+        lineage can publish — best-effort: a re-run under different
+        memory pressure may fragment differently, and a reduce job
+        holding the old file list then retries through the normal
+        missing-runs ladder (DESIGN §24)."""
         ns = self.spec.result_ns
         m = run_name_re(ns).match(name)
         if m:
             self._requeue_maps([m.group(2)], name)
+            return
+        from lua_mapreduce_tpu.engine.push import (manifest_name,
+                                                   parse_inbox_name,
+                                                   parse_manifest_name)
+        inbox = parse_inbox_name(ns, name)
+        man = parse_manifest_name(ns, name) if inbox is None else None
+        if inbox is not None or man is not None:
+            key = inbox[1] if inbox is not None else man[0]
+            # invalidate the lineage whose file is gone (every copy of
+            # the canonical manifest, so publish-if-absent re-opens)
+            from lua_mapreduce_tpu.faults.replicate import reading_view
+            view = reading_view(self._data_store, self.replication)
+            try:
+                view.remove(manifest_name(ns, key))
+            except Exception:
+                pass
+            self._requeue_maps([key], name)
             return
         parsed = parse_spill_name(ns, name)
         if parsed is None:
@@ -752,6 +814,14 @@ class Server:
         status = {d["_id"]: d["status"] for d in self.store.jobs(MAP_NS)}
         order = sorted(by_key)
         run_re = run_name_re(ns)
+        # settle-ready repairs first, so the push branch resolves ONE
+        # file-list pass for the union of their keys — push_file_lists
+        # opens with a full-namespace listing plus per-key manifest
+        # reads, and paying that per repair per housekeeping pass would
+        # turn many lost spills into O(repairs × namespace) RPCs (the
+        # staged branch's per-partition glob stays per-repair: it is
+        # one single-partition listing)
+        ready: List[tuple] = []
         for spill, (part, a, b) in list(self._spill_repairs.items()):
             if view.exists(spill):
                 self._spill_repairs.pop(spill)
@@ -760,9 +830,24 @@ class Server:
             if not all(status.get(by_key[k]) == Status.WRITTEN
                        for k in keys if k in by_key):
                 continue        # producers still re-running
+            ready.append((spill, part, a, b, keys))
+        push_lists = None
+        if self.push and ready:
+            from lua_mapreduce_tpu.engine.push import push_file_lists
+            union = sorted({k for _, _, _, _, keys in ready for k in keys})
+            push_lists, _ = push_file_lists(view, ns, union,
+                                            self.replication)
+        for spill, part, a, b, keys in ready:
             wanted = set(keys)
-            files = [n for n in view.list(f"{ns}.P{part}.M*")
-                     if (mm := run_re.match(n)) and mm.group(2) in wanted]
+            if push_lists is not None:
+                # push re-runs re-emit manifest-gated inbox files, not
+                # bare runs: the same canonical resolution the tracker
+                # uses, computed once above for every ready repair
+                files = [f for key in sorted(wanted)
+                         for f in push_lists.get(key, {}).get(part, [])]
+            else:
+                files = [n for n in view.list(f"{ns}.P{part}.M*")
+                         if (mm := run_re.match(n)) and mm.group(2) in wanted]
             if not files:
                 self._spill_repairs.pop(spill)
                 continue        # nothing re-emitted for this partition
@@ -856,6 +941,21 @@ class Server:
                 for d in newly:
                     seen_committed.add(d["_id"])
                     key = map_key_str(d["_id"])
+                    if self.push:
+                        # push mode: the committed map's inbox lineage
+                        # resolves through the manifest gate (with the
+                        # promote backstop for a winning clone that
+                        # died pre-promote); classic runs stay the
+                        # fallback for push-off fleet members and the
+                        # native map fast path (DESIGN §24)
+                        from lua_mapreduce_tpu.engine.push import (
+                            ensure_canonical, manifest_files_by_part)
+                        man = ensure_canonical(store, ns, key,
+                                               self.replication)
+                        if man is not None:
+                            tracker.note_map_committed(
+                                key, manifest_files_by_part(man))
+                            continue
                     # FAILED jobs contribute whatever partial runs they
                     # managed to publish — the barrier path's documented
                     # partial-results behavior (discover_partitions

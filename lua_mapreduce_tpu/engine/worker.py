@@ -51,7 +51,7 @@ MAX_INFRA_POLL_FAILURES = 10
 
 _CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases",
                 "heartbeat_s", "batch_k", "batch_lease_s", "segment_format",
-                "replication", "idle_poll_ms")
+                "replication", "idle_poll_ms", "push", "push_budget_mb")
 
 
 def resolve_idle_poll_s(idle_poll_ms, max_sleep: float) -> float:
@@ -137,6 +137,15 @@ class Worker:
         # unreplicated path.
         self.replication = None
         self._task_replication = None           # last task doc's value
+        # push-based streaming shuffle (DESIGN §24): None = follow the
+        # task document's fleet default (the server-deployed marker);
+        # an explicit configure(push=...) wins. The memory budget is a
+        # WORKER knob (it bounds THIS process's buffer pool), resolved
+        # explicit → LMR_PUSH_BUDGET_MB → default.
+        self.push = None
+        self.push_budget_mb = None
+        self._task_push = None                  # last task doc's value
+        self._push_pool_obj = None              # lazy per-worker pool
         self._dur_ewma: Dict[str, float] = {}   # ns -> smoothed real secs
         self._fleet_ewma: Dict[str, float] = {}  # last task-doc aggregate
         self._ewma_pushed: Dict[str, float] = {}  # ns -> last value pushed
@@ -260,6 +269,7 @@ class Worker:
             self._infra_released.clear()
         self._task_segment_format = task.get("segment_format")
         self._task_replication = task.get("replication")
+        self._task_push = task.get("push")
         self._speculation = float(task.get("speculation") or 0.0)
         # fleet duration aggregate (DESIGN §21): remember the doc's
         # values for the persist blend, and SEED this worker's own EWMA
@@ -275,6 +285,34 @@ class Worker:
                 self._dur_ewma[ns_key] = float(val)
 
         if task["status"] == TaskStatus.MAP.value:
+            # eager pre-merge rides INSIDE the map phase (pipelined
+            # shuffle): reduce-side consolidation of committed runs
+            # behind the same phase filter as reduce jobs. Claim
+            # PRIORITY depends on the shuffle mode: staged pipelining
+            # treats consolidation as idle-capacity work (map progress
+            # first — a pre-merge can always run later), but the PUSH
+            # shuffle's whole point is the merge keeping pace with
+            # frame production (DESIGN §24) — inbox-merge jobs are
+            # serviced FIRST, so consolidation interleaves with the
+            # maps instead of piling into a post-map drain. Map
+            # progress is preserved either way: pre_jobs exist only in
+            # tracker-bounded batches, never as an open-ended queue.
+            # The task-doc markers gate the probes: barrier-mode tasks
+            # never pay the extra pre_jobs claim round-trip per poll.
+            pre_first = bool(task.get("push")) and task.get("pipeline")
+
+            def probe_pre():
+                if "reduce" in self.phases and task.get("pipeline"):
+                    jobs = self.store.claim_batch(
+                        PRE_NS, self.name, self._effective_k(PRE_NS, task))
+                    if jobs:
+                        self._idle_count = 0
+                        self._execute_batch(spec, PRE_NS, jobs)
+                        return True
+                return False
+
+            if pre_first and probe_pre():
+                return "executed"
             if "map" in self.phases:
                 preferred = self._affinity if iteration > 1 else None
                 steal = not preferred or self._idle_count >= MAX_IDLE_COUNT
@@ -285,20 +323,8 @@ class Worker:
                     self._idle_count = 0
                     self._execute_batch(spec, MAP_NS, jobs)
                     return "executed"
-            # eager pre-merge rides INSIDE the map phase (pipelined
-            # shuffle): reduce-side consolidation of committed runs, so
-            # it sits behind the same phase filter as reduce jobs —
-            # map-capable workers pick it up only when no map job is
-            # claimable (map progress stays the priority). The task-doc
-            # marker gates the probe: barrier-mode tasks never pay the
-            # extra pre_jobs claim round-trip per idle poll
-            if "reduce" in self.phases and task.get("pipeline"):
-                jobs = self.store.claim_batch(
-                    PRE_NS, self.name, self._effective_k(PRE_NS, task))
-                if jobs:
-                    self._idle_count = 0
-                    self._execute_batch(spec, PRE_NS, jobs)
-                    return "executed"
+            if not pre_first and probe_pre():
+                return "executed"
             # speculative duplicate leases (DESIGN §21): only a worker
             # with NOTHING claimable reaches here, so clones never
             # steal capacity from unstarted jobs. Gated on the task-doc
@@ -476,12 +502,41 @@ class Worker:
         return int(self.replication if self.replication is not None
                    else (self._task_replication or 1))
 
+    def _push_on(self) -> bool:
+        """Whether this worker publishes map output through the push
+        shuffle (DESIGN §24): its own override, else the task
+        document's fleet marker, else off."""
+        if self.push is not None:
+            return bool(self.push)
+        return bool(self._task_push)
+
+    def _push_pool(self):
+        """This worker's memory-budgeted push buffer pool, minted
+        lazily (one pool per worker — the budget bounds what THIS
+        loop's map bodies may hold in unpublished frames)."""
+        if self._push_pool_obj is None:
+            from lua_mapreduce_tpu.engine.push import (BufferPool,
+                                                       resolve_push_budget)
+            self._push_pool_obj = BufferPool(
+                resolve_push_budget(self.push_budget_mb))
+        return self._push_pool_obj
+
     def _map_body(self, spec: TaskSpec, job: dict):
         store = get_storage_from(spec.storage)
+        push_on = self._push_on()
+        lineage = None
+        if push_on and job.get("speculative"):
+            # a clone's pushes are QUARANTINED under its spec identity
+            # until its commit wins (run_one promotes; DESIGN §24)
+            from lua_mapreduce_tpu.engine.push import lineage_token
+            lineage = lineage_token(self.name)
         return run_map_job(spec, store, str(job["_id"]), job["key"],
                            job["value"],
                            segment_format=self._segment_format(),
-                           replication=self._replication())
+                           replication=self._replication(),
+                           push=push_on,
+                           push_pool=self._push_pool() if push_on else None,
+                           spec_lineage=lineage)
 
     def _premerge_body(self, spec: TaskSpec, job: dict):
         """Consolidate committed runs into a spill (pipelined shuffle).
@@ -735,6 +790,23 @@ class Worker:
         committed = self.store.commit_batch(ns, self.name,
                                             [(jid, _times_dict(times))])
         if committed:
+            if ns == MAP_NS and self._push_on():
+                # first-commit-wins decided: THIS clone's quarantined
+                # inbox lineage becomes the visible one (DESIGN §24).
+                # Best-effort — the server's ensure_canonical backstop
+                # promotes any complete spec lineage behind a WRITTEN
+                # job whose promoter died right here.
+                try:
+                    from lua_mapreduce_tpu.engine.job import map_key_str
+                    from lua_mapreduce_tpu.engine.push import (
+                        lineage_token, promote)
+                    promote(get_storage_from(spec.storage),
+                            spec.result_ns, map_key_str(jid),
+                            lineage_token(self.name), self._replication())
+                except Exception as exc:
+                    _log.warning("[%s] push promote failed (%s: %s); "
+                                 "server backstop covers it", self.name,
+                                 type(exc).__name__, exc)
             self._notify("done")
             from lua_mapreduce_tpu.faults.retry import COUNTERS
             COUNTERS.bump("spec_wins")

@@ -76,6 +76,8 @@ COUNTER_FOLD = {
     "spec_wins": ("spec_wins",),
     "spec_cancelled": ("spec_cancelled",),
     "spec_wasted_s": ("spec_wasted_s",),
+    "push_frames": ("push_frames",),
+    "push_evictions": ("push_evictions",),
 }
 _FLOAT_COUNTERS = frozenset({"spec_wasted_s"})
 
@@ -133,6 +135,12 @@ class IterationStats:
     #                    original) spent on work that lost its commit
     #                    race (the duplicate-execution trade's cost
     #                    side; the bench's wasted-work fraction)
+    # push-shuffle accounting (DESIGN §24), same fold:
+    #   push_frames    — inbox frame files published by pushing maps
+    #   push_evictions — partition buffers evicted to the staged tail
+    #                    path under memory-budget pressure (the
+    #                    degrade-to-staged rung; >0 proves a budgeted
+    #                    run survived via eviction, not OOM)
     store_retries: int = 0
     store_faults: int = 0
     infra_releases: int = 0
@@ -145,6 +153,8 @@ class IterationStats:
     spec_wins: int = 0
     spec_cancelled: int = 0
     spec_wasted_s: float = 0.0
+    push_frames: int = 0
+    push_evictions: int = 0
 
     def fold_fault_counters(self, delta: Dict[str, float]
                             ) -> "IterationStats":
@@ -191,6 +201,8 @@ class IterationStats:
             "spec_wins": self.spec_wins,
             "spec_cancelled": self.spec_cancelled,
             "spec_wasted_s": self.spec_wasted_s,
+            "push_frames": self.push_frames,
+            "push_evictions": self.push_evictions,
             "cluster_time": self.cluster_time,
             "wall_time": self.wall_time,
         }

@@ -54,11 +54,25 @@ echo "trace smoke: spans collected, exports valid, bytes unchanged"
 python -m pytest tests/test_sched.py -q \
     -k "conformance or starvation or notify_off or wakes"
 echo "sched smoke: wakeups fire, lost notifies degrade, fairness holds"
+# push smoke gate (DESIGN §24): the streaming-shuffle golden matrix
+# (push off AND on, byte-identical), the memory-budget eviction
+# regression, the quarantine/promote manifest gate, and the parsed-
+# footer cache regression; plus the push chaos legs (seeded faults,
+# one placement tag dark during the push, SIGKILL a pushing mapper
+# mid-frame covered by a zero-charge speculation clone)
+python -m pytest tests/test_push.py -q
+python -m pytest tests/test_chaos.py -q -k "push"
+echo "push smoke: golden matrix identical, eviction degrades, chaos held"
+# external-sort smoke leg: a tiny CloudSort-shaped end-to-end sort —
+# push vs staged byte-identical, globally sorted, frames actually
+# pushed (the full GB-scale artifact is benchmarks/results/sort.json)
+python benchmarks/sort_bench.py --smoke
 # lmr-analyze gate: the framework-aware lint pass must be clean against
 # the checked-in suppression baseline (analysis/baseline.json — shipped
 # EMPTY; LMR009 keeps every engine spill publish on the replication
 # helper, LMR010 keeps trace/ timing on the injectable clock, LMR011
-# keeps every coord/engine wait on the sched Waiter), and the
+# keeps every coord/engine wait on the sched Waiter, LMR012 keeps
+# every inbox/manifest publish on spill_writer), and the
 # lease-protocol model checker must exhaustively pass
 # the 2-worker lifecycle (worker death included), the replica-recovery
 # (reconstruct-vs-requeue) edge, the speculation (duplicate-lease /

@@ -469,6 +469,59 @@ def test_lmr011_scoped_to_coord_engine(tmp_path):
     assert all(f.rule != "LMR011" for f in got)
 
 
+# --- LMR012 inbox publishes through spill_writer -----------------------------
+
+def test_lmr012_raw_builder_inbox_publish_flagged(tmp_path):
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        def publish_frame(store, ns, key, seq):
+            b = store.builder()
+            try:
+                b.write_bytes(b"JSEG0001")
+                b.build(f"{ns}.P0.INBOX-{key}-{seq:05d}")
+            finally:
+                b.close()
+
+        def publish_manifest(store, ns, key, payload):
+            with store.builder() as b:
+                b.write(payload)
+                b.build(f"{ns}.PUSH.M{key}")
+        """)
+    assert [f.rule for f in got
+            if f.rule == "LMR012"] == ["LMR012", "LMR012"]
+    assert "spill_writer" in [f for f in got
+                              if f.rule == "LMR012"][0].message
+
+
+def test_lmr012_spill_writer_and_other_names_pass(tmp_path):
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        from lua_mapreduce_tpu.faults.replicate import spill_writer
+
+        def publish_frame(store, ns, key, seq, r):
+            w = spill_writer(store, "v2", r)
+            try:
+                w.add_line("k", '["k",[1]]')
+                w.build(f"{ns}.P0.INBOX-{key}-{seq:05d}")
+            finally:
+                w.close()
+
+        def publish_result(store, name):
+            # non-push names through a plain builder stay legal:
+            # results are deliberately unreplicated
+            with store.builder() as b:
+                b.write("x")
+                b.build(f"{name}.P0")
+        """)
+    assert all(f.rule != "LMR012" for f in got)
+    # the rule scopes to engine/: a test harness building fixture
+    # inbox files directly is out of scope
+    got = _lint_snippet(tmp_path, "store/fx.py", """\
+        def fixture(store):
+            with store.builder() as b:
+                b.build("r.P0.INBOX-1-00000")
+        """)
+    assert all(f.rule != "LMR012" for f in got)
+
+
 # --- LMR007 jax purity -----------------------------------------------------
 
 def test_lmr007_impure_traced_functions_flagged(tmp_path):
@@ -550,7 +603,8 @@ def test_shipped_baseline_is_empty():
 def test_rule_catalog_complete():
     rules = lint_mod.all_rules()
     assert [r.id for r in rules] == \
-        [f"LMR00{i}" for i in range(1, 10)] + ["LMR010", "LMR011"]
+        [f"LMR00{i}" for i in range(1, 10)] + ["LMR010", "LMR011",
+                                              "LMR012"]
     for r in rules:
         assert r.title and r.rationale and r.severity in ("error", "warning")
 

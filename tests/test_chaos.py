@@ -107,7 +107,8 @@ def _plan(seed, heavy=False):
                      latency_ms=1.0, max_per_key=2)
 
 
-def _run_local(tmp_path, backend, pipeline, tag, plan=None, replication=1):
+def _run_local(tmp_path, backend, pipeline, tag, plan=None, replication=1,
+               push=False, push_budget_mb=None):
     _install_module()
     spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
                     reducefn=_MOD,
@@ -117,19 +118,20 @@ def _run_local(tmp_path, backend, pipeline, tag, plan=None, replication=1):
         ex = LocalExecutor(spec, map_parallelism=3, pipeline=pipeline,
                            premerge_min_runs=2,
                            segment_format="v2" if pipeline else "v1",
-                           replication=replication)
+                           replication=replication, push=push,
+                           push_budget_mb=push_budget_mb)
         stats = ex.run()
     finally:
         install_fault_plan(None)
     got = {k: v[0] for k, v in ex.results()}
     assert got == GOLDEN
     return _result_bytes(spec.storage,
-                         only_results=replication > 1), stats
+                         only_results=replication > 1 or push), stats
 
 
 def _run_distributed(tmp_path, backend, pipeline, tag, plan=None,
                      n_workers=2, replication=1, speculation=0.0,
-                     straggler=False, batch_k=2):
+                     straggler=False, batch_k=2, push=False):
     _install_module()
     spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
                     reducefn=_MOD,
@@ -141,7 +143,7 @@ def _run_distributed(tmp_path, backend, pipeline, tag, plan=None,
                         premerge_min_runs=2, batch_k=batch_k,
                         segment_format="v2" if pipeline else "v1",
                         replication=replication,
-                        speculation=speculation).configure(spec)
+                        speculation=speculation, push=push).configure(spec)
         # ``straggler`` names the LAST worker "straggler-0" (the slow
         # FaultPlan kind routes by worker name) and gives it a head
         # start so it deterministically holds at least one lease
@@ -196,7 +198,7 @@ def _run_distributed(tmp_path, backend, pipeline, tag, plan=None,
     # lands nowhere), exactly like replica-kill legs leave dead copies
     return _result_bytes(spec.storage,
                          only_results=replication > 1
-                         or speculation > 0), stats
+                         or speculation > 0 or push), stats
 
 
 def _wait_for_claim(store, timeout=30.0):
@@ -562,3 +564,240 @@ def test_replication_total_loss_single_dual_phase_worker(tmp_path):
     assert _result_bytes(spec.storage, only_results=True) == clean
     it = final["stats"].iterations[-1]
     assert it.map_reruns >= len(victims)
+
+
+# --- push-shuffle legs (DESIGN §24) ------------------------------------------
+#
+# The ISSUE 12 chaos gate: the streaming shuffle under the same storms
+# as the staged plane — seeded transient faults, a whole placement tag
+# dark during the push, a SIGKILLed mapper mid-frame covered by a
+# speculation clone, and the quarantine rule (a clone's inbox lineage
+# must never become visible once the original's commit wins).
+
+def test_push_chaos_smoke_faultplan(tmp_path):
+    """Seeded transient/latency/error-after-write faults on a push run:
+    invisible in the bytes (vs the fault-free STAGED twin — one oracle
+    covers both mode equivalence and fault absorption)."""
+    clean, _ = _run_local(tmp_path, "mem", False, "push-sm-c")
+    plan = _plan(seed=211)
+    chaotic, stats = _run_local(tmp_path, "mem", False, "push-sm-f",
+                                plan=plan, push=True)
+    assert chaotic == clean
+    assert plan.total_fired() > 0
+    assert stats.iterations[-1].push_frames > 0
+
+
+def test_push_chaos_blackout_tag(tmp_path):
+    """One placement tag dark for the whole run while frames are being
+    pushed (fragments, tails, manifests AND their replica copies on the
+    dark tag): r=2 failover serves every read — byte-identical output,
+    zero map re-runs."""
+    from lua_mapreduce_tpu.engine.placement import replica_pattern
+
+    clean, _ = _run_local(tmp_path, "mem", True, "push-bo-c")
+    shuffle = ["result.P[0-9]*.M*", "result.P[0-9]*.SPILL-*",
+               "result.P[0-9]*.INBOX-*", "result.PUSH.M*"]
+    plan = FaultPlan(223, blackout_tag=5, blackout_s=3600.0,
+                     pattern="|".join(shuffle
+                                      + [replica_pattern(p)
+                                         for p in shuffle]),
+                     latency_ms=0)
+    chaotic, stats = _run_local(tmp_path, "mem", True, "push-bo-f",
+                                plan=plan, push=True, replication=2)
+    assert chaotic == clean
+    assert plan.fired.get("blackout", 0) > 0, "the dark tag was never hit"
+    it = stats.iterations[-1]
+    assert it.push_frames > 0
+    assert it.map_reruns == 0
+
+
+def test_push_chaos_spec_straggler_quarantine(tmp_path):
+    """Slow-plan straggler with speculation on a PUSH run: clones race
+    the straggler's maps, first-commit-wins decides each visible inbox
+    lineage, output stays byte-identical with zero repetition charges
+    — and no quarantined (spec-tagged) fragment survives outside its
+    winning lineage."""
+    clean, _ = _run_distributed(tmp_path, "mem", True, "push-spec-c")
+    plan = _slow_plan(227)
+    chaotic, stats = _run_distributed(
+        tmp_path, "mem", True, "push-spec-f", plan=plan, n_workers=3,
+        speculation=3.0, straggler=True, batch_k=1, push=True)
+    assert chaotic == clean, "push speculation leg output differs"
+    assert plan.fired.get("slow", 0) > 0
+    it = stats.iterations[-1]
+    assert it.spec_wins >= 1, "no clone ever won the commit race"
+    assert it.push_frames > 0
+    # quarantine: every spec-tagged fragment left behind must belong to
+    # a lineage that became canonical (a loser's inbox is swept or was
+    # never referenced) — no reduce consumed a quarantined lineage, or
+    # the byte-compare above would already have failed
+    from lua_mapreduce_tpu.engine.push import (manifest_name,
+                                               parse_inbox_name,
+                                               read_manifest)
+    store = get_storage_from(
+        _storage(tmp_path, "mem", "push-spec-f"))
+    for name in store.list("result.P*.INBOX-*"):
+        parsed = parse_inbox_name("result", name)
+        assert parsed is not None
+        part, key, lineage, _seq, _tail = parsed
+        if lineage is None:
+            continue
+        man = read_manifest(store, manifest_name("result", key))
+        assert man is not None and man.get("lineage") == lineage, \
+            f"quarantined fragment {name} visible outside its lineage"
+
+
+def test_push_chaos_sigkill_pusher_midframe(tmp_path):
+    """SIGKILL a pushing mapper mid-frame (a real subprocess worker,
+    slowed by the plan so it is verifiably mid-push when killed) with
+    speculation on and the stale-requeue DISABLED: only a clone's
+    first-commit-wins coverage can finish the job, so completion with
+    zero repetition charges is load-bearing, not luck. The victim's
+    partial inbox (frames with no manifest) stays invisible and is
+    swept; output is byte-identical to the fault-free staged twin."""
+    import json as _json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from lua_mapreduce_tpu.coord.filestore import FileJobStore
+
+    clean, _ = _run_local(tmp_path, "mem", False, "push-kill-c")
+
+    _install_module()
+    # the distributed fleet round-trips user modules by import path:
+    # install the same wordcount as a real module file the subprocess
+    # can import
+    moddir = tmp_path / "mods"
+    moddir.mkdir()
+    (moddir / "pushkill_wc.py").write_text(
+        "CORPUS = " + repr(CORPUS) + "\n"
+        "def taskfn(emit):\n"
+        "    for k, v in sorted(CORPUS.items()): emit(k, v)\n"
+        "def mapfn(key, value, emit):\n"
+        "    for w in value.split(): emit(w, 1)\n"
+        "def partitionfn(key):\n"
+        "    return sum(key.encode()) % 4\n"
+        "def reducefn(key, values):\n"
+        "    return sum(values)\n")
+    coord = tmp_path / "kill-coord"
+    spill = tmp_path / "kill-spill"
+    import sys as _sys
+    _sys.path.insert(0, str(moddir))
+    try:
+        spec = TaskSpec(taskfn="pushkill_wc", mapfn="pushkill_wc",
+                        partitionfn="pushkill_wc", reducefn="pushkill_wc",
+                        storage=f"shared:{spill}")
+        plan = FaultPlan(229, slow_worker="victim-*", slow_ms=250.0,
+                         slow_s=3600.0)
+        env = dict(os.environ,
+                   PYTHONPATH=f"{moddir}:{os.environ.get('PYTHONPATH', '')}",
+                   LMR_FAULT_PLAN=plan.to_spec(),
+                   JAX_PLATFORMS="cpu")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+        def spawn(name):
+            code = (
+                "import sys\n"
+                f"sys.path.insert(0, {repo!r})\n"
+                f"sys.path.insert(0, {str(moddir)!r})\n"
+                "from lua_mapreduce_tpu import FileJobStore, Worker\n"
+                f"w = Worker(FileJobStore({str(coord)!r}), name={name!r})\n"
+                "w.configure(max_iter=100000, max_sleep=0.05,\n"
+                "            max_tasks=1, heartbeat_s=0.25)\n"
+                "w.execute()\n")
+            return subprocess.Popen([sys.executable, "-c", code], env=env)
+
+        victim = spawn("victim-0")
+        store = FileJobStore(str(coord))
+        server = Server(store, poll_interval=0.05, push=True,
+                        stale_timeout_s=None,   # ONLY speculation saves it
+                        speculation=2.0, batch_k=1).configure(spec)
+        final = {}
+        st = threading.Thread(
+            target=lambda: final.setdefault("stats", server.loop()),
+            daemon=True)
+        st.start()
+        # head start: the victim must HOLD a lease before the healthy
+        # fleet exists, or the un-slowed workers drain the tiny job set
+        # before the slowed victim ever claims
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                if any(d["status"] == Status.RUNNING
+                       and d.get("worker") == "victim-0"
+                       for d in store.jobs(MAP_NS)):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.05)
+        else:
+            raise AssertionError("victim never claimed a lease")
+        healthy = [spawn(f"healthy-{i}") for i in range(2)]
+
+        # kill the victim the moment it is verifiably MID-PUSH: a
+        # frame of one of its claimed jobs landed, more output pending
+        deadline = time.time() + 90
+        killed = False
+        while time.time() < deadline and not killed:
+            frags = []
+            if spill.exists():
+                frags = [f for f in os.listdir(spill)
+                         if ".INBOX-" in f]
+            if frags:
+                try:
+                    # the victim must HOLD a live lease right now — the
+                    # claim log alone also lists already-committed
+                    # claims, and killing after its last commit would
+                    # prove nothing
+                    running = [d for d in store.jobs(MAP_NS)
+                               if d["status"] == Status.RUNNING
+                               and d.get("worker") == "victim-0"]
+                except Exception:
+                    running = []
+                # ... and be verifiably MID-FRAME: a frame of one of
+                # ITS running jobs already landed, its manifest/commit
+                # have not (it is still RUNNING)
+                from lua_mapreduce_tpu.engine.job import map_key_str
+                keys = {map_key_str(d["_id"]) for d in running}
+                mid_frame = any(f".INBOX-{k}-" in f
+                                for k in keys for f in frags)
+                if mid_frame:
+                    victim.send_signal(signal.SIGKILL)
+                    killed = True
+                    break
+            time.sleep(0.05)
+        assert killed, "victim never got mid-push before the deadline"
+
+        st.join(timeout=120)
+        assert not st.is_alive(), \
+            "server wedged after the pusher was SIGKILLed"
+        for p in healthy:
+            try:
+                p.wait(timeout=30)
+            except Exception:
+                p.kill()
+        victim.wait(timeout=10)
+        stats = final["stats"]
+    finally:
+        _sys.path.remove(str(moddir))
+
+    got = {}
+    from lua_mapreduce_tpu.engine.local import iter_results as _ir
+    for k, v in _ir(get_storage_from(spec.storage), "result"):
+        got[k] = v[0]
+    assert got == GOLDEN
+    assert _result_bytes(spec.storage, only_results=True) == clean
+    # zero repetition charges: with the stale requeue off, only the
+    # clone's zero-charge coverage can have finished the victim's job
+    for d in store.jobs(MAP_NS):
+        assert d["repetitions"] == 0, \
+            f"SIGKILL charged a repetition: map job {d['_id']}"
+    # spec_wins is counted in the CLONE's process (a subprocess here);
+    # the server-side proof is the detector having opened the shadow
+    # lease — with the stale requeue off and zero repetitions, nothing
+    # else can have finished the victim's job
+    it = stats.iterations[-1]
+    assert it.spec_launched >= 1, "detector never opened a shadow lease"

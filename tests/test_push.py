@@ -1,0 +1,449 @@
+"""Push-shuffle suite (DESIGN §24): knob resolution, the golden matrix
+with push off AND on across {mem,shared,object} × {barrier,pipelined}
+on both executors, the memory-budget eviction regression, manifest
+gating (quarantine / promote / backstop), mixed push-on/off fleets, and
+the SegmentReader parsed-footer cache regression."""
+
+import re
+import threading
+
+import pytest
+
+from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+from lua_mapreduce_tpu.engine import push as push_mod
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.local import LocalExecutor, iter_results
+from lua_mapreduce_tpu.engine.server import Server
+from lua_mapreduce_tpu.engine.worker import MAP_NS, Worker
+from lua_mapreduce_tpu.store.router import get_storage_from
+
+CORPUS = {
+    f"doc{i}": " ".join(f"w{(i * 11 + j) % 29}" for j in range(48))
+    for i in range(8)
+}
+GOLDEN = {}
+for _text in CORPUS.values():
+    for _w in _text.split():
+        GOLDEN[_w] = GOLDEN.get(_w, 0) + 1
+
+_MOD = "tests._push_wc"
+
+
+def _install_module():
+    import sys
+    import types
+
+    mod = sys.modules.get(_MOD)
+    if mod is None:
+        mod = types.ModuleType(_MOD)
+
+        def taskfn(emit):
+            for k, v in sorted(CORPUS.items()):
+                emit(k, v)
+
+        def mapfn(key, value, emit):
+            for w in value.split():
+                emit(w, 1)
+
+        mod.taskfn = taskfn
+        mod.mapfn = mapfn
+        mod.partitionfn = lambda key: sum(key.encode()) % 4
+        mod.reducefn = lambda key, values: sum(values)
+        sys.modules[_MOD] = mod
+    return mod
+
+
+def _storage(tmp_path, backend, tag):
+    return {"mem": f"mem:{tag}",
+            "shared": f"shared:{tmp_path}/shared-{tag}",
+            "object": f"object:{tmp_path}/object-{tag}"}[backend]
+
+
+def _result_bytes(storage_spec, ns="result"):
+    store = get_storage_from(storage_spec)
+    keep = re.compile(rf"^{re.escape(ns)}\.P\d+$")
+    return {n: "".join(store.lines(n)) for n in store.list(f"{ns}.P*")
+            if keep.match(n)}
+
+
+def _run_local(tmp_path, backend, pipeline, tag, push=False,
+               budget_mb=None, replication=1):
+    _install_module()
+    spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
+                    reducefn=_MOD,
+                    storage=_storage(tmp_path, backend, tag))
+    ex = LocalExecutor(spec, map_parallelism=3, pipeline=pipeline,
+                       premerge_min_runs=2, push=push,
+                       push_budget_mb=budget_mb, replication=replication)
+    stats = ex.run()
+    got = {k: v[0] for k, v in ex.results()}
+    assert got == GOLDEN
+    return _result_bytes(spec.storage), stats
+
+
+# --- knob resolution ---------------------------------------------------------
+
+def test_resolve_push_env_roundtrip(monkeypatch):
+    assert push_mod.resolve_push(True) is True
+    assert push_mod.resolve_push(None) is False
+    monkeypatch.setenv("LMR_PUSH", "1")
+    assert push_mod.resolve_push(None) is True
+    monkeypatch.setenv("LMR_PUSH", "off")
+    assert push_mod.resolve_push(None) is False
+    monkeypatch.setenv("LMR_PUSH_BUDGET_MB", "2.5")
+    assert push_mod.resolve_push_budget(None) == int(2.5 * 1024 * 1024)
+    assert push_mod.resolve_push_budget(1) == 1024 * 1024
+    monkeypatch.delenv("LMR_PUSH_BUDGET_MB")
+    assert push_mod.resolve_push_budget(None) == \
+        int(push_mod.DEFAULT_BUDGET_MB * 1024 * 1024)
+
+
+def test_cli_parsers_accept_push_knobs():
+    from lua_mapreduce_tpu.cli.execute_server import \
+        build_parser as server_parser
+    from lua_mapreduce_tpu.cli.execute_worker import \
+        build_parser as worker_parser
+    s = server_parser().parse_args(
+        ["coord", "t", "m", "p", "r", "--push", "--push-budget-mb", "16"])
+    assert s.push is True and s.push_budget_mb == 16.0
+    s = server_parser().parse_args(["coord", "t", "m", "p", "r"])
+    assert s.push is None            # None = LMR_PUSH env resolution
+    w = worker_parser().parse_args(
+        ["coord", "--push", "--push-budget-mb", "8"])
+    assert w.push is True and w.push_budget_mb == 8.0
+
+
+def test_worker_config_keys():
+    w = Worker(MemJobStore(), name="push-cfg")
+    w.configure(push=True, push_budget_mb=4.0)
+    assert w._push_on() is True
+    assert w._push_pool().budget == 4 * 1024 * 1024
+    # unset worker follows the task document's fleet marker
+    w2 = Worker(MemJobStore(), name="push-cfg2")
+    assert w2._push_on() is False
+    w2._task_push = True
+    assert w2._push_on() is True
+
+
+# --- the golden matrix: push off AND on, byte-identical ----------------------
+
+@pytest.mark.parametrize("pipeline", [False, True],
+                         ids=["barrier", "pipelined"])
+@pytest.mark.parametrize("backend", ["mem", "shared", "object"])
+def test_push_golden_matrix_local(tmp_path, backend, pipeline):
+    tag = f"pg-{backend}-{int(pipeline)}"
+    off, _ = _run_local(tmp_path, backend, pipeline, tag + "-off")
+    on, stats = _run_local(tmp_path, backend, pipeline, tag + "-on",
+                           push=True)
+    assert on == off, "push-on output differs from the staged path"
+    assert stats.iterations[-1].push_frames > 0
+
+
+def test_push_golden_replicated(tmp_path):
+    # pushed frames ride the replication plane: r=2 stays byte-identical
+    off, _ = _run_local(tmp_path, "mem", True, "pr-off")
+    on, stats = _run_local(tmp_path, "mem", True, "pr-on", push=True,
+                           replication=2)
+    assert on == off
+    assert stats.iterations[-1].push_frames > 0
+
+
+def test_push_distributed_task_doc_deploy(tmp_path):
+    """Server(push=True) deploys the marker through the task doc: stock
+    workers follow it, output byte-identical to the staged twin, and
+    the in-process pool's IterationStats carries the frame count."""
+    _install_module()
+    clean, _ = _run_local(tmp_path, "mem", False, "pd-off")
+    spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
+                    reducefn=_MOD, storage=_storage(tmp_path, "mem", "pd-on"))
+    store = MemJobStore()
+    server = Server(store, poll_interval=0.01, pipeline=True,
+                    premerge_min_runs=2, batch_k=2,
+                    push=True).configure(spec)
+    workers = [Worker(store).configure(max_iter=800, max_sleep=0.02)
+               for _ in range(2)]
+    threads = [threading.Thread(target=w.execute, daemon=True)
+               for w in workers]
+    for t in threads:
+        t.start()
+    stats = server.loop()
+    for t in threads:
+        t.join(timeout=30)
+    got = {k: v[0] for k, v in iter_results(
+        get_storage_from(spec.storage), "result")}
+    assert got == GOLDEN
+    assert _result_bytes(spec.storage) == clean
+    it = stats.iterations[-1]
+    assert it.push_frames > 0
+    assert it.map.failed == 0 and it.reduce.failed == 0
+
+
+def test_push_mixed_fleet(tmp_path):
+    """One worker pinned push=False (a push-off fleet member) while the
+    fleet default is push: manifested maps and classic runs interleave
+    in canonical order — output stays byte-identical."""
+    _install_module()
+    clean, _ = _run_local(tmp_path, "mem", False, "mix-off")
+    spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
+                    reducefn=_MOD,
+                    storage=_storage(tmp_path, "mem", "mix-on"))
+    store = MemJobStore()
+    server = Server(store, poll_interval=0.01, push=True,
+                    batch_k=2).configure(spec)
+    pusher = Worker(store, name="pusher").configure(max_iter=800,
+                                                    max_sleep=0.02)
+    classic = Worker(store, name="classic").configure(
+        max_iter=800, max_sleep=0.02, push=False)
+    threads = [threading.Thread(target=w.execute, daemon=True)
+               for w in (pusher, classic)]
+    for t in threads:
+        t.start()
+    server.loop()
+    for t in threads:
+        t.join(timeout=30)
+    got = {k: v[0] for k, v in iter_results(
+        get_storage_from(spec.storage), "result")}
+    assert got == GOLDEN
+    assert _result_bytes(spec.storage) == clean
+
+
+# --- satellite: memory-budget eviction regression ----------------------------
+
+def test_push_budget_eviction_regression(tmp_path):
+    """A push run with the budget far below the working set must
+    complete via eviction-to-staged — ``push_evictions > 0`` in
+    IterationStats — with byte-identical output (the degrade-to-staged
+    rung, never an OOM or a failure)."""
+    off, _ = _run_local(tmp_path, "mem", True, "bud-off")
+    on, stats = _run_local(tmp_path, "mem", True, "bud-on", push=True,
+                           budget_mb=0.0001)   # ~100 bytes: constant
+    assert on == off
+    it = stats.iterations[-1]
+    assert it.push_evictions > 0, \
+        "budget below working set must evict, not buffer"
+
+
+def test_buffer_pool_accounting():
+    pool = push_mod.BufferPool(1000)
+    pool.charge(600)
+    assert not pool.over()
+    pool.charge(600)
+    assert pool.over() and pool.held == 1200
+    pool.uncharge(900)
+    assert pool.held == 300 and not pool.over()
+    pool.uncharge(10_000)
+    assert pool.held == 0              # floor at zero, never negative
+
+
+# --- manifest gate: quarantine / promote / backstop --------------------------
+
+def test_spec_lineage_quarantined_until_promoted():
+    """A clone's pushes stay invisible — spec-tagged fragments + a spec
+    manifest — until promote(); promote is publish-if-absent, so a
+    canonical lineage published by the original always wins."""
+    from lua_mapreduce_tpu.store.memfs import MemStore
+    store = MemStore()
+    ns, key = "result", "00000003"
+    # original execution: canonical lineage
+    orig = push_mod.PushWriter(store, ns, key,
+                               pool=push_mod.BufferPool(1 << 20))
+    orig.add(0, "a", [1])
+    orig.add(1, "b", [2])
+    orig.finish()
+    orig.close()
+    # clone execution: different fragmentation, quarantined
+    lin = push_mod.lineage_token("clone-worker")
+    clone = push_mod.PushWriter(store, ns, key,
+                                pool=push_mod.BufferPool(0),
+                                lineage=lin)
+    clone.add(0, "a", [1])
+    clone.add(1, "b", [2])
+    clone.finish()
+    clone.close()
+    man = push_mod.read_manifest(store, push_mod.manifest_name(ns, key))
+    assert man["lineage"] == ""        # the original's lineage is visible
+    # every visible file is canonical; the clone's files carry its tag
+    visible = {f for files in
+               push_mod.manifest_files_by_part(man).values()
+               for f in files}
+    assert all(f"-s{lin}" not in f for f in visible)
+    # the original committed: promote must NOT flip the manifest
+    assert push_mod.promote(store, ns, key, lin, 1) is False
+    assert push_mod.read_manifest(
+        store, push_mod.manifest_name(ns, key)) == man
+    # discovery sweeps the losing clone's quarantined files
+    parts = push_mod.discover_push(store, ns, [key])
+    assert all(f"-s{lin}" not in f for files in parts.values()
+               for f in files)
+    leftover = [n for n in store.list(f"{ns}.P*.INBOX-*")
+                if f"-s{lin}" in n]
+    assert leftover == [], "losing clone's inbox must be swept"
+
+
+def test_promote_gap_backstop():
+    """The winning-clone-died-pre-promote gap: job committed, canonical
+    manifest absent, spec manifest complete — ensure_canonical promotes
+    it (deterministically) so the tracker/discovery never stall."""
+    from lua_mapreduce_tpu.store.memfs import MemStore
+    store = MemStore()
+    ns, key = "result", "00000009"
+    lin = push_mod.lineage_token("dead-winner")
+    clone = push_mod.PushWriter(store, ns, key,
+                                pool=push_mod.BufferPool(1 << 20),
+                                lineage=lin)
+    clone.add(0, "k", [1])
+    clone.finish()
+    clone.close()
+    assert push_mod.read_manifest(
+        store, push_mod.manifest_name(ns, key)) is None
+    man = push_mod.ensure_canonical(store, ns, key, 1)
+    assert man is not None and man["lineage"] == lin
+    assert store.exists(push_mod.manifest_name(ns, key))
+    # idempotent: a second resolution reads the promoted canonical
+    assert push_mod.ensure_canonical(store, ns, key, 1) == man
+
+
+def test_backstop_never_promotes_dangling_lineage():
+    """A losing clone's stale ``.s`` manifest whose fragments were
+    already swept must NOT be backstop-promoted after the scavenger
+    invalidates the canonical manifest — promoting a dangling lineage
+    would wedge recovery on files nobody can regenerate under those
+    names."""
+    from lua_mapreduce_tpu.store.memfs import MemStore
+    store = MemStore()
+    ns, key = "result", "00000011"
+    lin = push_mod.lineage_token("losing-clone")
+    clone = push_mod.PushWriter(store, ns, key,
+                                pool=push_mod.BufferPool(1 << 20),
+                                lineage=lin)
+    clone.add(0, "k", [1])
+    clone.finish()
+    clone.close()
+    # sweep the quarantined fragments (discovery's job), keep the stale
+    # spec manifest, and leave no canonical (scavenger invalidated it)
+    for n in store.list(f"{ns}.P*.INBOX-*"):
+        store.remove(n)
+    assert push_mod.ensure_canonical(store, ns, key, 1) is None
+    assert not store.exists(push_mod.manifest_name(ns, key))
+    # sweep_unreferenced drops a loser's .s manifest once a DIFFERENT
+    # lineage is canonical (keeping the promote-gap case covered)
+    orig = push_mod.PushWriter(store, ns, key,
+                               pool=push_mod.BufferPool(1 << 20))
+    orig.add(0, "k", [1])
+    orig.finish()
+    orig.close()
+    _, referenced = push_mod.push_file_lists(store, ns, [key])
+    push_mod.sweep_unreferenced(store, ns, referenced, [key])
+    assert not store.exists(push_mod.manifest_name(ns, key, lin))
+
+
+# --- satellite: SegmentReader parsed-footer cache ----------------------------
+
+class _CountingStore:
+    """Store wrapper counting read_range calls (duck-typed: only the
+    surface SegmentReader touches)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.reads = 0
+
+    def read_range(self, name, off, length):
+        self.reads += 1
+        return self._inner.read_range(name, off, length)
+
+    def size(self, name):
+        return self._inner.size(name)
+
+
+def test_footer_cache_saves_repeat_open_reads():
+    """Re-opening a segment must hit the per-store parsed-footer cache:
+    the trailer + footer ranged reads are paid once per (name, size),
+    not once per SegmentReader — the incremental inbox merge's
+    open-per-consumer pattern would otherwise pay O(openings) footer
+    fetches. The saved reads are counted."""
+    from lua_mapreduce_tpu.core import segment
+    from lua_mapreduce_tpu.store.memfs import MemStore
+
+    inner = MemStore()
+    with segment.writer_for(inner, "v2") as w:
+        for i in range(100):
+            w.add(f"k{i:03d}", [i])
+        w.build("seg.P0.INBOX-1-00000")
+
+    counting = _CountingStore(inner)
+    r1 = segment.SegmentReader(counting, "seg.P0.INBOX-1-00000")
+    first_open = counting.reads
+    assert first_open >= 3            # magic + trailer + footer
+    saved0 = segment.FOOTER_READS_SAVED
+    r2 = segment.SegmentReader(counting, "seg.P0.INBOX-1-00000")
+    second_open = counting.reads - first_open
+    assert second_open == first_open - 2, \
+        "second open must skip exactly the trailer + footer reads"
+    assert segment.FOOTER_READS_SAVED == saved0 + 2
+    assert list(r2.iter_records()) == list(r1.iter_records())
+    # the cache keys on size: a same-name file of a different size
+    # (honest rewrite) re-reads its own footer
+    with segment.writer_for(inner, "v2") as w:
+        for i in range(7):
+            w.add(f"z{i}", [i])
+        w.build("seg.P0.INBOX-1-00000")
+    r3 = segment.SegmentReader(counting, "seg.P0.INBOX-1-00000")
+    assert [k for k, _ in r3.iter_records()] == [f"z{i}" for i in range(7)]
+
+
+def test_footer_cache_purged_on_iteration_rollover():
+    """Loop tasks reuse run/fragment names with NEW contents, and
+    fixed-width records can reproduce the exact byte size — the
+    engines' iteration-start cleanup purges the cache so a same-size
+    rewrite can never serve a stale footer."""
+    from lua_mapreduce_tpu.core import segment
+    from lua_mapreduce_tpu.store.memfs import MemStore
+
+    store = MemStore()
+
+    def publish(keys):
+        with segment.writer_for(store, "v2") as w:
+            for k in keys:
+                w.add(k, [0])
+            w.build("r.P0.M00000001")
+
+    publish([f"a{i:03d}" for i in range(50)])
+    segment.SegmentReader(store, "r.P0.M00000001")      # cache fills
+    publish([f"b{i:03d}" for i in range(50)])           # same byte size
+    key = ("r.P0.M00000001", store.size("r.P0.M00000001"))
+    assert key in store._jseg_footers                   # stale entry live
+    segment.purge_footer_cache(store)                   # iteration hook
+    assert key not in store._jseg_footers
+    r = segment.SegmentReader(store, "r.P0.M00000001")
+    assert [k for k, _ in r.iter_records()] == \
+        [f"b{i:03d}" for i in range(50)]
+
+
+# --- resume stickiness -------------------------------------------------------
+
+def test_push_resume_sticky(tmp_path):
+    """A resumed task keeps its push mode from the task doc (like the
+    pipeline/replication rules): a crashed push run's data is visible
+    only through manifests, which a push-off resume would never
+    consult."""
+    _install_module()
+    spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
+                    reducefn=_MOD,
+                    storage=_storage(tmp_path, "mem", "resume"))
+    store = MemJobStore()
+    from lua_mapreduce_tpu.core.constants import TaskStatus
+    store.put_task({"_id": "unique", "status": TaskStatus.MAP.value,
+                    "iteration": 1, "spec": spec.describe(),
+                    "pipeline": False, "push": True, "batch_k": 1,
+                    "segment_format": "v1", "replication": 1,
+                    "speculation": 0.0})
+    server = Server(store, poll_interval=0.01, push=False).configure(spec)
+    w = Worker(store).configure(max_iter=800, max_sleep=0.02)
+    t = threading.Thread(target=w.execute, daemon=True)
+    t.start()
+    server.loop()
+    t.join(timeout=30)
+    assert server.push is True, "resume must keep the task doc's push mode"
+    got = {k: v[0] for k, v in iter_results(
+        get_storage_from(spec.storage), "result")}
+    assert got == GOLDEN
