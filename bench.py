@@ -440,12 +440,13 @@ def _sched_fields() -> dict:
 
 
 def _analysis_fields() -> dict:
-    """Detail fields for the analysis subsystem (DESIGN §18): the lint
-    pass's wall time over the whole package (it gates test.sh, so its
-    cost is part of the developer loop) and a small exhaustive
-    model-checker run (2 workers × 2 jobs, death included) with its
-    state count — the protocol-coverage figure. Never sinks the
-    flagship metric."""
+    """Detail fields for the analysis subsystem (DESIGN §18/§25): the
+    lint pass's wall time over the whole package (it gates test.sh, so
+    its cost is part of the developer loop), the interprocedural deep
+    pass's call-graph size (nodes/edges), context-reached function
+    count and wall time, and a small exhaustive model-checker run
+    (2 workers × 2 jobs, death included) with its state count — the
+    protocol-coverage figure. Never sinks the flagship metric."""
     import time as _t
     out = {}
     try:
@@ -456,6 +457,16 @@ def _analysis_fields() -> dict:
         out["analyze_lint_findings"] = len(findings)
     except Exception as e:
         out["analyze_lint_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        from lua_mapreduce_tpu.analysis import dataflow
+        res = dataflow.analyze()
+        out["analyze_callgraph_nodes"] = res.graph.node_count()
+        out["analyze_callgraph_edges"] = res.graph.edge_count()
+        out["analyze_deep_reached"] = res.reached
+        out["analyze_deep_findings"] = len(res.findings)
+        out["analyze_deep_wall_s"] = round(res.wall_s, 3)
+    except Exception as e:
+        out["analyze_deep_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         from lua_mapreduce_tpu.analysis import ModelConfig, check_protocol
         res = check_protocol(ModelConfig(n_workers=2, n_jobs=2))

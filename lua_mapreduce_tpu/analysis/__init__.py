@@ -24,13 +24,20 @@ scheduler cooperates.  This subsystem adds *checked invariants*:
 CLI: ``python -m lua_mapreduce_tpu.analysis`` (see ``--help``).
 """
 
-from lua_mapreduce_tpu.analysis.lint import (Finding, all_rules, format_text,
+from lua_mapreduce_tpu.analysis.callgraph import CallGraph, build_callgraph
+from lua_mapreduce_tpu.analysis.contracts import TaskReport, check_task
+from lua_mapreduce_tpu.analysis.dataflow import run_deep
+from lua_mapreduce_tpu.analysis.lint import (AuditReport, Finding, all_rules,
+                                             format_text, run_audit,
                                              run_lint)
 from lua_mapreduce_tpu.analysis.protocol import (LeaseModel, ModelConfig,
                                                  check_protocol, replay_trace)
 
 __all__ = [
-    "Finding", "run_lint", "all_rules", "format_text",
+    "Finding", "run_lint", "run_audit", "AuditReport", "all_rules",
+    "format_text",
+    "CallGraph", "build_callgraph", "run_deep",
+    "TaskReport", "check_task",
     "ModelConfig", "LeaseModel", "check_protocol", "replay_trace",
     "utest",
 ]
@@ -38,16 +45,29 @@ __all__ = [
 
 def utest() -> None:
     """Self-test: the lint engine finds a seeded fixture violation and
-    the repo's own package is lint-clean; the protocol model passes a
-    tiny exhaustive run and re-finds a seeded race."""
+    the repo's own package is lint-clean; the call graph resolves every
+    edge kind; each interprocedural rule re-finds its seeded
+    helper-indirection race and the package is deep-clean with no stale
+    suppressions; the contract checker classifies its fixtures; the
+    protocol model passes a tiny exhaustive run and re-finds a seeded
+    race."""
     import os
 
-    from lua_mapreduce_tpu.analysis import lint, protocol
+    from lua_mapreduce_tpu.analysis import (callgraph, contracts, dataflow,
+                                            lint, protocol, sarif)
 
     lint.utest()
+    callgraph.utest()
+    dataflow.utest()
+    contracts.utest()
+    sarif.utest()
     protocol.utest()
 
     pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = run_lint([pkg])
-    assert findings == [], (
-        "package must ship lint-clean:\n" + format_text(findings))
+    audit = run_audit([pkg])
+    assert audit.findings == [], (
+        "package must ship lint+deep clean:\n"
+        + format_text(audit.findings))
+    assert not audit.stale, (
+        "suppressions must not outlive the code they excused: "
+        f"{audit.stale_pragmas} {audit.stale_baseline}")

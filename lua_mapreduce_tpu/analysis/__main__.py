@@ -1,12 +1,24 @@
 """``lmr-analyze``: the analysis CLI.
 
-    python -m lua_mapreduce_tpu.analysis [lint|protocol|all] [options]
+    python -m lua_mapreduce_tpu.analysis \\
+        [lint|deep|protocol|task|rules|callgraph|all] [options]
 
-``lint`` runs the framework-aware rule registry over the package (or
-explicit paths); ``protocol`` exhaustively model-checks the lease
-lifecycle; ``all`` (the default) runs both.  Exit code 0 = clean; with
-``--fail-on-findings`` any surviving lint finding exits 1 (the CI
-gate); a protocol violation of the shipped model always exits 1.
+``lint`` runs the per-function rule registry over the package (or
+explicit paths); ``deep`` runs the interprocedural pass (call graph +
+context propagation, LMR013+); ``task <module>...`` statically
+validates user task modules (contract + determinism + lowerability
+verdict); ``protocol`` exhaustively model-checks the lease lifecycle;
+``callgraph`` prints the graph's size; ``all`` (the default) runs
+lint + deep + the stale-suppression audit + protocol.
+
+Exit code 0 = clean; with ``--fail-on-findings`` any surviving finding
+exits 1 (the CI gate); ``--fail-on-stale`` exits 1 when a suppression
+(inline pragma or baseline entry) no longer fires; a protocol violation
+of the shipped model, an unresolvable/invalid task module, or a task
+verdict differing from ``--expect`` always exits 1.
+
+``--format json`` emits one machine-readable payload; ``--format
+sarif`` (lint/deep/task) emits SARIF 2.1.0 for CI/editor annotation.
 """
 
 from __future__ import annotations
@@ -17,8 +29,11 @@ import json
 import os
 import sys
 
+from lua_mapreduce_tpu.analysis import contracts as contracts_mod
+from lua_mapreduce_tpu.analysis import dataflow as dataflow_mod
 from lua_mapreduce_tpu.analysis import lint as lint_mod
 from lua_mapreduce_tpu.analysis import protocol as proto_mod
+from lua_mapreduce_tpu.analysis import sarif as sarif_mod
 
 
 def _cmd_lint(args) -> tuple:
@@ -112,19 +127,53 @@ def _protocol_suite(args):
     return {"protocol": out}, failed
 
 
+def _cmd_task(args) -> tuple:
+    """Check every task-module spec; the payload carries one report per
+    spec. Fails on findings (always — an invalid task module is never a
+    soft result) and on an ``--expect`` verdict mismatch."""
+    reports = [contracts_mod.check_task(spec) for spec in args.paths]
+    fail = False
+    for rep in reports:
+        if any(f.severity == "error" for f in rep.findings):
+            fail = True
+        if args.expect and rep.verdict != args.expect:
+            fail = True
+        if args.expect_ingraph_fn and not any(
+                fr.verdict == contracts_mod.VERDICT_INGRAPH
+                for fr in rep.functions.values()):
+            fail = True
+    return reports, fail
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m lua_mapreduce_tpu.analysis",
-        description="framework-aware lint + lease-protocol model checker")
+        description="framework-aware lint, interprocedural deep pass, "
+                    "task-contract checker + lease-protocol model checker")
     ap.add_argument("command", nargs="?", default="all",
-                    choices=("all", "lint", "protocol", "rules"))
+                    choices=("all", "lint", "deep", "protocol", "rules",
+                             "task", "callgraph"))
     ap.add_argument("paths", nargs="*", default=None,
-                    help="files/dirs to lint (default: the package)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+                    help="files/dirs to lint, or task-module specs for "
+                         "the task command (default: the package)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--fail-on-findings", action="store_true",
-                    help="exit 1 when lint findings survive suppression")
+                    help="exit 1 when lint/deep findings survive "
+                         "suppression")
+    ap.add_argument("--fail-on-stale", action="store_true",
+                    help="exit 1 when an inline pragma or baseline entry "
+                         "no longer suppresses anything")
     ap.add_argument("--baseline", default=None,
                     help="suppression file (default: analysis/baseline.json)")
+    ap.add_argument("--expect", default=None,
+                    choices=(contracts_mod.VERDICT_INGRAPH,
+                             contracts_mod.VERDICT_STORE,
+                             contracts_mod.VERDICT_INVALID),
+                    help="task: required task-level verdict")
+    ap.add_argument("--expect-ingraph-fn", action="store_true",
+                    help="task: require at least one in-graph-eligible "
+                         "function")
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--jobs", type=int, default=3)
     ap.add_argument("--batch-k", type=int, default=2)
@@ -132,6 +181,28 @@ def main(argv=None) -> int:
                     choices=proto_mod.KNOWN_BUGS,
                     help="restrict the seeded-race regression to one bug")
     args = ap.parse_args(argv)
+
+    if args.format == "sarif" and args.command not in ("lint", "deep",
+                                                       "task"):
+        ap.error("--format sarif applies to lint/deep/task only")
+    if args.fail_on_stale and args.command != "all":
+        # only `all` runs the suppression audit — a scoped lint/deep
+        # pass cannot tell live pragmas from stale ones, so honoring
+        # the flag there would mint a permanently green gate
+        ap.error("--fail-on-stale applies to the all command (it runs "
+                 "the stale-suppression audit)")
+    if args.fail_on_stale and args.paths:
+        # a subset of the PACKAGE drops context seeds that live outside
+        # it (an LMR014 helper's seed sits in store/), so live pragmas
+        # would read as stale; self-contained external trees are fine
+        from lua_mapreduce_tpu.analysis.lint import _PKG_ROOT
+        for p in args.paths:
+            ap_ = os.path.abspath(p)
+            if ap_ != _PKG_ROOT and ap_.startswith(_PKG_ROOT + os.sep):
+                ap.error("--fail-on-stale needs the whole package in "
+                         "view: a package-scoped subset cannot tell "
+                         "live pragmas (whose context seeds may live "
+                         "outside it) from stale ones")
 
     if args.command == "rules":
         catalog = lint_mod.rule_catalog()
@@ -144,13 +215,66 @@ def main(argv=None) -> int:
                 print(f"    {r['rationale']}")
         return 0
 
+    if args.command == "callgraph":
+        from lua_mapreduce_tpu.analysis.callgraph import build_callgraph
+        g = build_callgraph(args.paths or None)
+        payload = {"callgraph": {
+            "nodes": g.node_count(), "edges": g.edge_count(),
+            "interface_methods": len(g.interface_methods()),
+            "unresolved_calls": g.unresolved}}
+        if args.format == "json":
+            print(json.dumps(payload, indent=2))
+        else:
+            cg = payload["callgraph"]
+            print(f"callgraph: {cg['nodes']} nodes, {cg['edges']} edges, "
+                  f"{cg['interface_methods']} interface methods, "
+                  f"{cg['unresolved_calls']} unresolved call sites")
+        return 0
+
+    if args.command == "task":
+        if not args.paths:
+            ap.error("task requires at least one module spec")
+        reports, fail = _cmd_task(args)
+        if args.format == "json":
+            print(json.dumps(
+                {"tasks": [contracts_mod.report_dict(r)
+                           for r in reports]}, indent=2))
+        elif args.format == "sarif":
+            fs = [f for r in reports for f in r.findings]
+            print(sarif_mod.format_sarif(fs))
+        else:
+            for r in reports:
+                print(contracts_mod.format_text(r))
+        return 1 if fail else 0
+
     payload = {}
     findings = None
     rc = 0
-    if args.command in ("all", "lint"):
+    if args.command == "lint":
         findings, fail = _cmd_lint(args)
         payload.update(lint_mod.report_dict(findings))
         rc = max(rc, 1 if fail else 0)
+    if args.command == "deep":
+        res = dataflow_mod.analyze(args.paths or None,
+                                   baseline=args.baseline)
+        findings = res.findings
+        payload.update(lint_mod.report_dict(findings))
+        payload["callgraph"] = {"nodes": res.graph.node_count(),
+                                "edges": res.graph.edge_count(),
+                                "reached": res.reached,
+                                "wall_s": round(res.wall_s, 3)}
+        rc = max(rc, 1 if findings and args.fail_on_findings else 0)
+    if args.command == "all":
+        # one combined pass: per-function + deep findings with shared
+        # suppression, plus the stale audit over both
+        audit = lint_mod.run_audit(args.paths or None,
+                                   baseline=args.baseline)
+        findings = audit.findings
+        payload.update(lint_mod.report_dict(findings))
+        payload["stale_pragmas"] = audit.stale_pragmas
+        payload["stale_baseline"] = audit.stale_baseline
+        rc = max(rc, 1 if findings and args.fail_on_findings else 0)
+        rc = max(rc, 1 if audit.stale and args.fail_on_stale else 0)
     if args.command in ("all", "protocol"):
         try:
             proto_payload, fail = _protocol_suite(args)
@@ -166,13 +290,34 @@ def main(argv=None) -> int:
         payload.update(proto_payload)
         rc = max(rc, 1 if fail else 0)
 
+    if args.format == "sarif":
+        print(sarif_mod.format_sarif(findings or []))
+        return rc
     if args.format == "json":
         print(json.dumps(payload, indent=2))
         return rc
     if findings is not None:
         if findings:
             print(lint_mod.format_text(findings))
-        print(f"lint: {len(findings)} finding(s)")
+        label = {"lint": "lint", "deep": "deep"}.get(args.command,
+                                                     "lint+deep")
+        print(f"{label}: {len(findings)} finding(s)")
+    if "callgraph" in payload:
+        cg = payload["callgraph"]
+        print(f"callgraph: {cg['nodes']} nodes, {cg['edges']} edges, "
+              f"{cg['reached']} context-reached functions, "
+              f"{cg['wall_s']}s")
+    for p in payload.get("stale_pragmas", ()):
+        print(f"{p['path']}:{p['line']}: stale suppression — "
+              f"# lmr: disable={p['rule']} no longer fires")
+    for e in payload.get("stale_baseline", ()):
+        print(f"baseline: stale entry {e.get('rule')} @ "
+              f"{e.get('path')}:{e.get('line', '*')} "
+              f"({e.get('reason', '')}) no longer fires")
+    if "stale_pragmas" in payload:
+        n = len(payload["stale_pragmas"]) + len(payload["stale_baseline"])
+        print(f"suppression audit: {n} stale entr"
+              f"{'y' if n == 1 else 'ies'}")
     for entry in payload.get("protocol", ()):
         if entry["run"].startswith("seeded:"):
             status = ("re-found: " + entry["violation"]

@@ -88,12 +88,7 @@ class FileContext:
 
     def line_disables(self, lineno: int) -> set:
         """Rule ids suppressed inline on ``lineno``."""
-        if not (1 <= lineno <= len(self.lines)):
-            return set()
-        m = _DISABLE_RE.search(self.lines[lineno - 1])
-        if not m:
-            return set()
-        return {s.strip() for s in m.group(1).split(",") if s.strip()}
+        return _line_disables_in(self.lines, lineno)
 
 
 def all_rules() -> List[Rule]:
@@ -126,11 +121,21 @@ def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
                     yield os.path.join(root, f)
 
 
-def _rel_path(path: str) -> str:
-    ap = os.path.abspath(path)
-    if ap.startswith(_PKG_ROOT + os.sep):
-        return os.path.relpath(ap, _PKG_ROOT).replace(os.sep, "/")
-    return os.path.basename(ap)
+def _iter_rel_files(paths: Sequence[str]):
+    """(abs path, rel) pairs, anchoring each input directory the way
+    the call graph does: package files keep the package-relative path,
+    files under an explicit directory root are relative to it (so
+    fixture trees carry their ``coord/``-style scope prefixes), bare
+    files fall back to their basename."""
+    for p in paths:
+        root = p if os.path.isdir(p) else (os.path.dirname(p) or ".")
+        for f in _iter_py_files([p]):
+            ap = os.path.abspath(f)
+            if ap.startswith(_PKG_ROOT + os.sep):
+                rel = os.path.relpath(ap, _PKG_ROOT)
+            else:
+                rel = os.path.relpath(ap, os.path.abspath(root))
+            yield f, rel.replace(os.sep, "/")
 
 
 def load_baseline(path: Optional[str] = None) -> List[dict]:
@@ -159,6 +164,77 @@ def _baseline_match(entry: dict, f: Finding) -> bool:
     return "line" not in entry or int(entry["line"]) == f.line
 
 
+def _collect_raw(paths: Sequence[str], rules: Sequence[Rule]):
+    """Pre-suppression findings + the pragma inventory + per-file line
+    maps: the shared substrate of run_lint and the stale-suppression
+    audit."""
+    raw: List[Finding] = []
+    pragmas: List[dict] = []               # {path, line, rule}
+    lines_by_rel: Dict[str, List[str]] = {}
+    sources: List[tuple] = []              # (rel, source) — graph input
+    for path, rel in _iter_rel_files(paths):
+        # a file the gate cannot read or parse cannot be verified — that
+        # is itself a finding (LMR000), never a crash of the gate
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (UnicodeDecodeError, OSError) as e:
+            raw.append(Finding("LMR000", "error", rel, 0, 0,
+                               f"file is not readable utf-8: {e}"))
+            continue
+        try:
+            ctx = FileContext(path, rel, source)
+        except SyntaxError as e:
+            raw.append(Finding("LMR000", "error", rel,
+                               e.lineno or 0, e.offset or 0,
+                               f"file does not parse: {e.msg}"))
+            continue
+        except ValueError as e:     # ast.parse on NUL bytes
+            raw.append(Finding("LMR000", "error", rel, 0, 0,
+                               f"file does not parse: {e}"))
+            continue
+        lines_by_rel[ctx.rel] = ctx.lines
+        sources.append((ctx.rel, source, ctx.tree))
+        # the pragma INVENTORY comes from real comment tokens only —
+        # a ``# lmr: disable=`` mention inside a docstring or a test
+        # fixture string is documentation, not a suppression
+        pragmas.extend(_comment_pragmas(ctx.rel, source))
+        for rule in rules:
+            if not rule.applies(ctx.rel):
+                continue
+            raw.extend(rule.check(ctx))
+    return raw, pragmas, lines_by_rel, sources
+
+
+def _comment_pragmas(rel: str, source: str) -> List[dict]:
+    import io
+    import tokenize
+    out: List[dict] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DISABLE_RE.search(tok.string)
+            if not m:
+                continue
+            for rid in (s.strip() for s in m.group(1).split(",")):
+                if rid:
+                    out.append({"path": rel, "line": tok.start[0],
+                                "rule": rid})
+    except (tokenize.TokenError, IndentationError):
+        pass          # unparseable tails already surfaced as LMR000
+    return out
+
+
+def _line_disables_in(lines: Sequence[str], lineno: int) -> set:
+    if not (1 <= lineno <= len(lines)):
+        return set()
+    m = _DISABLE_RE.search(lines[lineno - 1])
+    if not m:
+        return set()
+    return {s.strip() for s in m.group(1).split(",") if s.strip()}
+
+
 def run_lint(paths: Optional[Sequence[str]] = None,
              baseline: Optional[str] = None,
              rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
@@ -170,39 +246,71 @@ def run_lint(paths: Optional[Sequence[str]] = None,
     if rules is None:
         rules = all_rules()
     base = load_baseline(baseline)
+    raw, _pragmas, lines_by_rel, _sources = _collect_raw(paths, rules)
     out: List[Finding] = []
-    for path in _iter_py_files(paths):
-        # a file the gate cannot read or parse cannot be verified — that
-        # is itself a finding (LMR000), never a crash of the gate
-        try:
-            with open(path, encoding="utf-8") as f:
-                source = f.read()
-        except (UnicodeDecodeError, OSError) as e:
-            out.append(Finding("LMR000", "error", _rel_path(path), 0, 0,
-                               f"file is not readable utf-8: {e}"))
+    for finding in raw:
+        if finding.rule in _line_disables_in(
+                lines_by_rel.get(finding.path, ()), finding.line):
             continue
-        try:
-            ctx = FileContext(path, _rel_path(path), source)
-        except SyntaxError as e:
-            out.append(Finding("LMR000", "error", _rel_path(path),
-                               e.lineno or 0, e.offset or 0,
-                               f"file does not parse: {e.msg}"))
+        if any(_baseline_match(e, finding) for e in base):
             continue
-        except ValueError as e:     # ast.parse on NUL bytes
-            out.append(Finding("LMR000", "error", _rel_path(path), 0, 0,
-                               f"file does not parse: {e}"))
-            continue
-        for rule in rules:
-            if not rule.applies(ctx.rel):
-                continue
-            for finding in rule.check(ctx):
-                if finding.rule in ctx.line_disables(finding.line):
-                    continue
-                if any(_baseline_match(e, finding) for e in base):
-                    continue
-                out.append(finding)
+        out.append(finding)
     out.sort(key=Finding.key)
     return out
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """run_audit's result: surviving findings from BOTH passes plus the
+    suppressions that excused nothing — a pragma or baseline entry that
+    no longer fires has outlived the code it excused and must go."""
+    findings: List[Finding]
+    stale_pragmas: List[dict]       # {path, line, rule}
+    stale_baseline: List[dict]      # the unmatched baseline entries
+
+    @property
+    def stale(self) -> bool:
+        return bool(self.stale_pragmas or self.stale_baseline)
+
+
+def run_audit(paths: Optional[Sequence[str]] = None,
+              baseline: Optional[str] = None,
+              deep: bool = True) -> AuditReport:
+    """Lint + (optionally) the interprocedural deep pass, with the
+    stale-suppression audit: every inline ``# lmr: disable=`` pragma and
+    every baseline entry must still suppress at least one raw finding."""
+    if paths is None:
+        paths = [_PKG_ROOT]
+    rules = all_rules()
+    base = load_baseline(baseline)
+    raw, pragmas, lines_by_rel, sources = _collect_raw(paths, rules)
+    if deep:
+        # lazy imports: dataflow imports this module. The deep pass
+        # reuses the sources just read — one file walk, one parse set
+        from lua_mapreduce_tpu.analysis import dataflow
+        from lua_mapreduce_tpu.analysis.callgraph import CallGraph
+        graph = CallGraph.from_sources(sources)
+        raw = raw + dataflow.analyze(baseline=baseline, graph=graph).raw
+    used_pragmas = set()
+    used_baseline = set()
+    out: List[Finding] = []
+    for f in raw:
+        dis = _line_disables_in(lines_by_rel.get(f.path, ()), f.line)
+        if f.rule in dis:
+            used_pragmas.add((f.path, f.line, f.rule))
+            continue
+        matched = [i for i, e in enumerate(base) if _baseline_match(e, f)]
+        if matched:
+            used_baseline.update(matched)
+            continue
+        out.append(f)
+    out.sort(key=Finding.key)
+    stale_pragmas = [p for p in pragmas
+                     if (p["path"], p["line"], p["rule"])
+                     not in used_pragmas]
+    stale_baseline = [e for i, e in enumerate(base)
+                      if i not in used_baseline]
+    return AuditReport(out, stale_pragmas, stale_baseline)
 
 
 def format_text(findings: Sequence[Finding]) -> str:
@@ -221,9 +329,17 @@ def format_json(findings: Sequence[Finding]) -> str:
 
 
 def rule_catalog() -> List[Dict[str, str]]:
-    return [{"id": r.id, "severity": r.severity, "title": r.title,
-             "rationale": r.rationale,
-             "paths": list(r.paths) or ["<all>"]} for r in all_rules()]
+    """Every rule id the analyzer can emit: the per-function registry,
+    the interprocedural (deep) rules, and the task-contract rules — one
+    catalog, id order (DESIGN §25)."""
+    from lua_mapreduce_tpu.analysis import contracts, dataflow  # lazy
+    out = [{"id": r.id, "severity": r.severity, "title": r.title,
+            "rationale": r.rationale,
+            "paths": list(r.paths) or ["<all>"]} for r in all_rules()]
+    out.extend(dataflow.deep_rule_catalog())
+    out.extend(contracts.contract_rule_catalog())
+    out.sort(key=lambda r: r["id"])
+    return out
 
 
 def utest() -> None:

@@ -23,7 +23,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from lua_mapreduce_tpu.core.constants import MAX_JOB_RETRIES, Status
 from lua_mapreduce_tpu.core.native_build import load_native
 from lua_mapreduce_tpu.coord.idx_py import PyJobIndex
-from lua_mapreduce_tpu.faults.errors import NativeIndexError
+from lua_mapreduce_tpu.faults.errors import (NativeEngineError,
+                                             NativeIndexError)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
 _SRC = os.path.join(_NATIVE_DIR, "jobstore.cpp")
@@ -44,7 +45,7 @@ def _abi_check(lib: ctypes.CDLL) -> None:
                                 ctypes.POINTER(ctypes.c_int64),
                                 ctypes.POINTER(ctypes.c_int32)]
     except AttributeError:
-        raise RuntimeError(
+        raise NativeEngineError(
             f"native job index {_SO} predates the ABI guard — rebuild it "
             "(delete the cached .so) or set LMR_DISABLE_NATIVE=1")
     magic = ctypes.create_string_buffer(8)
@@ -55,7 +56,7 @@ def _abi_check(lib: ctypes.CDLL) -> None:
     python = (idx_py.MAGIC, idx_py.HEADER_SIZE, idx_py.RECORD_SIZE,
               [int(s) for s in Status])
     if native != python:
-        raise RuntimeError(
+        raise NativeEngineError(
             "native job index ABI drifted from coord/idx_py.py: native "
             f"{native} vs python {python} — the engines share index "
             "files byte-for-byte and must agree exactly")
@@ -356,7 +357,8 @@ def open_index(path: str, engine: str = "auto"):
             cause = ("LMR_DISABLE_NATIVE=1 is set"
                      if os.environ.get("LMR_DISABLE_NATIVE") == "1"
                      else "g++ build failed")
-            raise RuntimeError(f"native job index unavailable ({cause})")
+            raise NativeEngineError(
+                f"native job index unavailable ({cause})")
     return PyJobIndex(path)
 
 

@@ -91,8 +91,11 @@ def _encode_frame(payload: bytes, codec: str) -> Tuple[bytes, int]:
             return comp, CODEC_ZLIB
     elif codec == "lz4":
         if _lz4 is None:
-            raise RuntimeError("segment codec 'lz4' needs the lz4 package; "
-                               "use 'zlib' or 'raw'")
+            # ValueError: a deterministic config error the fault
+            # taxonomy maps as permanent — this raise crosses the retry
+            # boundary through every spill build (LMR014)
+            raise ValueError("segment codec 'lz4' needs the lz4 package; "
+                             "use 'zlib' or 'raw'")
         comp = _lz4.compress(payload, store_size=False)
         if len(comp) < len(payload):
             return comp, CODEC_LZ4

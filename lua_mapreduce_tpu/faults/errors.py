@@ -132,6 +132,16 @@ class ConcurrentInsertError(PermanentStoreError, RuntimeError):
     inserter — the server). Deterministic protocol violation."""
 
 
+class NativeEngineError(PermanentStoreError, RuntimeError):
+    """The native index engine is unusable by construction — ABI drift
+    from idx_py, a pre-guard cached .so, or an explicitly requested
+    native build that is unavailable. Deterministic: retrying cannot
+    rebuild a .so, so the retry layer must fail fast, not back off.
+    Subclasses RuntimeError so pre-taxonomy callers keep working.
+    (Distinct from :class:`NativeIndexError`, the TRANSIENT per-op
+    failure of a healthy engine.)"""
+
+
 class LostShuffleDataError(TransientStoreError):
     """Every replica of a shuffle file is unreadable (DESIGN §20).
 

@@ -67,18 +67,34 @@ echo "push smoke: golden matrix identical, eviction degrades, chaos held"
 # push vs staged byte-identical, globally sorted, frames actually
 # pushed (the full GB-scale artifact is benchmarks/results/sort.json)
 python benchmarks/sort_bench.py --smoke
-# lmr-analyze gate: the framework-aware lint pass must be clean against
-# the checked-in suppression baseline (analysis/baseline.json — shipped
-# EMPTY; LMR009 keeps every engine spill publish on the replication
-# helper, LMR010 keeps trace/ timing on the injectable clock, LMR011
-# keeps every coord/engine wait on the sched Waiter, LMR012 keeps
-# every inbox/manifest publish on spill_writer), and the
-# lease-protocol model checker must exhaustively pass
-# the 2-worker lifecycle (worker death included), the replica-recovery
-# (reconstruct-vs-requeue) edge, the speculation (duplicate-lease /
-# first-commit-wins / revoke) edge, AND the watch/notify (sleep /
-# wake / lost-notification) edge while re-finding all six seeded
-# races. Machine output: add --format json.
-python -m lua_mapreduce_tpu.analysis --fail-on-findings
-echo "lmr-analyze: lint clean + lease protocol model-checked"
+# lmr-analyze gate: the framework-aware lint pass AND the
+# interprocedural deep pass (DESIGN §25: whole-program call graph +
+# context propagation — LMR013 flock-reachable IO, LMR014 unclassified
+# raisables across the retry boundary, LMR015 clock/RNG in
+# replay-deterministic regions, LMR016 non-replayable RPCs in retried
+# frames, LMR017 trace-impure helpers) must be clean against the
+# checked-in suppression baseline (analysis/baseline.json — shipped
+# EMPTY), with NO stale suppressions (--fail-on-stale: a pragma or
+# baseline entry that no longer fires has outlived the code it
+# excused), and the lease-protocol model checker must exhaustively
+# pass the 2-worker lifecycle (worker death included), the
+# replica-recovery (reconstruct-vs-requeue) edge, the speculation
+# (duplicate-lease / first-commit-wins / revoke) edge, AND the
+# watch/notify (sleep / wake / lost-notification) edge while
+# re-finding all six seeded races. Machine output: --format json
+# (or --format sarif on lint/deep/task for CI annotation).
+python -m lua_mapreduce_tpu.analysis --fail-on-findings --fail-on-stale
+echo "lmr-analyze: lint+deep clean, no stale suppressions, protocol model-checked"
+# task-contract gate (DESIGN §25): every shipped task module must
+# statically validate — plugin signatures, emit arity, determinism
+# hazards — and classify to its pinned lowerability verdict: the
+# wordcount matrix is store-plane (mapfn reads files), extsort is
+# store-plane with in-graph-eligible partition/reduce (the numeric
+# path ROADMAP item 3's engine/ingraph.py will lift), the sched bench
+# task is fully in-graph eligible
+python -m lua_mapreduce_tpu.analysis task examples.wordcount --expect store-plane
+python -m lua_mapreduce_tpu.analysis task examples.extsort.sorttask --expect store-plane --expect-ingraph-fn
+python -m lua_mapreduce_tpu.analysis task benchmarks/coord_task.py --expect store-plane
+python -m lua_mapreduce_tpu.analysis task benchmarks/sched_task.py --expect in-graph
+echo "task contracts: all shipped task modules classify to their pinned verdicts"
 python -m pytest tests/ -q --full

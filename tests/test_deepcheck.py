@@ -1,0 +1,825 @@
+"""lmr-deepcheck tests (DESIGN §25): the whole-program call graph, the
+interprocedural context-propagation rules (LMR013+) with the fixture
+pairs the per-function lint provably misses, the stale-suppression
+audit, SARIF export, the static task-contract checker, and the
+pinned lowerability verdicts of every shipped task module."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from lua_mapreduce_tpu.analysis import callgraph as cg_mod
+from lua_mapreduce_tpu.analysis import contracts
+from lua_mapreduce_tpu.analysis import dataflow
+from lua_mapreduce_tpu.analysis import lint as lint_mod
+from lua_mapreduce_tpu.analysis import sarif
+from lua_mapreduce_tpu.analysis.callgraph import CallGraph
+from lua_mapreduce_tpu.analysis.lint import run_audit, run_lint
+
+PKG = os.path.dirname(os.path.abspath(lint_mod.__file__))
+REPO = os.path.dirname(os.path.dirname(PKG))
+
+
+def _write_fixture(tmp_path, rel, src):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+def _deep(tmp_path, fixtures):
+    for rel, src in fixtures.items():
+        _write_fixture(tmp_path, rel, src)
+    return dataflow.run_deep([str(tmp_path)], baseline="/nonexistent")
+
+
+def _per_function(tmp_path):
+    return run_lint([str(tmp_path)], baseline="/nonexistent")
+
+
+# --- call graph -------------------------------------------------------------
+
+def test_callgraph_resolves_every_edge_kind():
+    g = CallGraph.from_sources([
+        ("engine/a.py", textwrap.dedent("""\
+            from engine.b import helper, Tool
+            import engine.b
+
+            class Runner:
+                def top(self, cb):
+                    self.low()
+                    helper()
+                    engine.b.other()
+                    t = Tool()
+                    cb(1)
+
+                def low(self):
+                    def inner():
+                        return 1
+                    return inner()
+            """)),
+        ("engine/b.py", textwrap.dedent("""\
+            def helper():
+                return other()
+
+            def other():
+                return 2
+
+            class Tool:
+                def __init__(self):
+                    self.x = 1
+            """)),
+    ])
+    kinds = {(e.caller.split("::")[1], e.callee, e.kind)
+             for edges in g.edges_from.values() for e in edges}
+    assert ("Runner.top", "engine/a.py::Runner.low", "method") in kinds
+    assert ("Runner.top", "engine/b.py::helper", "direct") in kinds
+    assert ("Runner.top", "engine/b.py::other", "direct") in kinds
+    assert ("Runner.top", "engine/b.py::Tool.__init__", "ctor") in kinds
+    assert ("Runner.top", "<param:cb>", "param") in kinds
+    assert ("Runner.low", "engine/a.py::Runner.low.inner",
+            "direct") in kinds
+
+
+def test_callgraph_interface_surface_fans_out():
+    g = CallGraph.from_sources([
+        ("store/base.py", textwrap.dedent("""\
+            class Store:
+                def lines(self, name):
+                    raise NotImplementedError
+            """)),
+        ("store/memfs.py", textwrap.dedent("""\
+            class MemStore(Store):
+                def lines(self, name):
+                    return []
+            """)),
+        ("engine/job.py", textwrap.dedent("""\
+            def read_all(store):
+                return list(store.lines('x'))
+            """)),
+    ])
+    edges = [e for e in g.callees("engine/job.py::read_all")
+             if e.kind == "interface"]
+    assert len(edges) == 1
+    impls = set(g.iface_targets("lines"))
+    assert impls == {"store/base.py::Store.lines",
+                     "store/memfs.py::MemStore.lines"}
+
+
+def test_callgraph_base_class_resolution_across_modules():
+    g = CallGraph.from_sources([
+        ("store/base.py", "class Base:\n"
+                          "    def shared(self):\n"
+                          "        return 1\n"),
+        ("store/impl.py", "from store.base import Base\n"
+                          "class Impl(Base):\n"
+                          "    def use(self):\n"
+                          "        return self.shared()\n"),
+    ])
+    edges = g.callees("store/impl.py::Impl.use")
+    assert [(e.callee, e.kind) for e in edges] == \
+        [("store/base.py::Base.shared", "method")]
+
+
+def test_callgraph_indexes_defs_inside_except_handlers(tmp_path):
+    """The import-fallback idiom (`except ImportError: def helper()`)
+    nests the def two statement levels deep — it must still be a graph
+    node, or the deep pass is blind through every fallback helper."""
+    deep = _deep(tmp_path, {
+        "coord/fb.py": """\
+            import os
+            try:
+                from fast import helper
+            except ImportError:
+                def helper():
+                    import json
+                    return json.load(open('x'))
+
+            class Idx:
+                def claim(self):
+                    fd = self._open_locked()
+                    try:
+                        return helper()
+                    finally:
+                        os.close(fd)
+            """,
+    })
+    # json.load + open share line 7: same (path, line, rule) — the
+    # shortest-chain dedup collapses them to ONE finding by design
+    assert [(f.rule, f.line) for f in deep] == [("LMR013", 7)]
+
+
+def test_real_package_graph_size_and_speed():
+    import time
+    t0 = time.perf_counter()
+    g = cg_mod.build_callgraph()
+    wall = time.perf_counter() - t0
+    assert g.node_count() > 800 and g.edge_count() > 1500
+    assert wall < 15.0, f"callgraph build took {wall:.1f}s"
+    assert {"lines", "build", "claim_batch",
+            "read_range"} <= g.interface_methods()
+
+
+# --- LMR013: flock-reachable IO ---------------------------------------------
+
+FLOCK_INDIRECT = {
+    "coord/fx.py": """\
+        import json, os, time
+
+        class Idx:
+            def claim(self):
+                fd = self._open_locked()
+                try:
+                    return self._load_doc(fd)
+                finally:
+                    os.close(fd)
+
+            def _load_doc(self, fd):
+                doc = json.load(open('sidecar'))
+                time.sleep(0.1)
+                return doc
+        """,
+}
+
+
+def test_lmr013_helper_io_under_flock_found_deep_missed_shallow(tmp_path):
+    deep = _deep(tmp_path, FLOCK_INDIRECT)
+    assert {f.rule for f in deep} == {"LMR013"}
+    assert sorted({f.line for f in deep}) == [12, 13]
+    assert any("json.load" in f.message or "open()" in f.message
+               for f in deep)
+    assert all("reached from" in f.message for f in deep)
+    # the per-function pass provably misses the indirection
+    per_fn = _per_function(tmp_path)
+    assert [f for f in per_fn if f.rule == "LMR002"] == []
+
+
+def test_lmr013_store_dataplane_call_in_region_and_clean_twin(tmp_path):
+    deep = _deep(tmp_path, {
+        "coord/direct.py": """\
+            import os
+
+            class Idx:
+                def scan(self, store):
+                    fd = self._open_locked()
+                    try:
+                        return store.lines('manifest')
+                    finally:
+                        os.close(fd)
+            """,
+        "coord/clean.py": """\
+            import os
+
+            class Idx:
+                def good(self):
+                    fd = self._open_locked()
+                    try:
+                        return self._read_rec(fd)
+                    finally:
+                        os.close(fd)
+
+                def _read_rec(self, fd):
+                    return os.read(fd, 88)
+            """,
+    })
+    assert [f.rule for f in deep] == ["LMR013"]
+    assert "store data-plane call" in deep[0].message
+    assert deep[0].path == "coord/direct.py"
+
+
+def test_lmr013_user_callback_one_frame_deep(tmp_path):
+    deep = _deep(tmp_path, {
+        "coord/cb.py": """\
+            import os
+
+            class Idx:
+                def claim(self, notify):
+                    fd = self._open_locked()
+                    try:
+                        self._fire(notify)
+                    finally:
+                        os.close(fd)
+
+                def _fire(self, notify):
+                    notify("claimed")
+            """,
+    })
+    assert [f.rule for f in deep] == ["LMR013"]
+    assert "user callback" in deep[0].message
+
+
+# --- LMR014: unclassified raisables across the retry boundary ---------------
+
+RETRY_INDIRECT = {
+    "store/fx.py": """\
+        class MyStore:
+            def read_range(self, name, offset, length):
+                return self._fetch(name)
+
+            def _fetch(self, name):
+                raise RuntimeError('backend hiccup')
+        """,
+}
+
+
+def test_lmr014_helper_raise_found_deep_missed_shallow(tmp_path):
+    deep = _deep(tmp_path, RETRY_INDIRECT)
+    assert [f.rule for f in deep] == ["LMR014"]
+    assert deep[0].line == 6 and "RuntimeError" in deep[0].message
+    per_fn = _per_function(tmp_path)
+    assert [f for f in per_fn if f.rule == "LMR008"] == []
+
+
+def test_lmr014_classified_helper_raises_pass(tmp_path):
+    deep = _deep(tmp_path, {
+        "store/ok.py": """\
+            class MyStore:
+                def read_range(self, name, offset, length):
+                    return self._fetch(name)
+
+                def _fetch(self, name):
+                    raise TransientStoreError('blip')
+
+                def size(self, name):
+                    return self._stat(name)
+
+                def _stat(self, name):
+                    raise FileNotFoundError(name)
+            """,
+    })
+    assert deep == []
+
+
+def test_lmr014_checks_the_directly_wrapped_policy_frame(tmp_path):
+    """A function handed straight to RetryPolicy.call IS the retried
+    frame, and it is not a boundary method LMR008 ever checks — its
+    own depth-0 raise must still classify."""
+    deep = _deep(tmp_path, {
+        "faults/fx.py": """\
+            def fetch_with_retry(policy):
+                return policy.call(_do_fetch)
+
+            def _do_fetch():
+                raise RuntimeError('backend hiccup')
+            """,
+    })
+    assert [(f.rule, f.line) for f in deep] == [("LMR014", 5)]
+
+
+def test_lmr014_reaches_helpers_outside_store_paths(tmp_path):
+    # the helper lives in core/ — outside LMR008's path scope entirely
+    deep = _deep(tmp_path, {
+        "core/codec.py": """\
+            def encode_frame(payload):
+                raise RuntimeError('bad frame')
+            """,
+        "store/user.py": """\
+            from core.codec import encode_frame
+
+            class S:
+                def build(self, name):
+                    return encode_frame(name)
+            """,
+    })
+    assert [f.rule for f in deep] == ["LMR014"]
+    assert deep[0].path == "core/codec.py"
+
+
+# --- LMR015: clock/RNG in replay-deterministic regions ----------------------
+
+REPLAY_INDIRECT = {
+    "coord/fx.py": """\
+        import time
+
+        class S:
+            def stamp(self):
+                with self._lock:
+                    self.t = self._now()
+
+            def _now(self):
+                return time.time()
+        """,
+}
+
+
+def test_lmr015_hoistable_clock_found_deep_missed_shallow(tmp_path):
+    deep = _deep(tmp_path, REPLAY_INDIRECT)
+    assert [f.rule for f in deep] == ["LMR015"]
+    assert deep[0].line == 9
+    per_fn = _per_function(tmp_path)
+    assert [f for f in per_fn if f.rule == "LMR004"] == []
+
+
+def test_lmr015_trace_seeded_chain_and_hoisted_twin(tmp_path):
+    deep = _deep(tmp_path, {
+        "trace/fx.py": """\
+            from core.util import jitter
+
+            class Tracer:
+                def add(self, name):
+                    return jitter()
+            """,
+        "core/util.py": """\
+            import random
+
+            def jitter():
+                return random.random()
+            """,
+        "coord/clean.py": """\
+            import time
+
+            class S:
+                def stamp(self):
+                    now = self._now()
+                    with self._lock:
+                        self.t = now
+
+                def _now(self):
+                    return time.time()
+            """,
+    })
+    assert [f.rule for f in deep] == ["LMR015"]
+    assert deep[0].path == "core/util.py"
+    assert "random.random" in deep[0].message
+
+
+# --- LMR016: non-replayable RPCs inside retried frames ----------------------
+
+def test_lmr016_insert_jobs_reachable_from_retried_op(tmp_path):
+    deep = _deep(tmp_path, {
+        "store/fx.py": """\
+            class S:
+                def build(self, name):
+                    self._publish(name)
+
+                def _publish(self, name):
+                    self.js.insert_jobs('ns', [])
+            """,
+    })
+    assert [f.rule for f in deep] == ["LMR016"]
+    assert "insert_jobs" in deep[0].message
+
+
+def test_lmr016_policy_call_frame_and_unretried_claim_pass(tmp_path):
+    deep = _deep(tmp_path, {
+        "faults/fx.py": """\
+            class Wrapper:
+                def flush(self, name):
+                    self._policy.call(lambda: self._inner.pt_cas(
+                        name, None, {}), op='flush', name=name)
+            """,
+        "coord/ok.py": """\
+            class JS:
+                def claim(self, ns, worker):
+                    # claim is NOT a retried frame: its claim_batch
+                    # fallback is the documented default-1 path
+                    return self.claim_batch(ns, worker, 1)
+
+                def claim_batch(self, ns, worker, k):
+                    return []
+            """,
+    })
+    assert [f.rule for f in deep] == ["LMR016"]
+    assert deep[0].path == "faults/fx.py"
+    assert "pt_cas" in deep[0].message
+
+
+# --- LMR017: jit-trace purity through helpers -------------------------------
+
+JIT_INDIRECT = {
+    "ops/fx.py": """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x + _noise(3)
+
+        def _noise(n):
+            return np.random.randn(n)
+        """,
+}
+
+
+def test_lmr017_impure_helper_found_deep_missed_shallow(tmp_path):
+    deep = _deep(tmp_path, JIT_INDIRECT)
+    assert [f.rule for f in deep] == ["LMR017"]
+    assert "np.random" in deep[0].message
+    per_fn = _per_function(tmp_path)
+    assert [f for f in per_fn if f.rule == "LMR007"] == []
+
+
+def test_lmr017_pure_helper_and_untraced_users_pass(tmp_path):
+    deep = _deep(tmp_path, {
+        "ops/ok.py": """\
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def kernel(x):
+                return _scale(x)
+
+            def _scale(x):
+                return x * jnp.float32(2.0)
+
+            def host_bench():
+                import numpy as np
+                return _noise(np.random.default_rng(0))
+
+            def _noise(rng):
+                return rng.normal()
+            """,
+    })
+    assert deep == []
+
+
+# --- suppression + stale audit ----------------------------------------------
+
+def test_deep_findings_respect_inline_and_baseline(tmp_path):
+    fixtures = dict(REPLAY_INDIRECT)
+    _write_fixture(tmp_path, "coord/fx.py", fixtures["coord/fx.py"])
+    assert len(dataflow.run_deep([str(tmp_path)],
+                                 baseline="/nonexistent")) == 1
+    # inline pragma on the deep finding's line
+    src = textwrap.dedent(fixtures["coord/fx.py"]).replace(
+        "return time.time()",
+        "return time.time()  # lmr: disable=LMR015")
+    (tmp_path / "coord" / "fx.py").write_text(src)
+    assert dataflow.run_deep([str(tmp_path)],
+                             baseline="/nonexistent") == []
+    # justified baseline entry
+    (tmp_path / "coord" / "fx.py").write_text(
+        textwrap.dedent(fixtures["coord/fx.py"]))
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps([{"rule": "LMR015", "path": "coord/fx.py",
+                               "reason": "test"}]))
+    assert dataflow.run_deep([str(tmp_path)], baseline=str(bl)) == []
+
+
+def test_stale_pragma_and_baseline_detected(tmp_path):
+    _write_fixture(tmp_path, "train/fx.py", """\
+        def fine():
+            return 1  # lmr: disable=LMR005
+        """)
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps([{"rule": "LMR001", "path": "train/gone.py",
+                               "reason": "file was deleted"}]))
+    audit = run_audit([str(tmp_path)], baseline=str(bl))
+    assert audit.findings == []
+    assert audit.stale_pragmas == [{"path": "train/fx.py", "line": 2,
+                                    "rule": "LMR005"}]
+    assert audit.stale_baseline == [{"rule": "LMR001",
+                                     "path": "train/gone.py",
+                                     "reason": "file was deleted"}]
+    assert audit.stale
+
+
+def test_live_pragma_is_not_stale(tmp_path):
+    _write_fixture(tmp_path, "train/fx.py", """\
+        def swallow():
+            try:
+                work()
+            except BaseException:  # lmr: disable=LMR005
+                pass
+        """)
+    audit = run_audit([str(tmp_path)], baseline="/nonexistent")
+    assert audit.findings == [] and not audit.stale
+
+
+def test_docstring_mentions_are_not_pragmas(tmp_path):
+    _write_fixture(tmp_path, "train/fx.py", '''\
+        """Suppress with ``# lmr: disable=LMR005`` on the line."""
+        SNIPPET = "x = 1  # lmr: disable=LMR001"
+        ''')
+    audit = run_audit([str(tmp_path)], baseline="/nonexistent")
+    assert not audit.stale
+
+
+def test_cli_fail_on_stale_and_json_payload(tmp_path):
+    _write_fixture(tmp_path, "train/fx.py", """\
+        def fine():
+            return 1  # lmr: disable=LMR005
+        """)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "lua_mapreduce_tpu.analysis", "all",
+         str(tmp_path), "--fail-on-stale", "--format", "json",
+         "--baseline", "/nonexistent", "--workers", "1", "--jobs", "1",
+         "--batch-k", "1", "--seed-bug", "commit_skips_owner_cas"],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["stale_pragmas"][0]["rule"] == "LMR005"
+    assert payload["count"] == 0
+
+
+# --- SARIF ------------------------------------------------------------------
+
+def test_sarif_export_schema_and_results(tmp_path):
+    _write_fixture(tmp_path, "store/fx.py", RETRY_INDIRECT["store/fx.py"])
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "lua_mapreduce_tpu.analysis", "deep",
+         str(tmp_path), "--format", "sarif",
+         "--baseline", "/nonexistent"],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    sarif.validate_sarif(doc)
+    results = doc["runs"][0]["results"]
+    assert len(results) == 1 and results[0]["ruleId"] == "LMR014"
+    uri = results[0]["locations"][0]["physicalLocation"][
+        "artifactLocation"]["uri"]
+    assert uri == "store/fx.py"
+
+
+def test_sarif_rejected_for_protocol():
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "lua_mapreduce_tpu.analysis", "protocol",
+         "--format", "sarif"],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert r.returncode == 2
+    assert "sarif" in r.stderr
+
+
+# --- task-contract checker --------------------------------------------------
+
+def test_contract_signature_and_emit_arity(tmp_path):
+    p = _write_fixture(tmp_path, "task.py", """\
+        def taskfn(emit, extra):
+            emit(1)
+
+        def mapfn(key, value, emit):
+            emit(key, value, 1)
+
+        def partitionfn(key):
+            return 0
+
+        def reducefn(key, values):
+            return sum(values)
+        """)
+    rep = contracts.check_task(p)
+    assert rep.verdict == contracts.VERDICT_INVALID
+    rules = sorted(f.rule for f in rep.findings)
+    assert rules == ["LMR021", "LMR022", "LMR022"]
+    assert rep.functions["taskfn"].verdict == contracts.VERDICT_INVALID
+
+
+def test_contract_missing_required_functions(tmp_path):
+    p = _write_fixture(tmp_path, "half.py", """\
+        def mapfn(key, value, emit):
+            emit(key, value)
+        """)
+    rep = contracts.check_task(p)
+    assert rep.verdict == contracts.VERDICT_INVALID
+    missing = {f.message.split("'")[1] for f in rep.findings
+               if f.rule == "LMR020"}
+    assert missing == {"taskfn", "partitionfn", "reducefn"}
+
+
+def test_contract_determinism_hazards(tmp_path):
+    p = _write_fixture(tmp_path, "hazard.py", """\
+        import time, random, os, glob
+
+        def taskfn(emit):
+            for path in glob.glob('*.txt'):
+                emit(path, path)
+
+        def mapfn(key, value, emit):
+            emit(key, time.time())
+            emit(key, random.random())
+
+        def partitionfn(key):
+            return hash(key) % 4
+
+        def reducefn(key, values):
+            total = 0
+            for v in set(values):
+                total += v
+            return total
+        """)
+    rep = contracts.check_task(p)
+    assert rep.verdict == contracts.VERDICT_STORE
+    rules = {f.rule for f in rep.findings}
+    assert {"LMR023", "LMR024", "LMR025"} <= rules
+    # hazards make a function store-plane, never in-graph
+    assert rep.functions["partitionfn"].verdict == contracts.VERDICT_STORE
+
+
+def test_contract_hazards_seen_through_helpers(tmp_path):
+    p = _write_fixture(tmp_path, "indirect.py", """\
+        import time
+
+        def _stamp():
+            return time.time()
+
+        def taskfn(emit):
+            emit(0, 0)
+
+        def mapfn(key, value, emit):
+            emit(key, _stamp())
+
+        def partitionfn(key):
+            return 0
+
+        def reducefn(key, values):
+            return values[0]
+        """)
+    rep = contracts.check_task(p)
+    hits = [f for f in rep.findings if f.rule == "LMR023"]
+    assert len(hits) == 1 and "_stamp" in hits[0].message
+
+
+def test_contract_sorted_listdir_passes(tmp_path):
+    p = _write_fixture(tmp_path, "sortedio.py", """\
+        import os
+
+        def taskfn(emit):
+            for i, p in enumerate(sorted(os.listdir('.'))):
+                emit(i, p)
+
+        def mapfn(key, value, emit):
+            emit(key, value)
+
+        def partitionfn(key):
+            return 0
+
+        def reducefn(key, values):
+            return values[0]
+        """)
+    rep = contracts.check_task(p)
+    assert not [f for f in rep.findings if f.rule == "LMR024"]
+
+
+def test_contract_pure_numeric_task_is_ingraph(tmp_path):
+    p = _write_fixture(tmp_path, "numeric.py", """\
+        def taskfn(emit):
+            for j in range(8):
+                emit(j, j)
+
+        def mapfn(key, value, emit):
+            emit(key % 4, value * value + 1)
+
+        def partitionfn(key):
+            return key % 4
+
+        def reducefn(key, values):
+            return sum(values)
+        """)
+    rep = contracts.check_task(p)
+    assert rep.verdict == contracts.VERDICT_INGRAPH
+    assert all(fr.verdict == contracts.VERDICT_INGRAPH
+               for fr in rep.functions.values())
+
+
+def test_contract_unresolvable_module():
+    rep = contracts.check_task("no.such.module.anywhere")
+    assert rep.verdict == contracts.VERDICT_INVALID
+    assert rep.findings[0].rule == "LMR020"
+
+
+# --- shipped task modules: pinned verdicts (the e2e matrix) -----------------
+
+def test_wordcount_package_is_store_plane_only():
+    rep = contracts.check_task(os.path.join(REPO, "examples", "wordcount"))
+    assert rep.verdict == contracts.VERDICT_STORE
+    assert rep.findings == [], contracts.format_text(rep)
+    # mapfn reads files — the whole task is store-plane; the pure sum
+    # reducer alone is liftable
+    assert rep.functions["mapfn"].verdict == contracts.VERDICT_STORE
+    assert rep.functions["reducefn"].verdict == contracts.VERDICT_INGRAPH
+    assert set(rep.functions) >= {"taskfn", "mapfn", "partitionfn",
+                                  "reducefn", "finalfn"}
+
+
+def test_extsort_has_ingraph_numeric_path():
+    rep = contracts.check_task(
+        os.path.join(REPO, "examples", "extsort", "sorttask.py"))
+    assert rep.verdict == contracts.VERDICT_STORE
+    assert rep.findings == [], contracts.format_text(rep)
+    # the range-partition arithmetic and identity fold are the
+    # in-graph-eligible numeric path (ROADMAP item 3's oracle)
+    assert rep.functions["partitionfn"].verdict == contracts.VERDICT_INGRAPH
+    assert rep.functions["reducefn"].verdict == contracts.VERDICT_INGRAPH
+    assert rep.functions["mapfn"].verdict == contracts.VERDICT_STORE
+
+
+def test_coord_task_is_store_plane_and_clean():
+    rep = contracts.check_task(
+        os.path.join(REPO, "benchmarks", "coord_task.py"))
+    assert rep.verdict == contracts.VERDICT_STORE
+    assert rep.findings == [], contracts.format_text(rep)
+
+
+def test_sched_task_is_fully_ingraph():
+    rep = contracts.check_task(
+        os.path.join(REPO, "benchmarks", "sched_task.py"))
+    assert rep.verdict == contracts.VERDICT_INGRAPH
+    assert rep.findings == [], contracts.format_text(rep)
+
+
+def test_task_cli_expect_verdicts():
+    env = dict(os.environ, PYTHONPATH=REPO)
+    ok = subprocess.run(
+        [sys.executable, "-m", "lua_mapreduce_tpu.analysis", "task",
+         "examples.wordcount", "--expect", "store-plane"],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(
+        [sys.executable, "-m", "lua_mapreduce_tpu.analysis", "task",
+         "examples.wordcount", "--expect", "in-graph"],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert bad.returncode == 1
+    fn = subprocess.run(
+        [sys.executable, "-m", "lua_mapreduce_tpu.analysis", "task",
+         "examples.extsort.sorttask", "--expect", "store-plane",
+         "--expect-ingraph-fn", "--format", "json"],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert fn.returncode == 0, fn.stdout + fn.stderr
+    payload = json.loads(fn.stdout)
+    verdicts = {name: d["verdict"]
+                for name, d in payload["tasks"][0]["functions"].items()}
+    assert verdicts["reducefn"] == "in-graph"
+
+
+# --- whole-repo gates -------------------------------------------------------
+
+def test_repo_deep_pass_clean_and_fast():
+    res = dataflow.analyze()
+    assert res.findings == [], lint_mod.format_text(res.findings)
+    assert res.wall_s < 30.0, f"deep pass took {res.wall_s:.1f}s"
+    assert res.reached > 100          # contexts actually propagate
+
+
+def test_repo_audit_has_no_stale_suppressions():
+    audit = run_audit()
+    assert audit.findings == [], lint_mod.format_text(audit.findings)
+    assert not audit.stale, (audit.stale_pragmas, audit.stale_baseline)
+
+
+def test_full_rule_catalog_spans_all_three_bands():
+    ids = [r["id"] for r in lint_mod.rule_catalog()]
+    assert ids == sorted(ids) and len(ids) == len(set(ids))
+    for rid in ("LMR001", "LMR013", "LMR014", "LMR015", "LMR016",
+                "LMR017", "LMR020", "LMR021", "LMR022", "LMR023",
+                "LMR024", "LMR025"):
+        assert rid in ids, rid
+
+
+def test_native_engine_error_is_classified_permanent():
+    """The at-head LMR014 fix: the native-engine refusals now raise a
+    classified PERMANENT error (retrying cannot rebuild a .so) that
+    stays RuntimeError-compatible for pre-taxonomy callers."""
+    from lua_mapreduce_tpu.faults.errors import (NativeEngineError,
+                                                 PermanentStoreError,
+                                                 classify_exception)
+
+    e = NativeEngineError("abi drift")
+    assert isinstance(e, RuntimeError)
+    assert isinstance(e, PermanentStoreError)
+    assert classify_exception(e) is False
