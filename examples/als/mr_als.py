@@ -7,15 +7,28 @@ Loop shape (SURVEY.md §3.5, the looping-MapReduce template):
 
     init        — build the ratings matrix; seed item factors V into the
                   persistent table
-    taskfn      — emit n_shards user shards
-    mapfn       — read V; solve this shard's user factors (ridge
-                  regression per user — embarrassingly parallel); emit
-                  each item's partial normal equations (A_i, b_i) and the
-                  shard's ("SSE", …) against the solved users
-    partitionfn — item id hash % NUM_REDUCERS
+    taskfn      — read V from the table and THREAD IT THROUGH the job
+                  values: emit n_shards user-shard jobs each carrying V
+                  as an array-shaped record
+    mapfn       — pure array program: solve this shard's user factors
+                  (batched ridge regression) against the V riding the
+                  job value; emit each item's partial normal equations
+                  (A_i, b_i) and the shard's SSE under the sentinel key
+                  n_items
+    partitionfn — item id % NUM_REDUCERS (numeric keys)
     reducefn    — matrix/vector partial sums (assoc+commut flags)
     finalfn     — solve every item's (A_i + λI) v_i = b_i, commit V,
                   loop for a fixed number of rounds
+
+**In-graph eligible (DESIGN §26).** The data-plane functions sit inside
+the static lowerability oracle's surface (analysis/contracts.py):
+mapfn/reducefn are jnp-only array programs, partitionfn is integer
+math, and the cross-iteration state (V) enters through the taskfn job
+values — under ``engine="auto"`` the data plane compiles to ONE jitted
+program (engine/ingraph.py) re-fed fresh factor arrays each "loop"
+iteration with zero retrace, and the same module runs unchanged on the
+distributed store plane as the allclose golden twin
+(tests/test_ingraph.py).
 
 The TPU-native fast path of the same algorithm (users sharded over the
 mesh, partials psum'd over ICI) is models/als.py; the two must agree —
@@ -30,6 +43,7 @@ process gets an isolated table and the loop silently reiterates round 1
 MongoDB by its connection string, execute_server.lua:25-35).
 """
 
+import jax.numpy as jnp
 import numpy as np
 
 from lua_mapreduce_tpu.coord.filestore import FileJobStore
@@ -78,55 +92,59 @@ def init(args):
 
 
 def taskfn(emit):
-    for i in range(_cfg["n_shards"]):
-        emit(i, i)
-
-
-def _shard_rows(shard: int):
-    sl = slice(int(shard), None, _cfg["n_shards"])
-    return _r[sl], _w[sl]
-
-
-def mapfn(key, shard, emit):
+    # state-threading contract (DESIGN §26): V rides every job value as
+    # an array-shaped record — same shapes each iteration, so the
+    # compiled plane's "loop" never retraces, and store-plane mapfn no
+    # longer reads the persistent table per job
     pt = _table(read_only=True)
-    v = np.asarray(pt["item_factors"], np.float32)      # (n_items, k)
-    r, w = _shard_rows(shard)
+    item_factors = pt["item_factors"]
+    for i in range(_cfg["n_shards"]):
+        emit(i, {"item_factors": item_factors})
+
+
+def _shard_rows(shard):
+    return (_r[int(shard)::_cfg["n_shards"]],
+            _w[int(shard)::_cfg["n_shards"]])
+
+
+def mapfn(key, value, emit):
+    v = jnp.asarray(value["item_factors"], jnp.float32)  # (n_items, k)
+    r, w = _shard_rows(key)
+    r = jnp.asarray(r, jnp.float32)
+    w = jnp.asarray(w, jnp.float32)
     k = v.shape[1]
-    eye = _cfg["reg"] * np.eye(k, dtype=np.float32)
+    eye = _cfg["reg"] * jnp.eye(k, dtype=jnp.float32)
 
     # user step: per-user ridge solve given V, batched over the shard
-    # (np.linalg.solve broadcasts over the leading axis — one LAPACK
-    # dispatch for the whole shard, the host analog of models/als.py's
-    # vmap'd solve)
-    vw = v[None, :, :] * w[:, :, None]              # (n_u, n_items, k)
-    a = vw.transpose(0, 2, 1) @ v + eye             # (n_u, k, k)
-    b = vw.transpose(0, 2, 1) @ r[:, :, None]       # (n_u, k, 1)
-    u = np.linalg.solve(a, b)[..., 0].astype(np.float32)
+    # (jnp.linalg.solve broadcasts over the leading axis — the array
+    # analog of models/als.py's vmap'd solve)
+    vw = v[None, :, :] * w[:, :, None]                  # (n_u, n_items, k)
+    a = jnp.transpose(vw, (0, 2, 1)) @ v + eye          # (n_u, k, k)
+    b = jnp.transpose(vw, (0, 2, 1)) @ r[:, :, None]    # (n_u, k, 1)
+    u = jnp.linalg.solve(a, b)[..., 0]                  # (n_u, k)
 
     # item-step partials: A_i = Σ_u w_ui u uᵀ, b_i = Σ_u w_ui r_ui u
-    a = np.einsum("ui,uk,ul->ikl", w, u, u)
-    b = np.einsum("ui,ui,uk->ik", w, r, u)
+    a_items = jnp.einsum("ui,uk,ul->ikl", w, u, u)
+    b_items = jnp.einsum("ui,ui,uk->ik", w, r, u)
     for item in range(v.shape[0]):
-        emit(int(item), {"a": a[item].tolist(), "b": b[item].tolist()})
+        emit(item, {"a": a_items[item], "b": b_items[item]})
 
-    err = w * (u @ v.T - r)
-    emit("SSE", {"sq": float((err ** 2).sum()), "cnt": float(w.sum())})
+    # shard SSE under the sentinel key n_items (numeric key space)
+    err = w * (u @ jnp.transpose(v) - r)
+    emit(v.shape[0], {"a": jnp.sum(err * err), "b": jnp.sum(w)})
 
 
 def partitionfn(key):
-    return sum(str(key).encode()) % NUM_REDUCERS
+    return int(key) % NUM_REDUCERS
 
 
 def reducefn(key, values):
-    if key == "SSE":
-        return {"sq": sum(v["sq"] for v in values),
-                "cnt": sum(v["cnt"] for v in values)}
-    a = np.asarray(values[0]["a"], np.float64)
-    b = np.asarray(values[0]["b"], np.float64)
-    for v in values[1:]:
-        a = a + np.asarray(v["a"], np.float64)
-        b = b + np.asarray(v["b"], np.float64)
-    return {"a": a.tolist(), "b": b.tolist()}
+    a = jnp.asarray(values[0]["a"])
+    b = jnp.asarray(values[0]["b"])
+    for i in range(1, len(values)):
+        a = a + jnp.asarray(values[i]["a"])
+        b = b + jnp.asarray(values[i]["b"])
+    return {"a": a, "b": b}
 
 
 reducefn.associative_reducer = True
@@ -136,13 +154,13 @@ reducefn.commutative_reducer = True
 def finalfn(pairs):
     pt = _table()
     v = np.asarray(pt["item_factors"], np.float32)
-    k = v.shape[1]
+    n_items, k = v.shape
     eye = _cfg["reg"] * np.eye(k)
     sq = cnt = 0.0
     for key, vs in pairs:
         val = vs[0]
-        if key == "SSE":
-            sq, cnt = val["sq"], val["cnt"]
+        if int(key) == n_items:
+            sq, cnt = float(np.asarray(val["a"])), float(np.asarray(val["b"]))
         else:
             a = np.asarray(val["a"], np.float64)
             b = np.asarray(val["b"], np.float64)

@@ -1,0 +1,193 @@
+"""Digits-MLP data-parallel SGD packaged as the six MapReduce functions
+— the IN-GRAPH-ELIGIBLE variant of examples/digits/mr_train.py
+(DESIGN §26; the headline workload of benchmarks/ingraph_bench.py).
+
+Where mr_train.py keeps model state in a checkpoint file that every
+mapfn re-reads (host IO → store-plane verdict), this packaging follows
+the state-threading contract the compiled plane needs: taskfn threads
+the CURRENT parameters (and each shard's deterministic minibatch
+indices) through the job values as array-shaped records, mapfn is a
+pure jnp program — manual forward + backward for the 2-layer tanh MLP,
+no ``jax.grad`` (a transformed-function call is outside the static
+oracle's surface; the hand-written VJP is the same math) — and
+reducefn is the elementwise gradient sum. Under ``engine="auto"`` the
+whole per-step map→shuffle→reduce compiles to ONE jitted program,
+re-fed fresh parameter arrays each "loop" iteration with zero retrace;
+``engine="store"`` runs the identical module interpreted — the
+allclose golden twin (tests/test_ingraph.py).
+
+Numeric key space: grad keys 0..3 = (w1, b1, w2, b2), key 4 = the
+training-loss accumulator; partitionfn is integer math.
+
+Scope: optimizer state lives in module-level host state (updated by
+finalfn), so the example is **LocalExecutor / single-process**: a
+multi-process store-plane fleet would re-init per worker and never see
+finalfn's updates. That is the right trade for what this module is —
+the in-graph engine runs the data plane entirely in the server process
+anyway, and the store-plane twin exists to golden-diff it. The
+distributed checkpoint-backed packaging of the same workload remains
+mr_train.py. The model is deliberately small: job values must clear
+MAX_TASKFN_VALUE_SIZE (16KB serialized, reference utils.lua:54), which
+caps the parameters a state-threading task can carry.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+NUM_REDUCERS = 5
+W1, B1, W2, B2, LOSS = 0, 1, 2, 3, 4     # the numeric grad key space
+
+_cfg = {}
+_data = None
+_state = {}
+
+
+def init(args):
+    global _cfg, _data, _state
+    from lua_mapreduce_tpu.train.data import make_digits
+    _cfg = {
+        "dim": int(args.get("dim", 16)),
+        "hidden": int(args.get("hidden", 8)),
+        "classes": 10,
+        "n_shards": int(args.get("n_shards", 4)),
+        "bunch": int(args.get("bunch", 128)),      # init.lua:127-141
+        "lr": float(args.get("lr", 0.05)),
+        "momentum": float(args.get("momentum", 0.9)),
+        "max_steps": int(args.get("max_steps", 20)),
+        "seed": int(args.get("seed", 0)),
+    }
+    _data = make_digits(seed=_cfg["seed"], dim=_cfg["dim"])
+    rng = np.random.RandomState(_cfg["seed"])
+    scale = 1.0 / np.sqrt(_cfg["dim"])
+    # init RESETS the run (unlike mr_train's restore-from-checkpoint):
+    # every TaskSpec construction starts the same deterministic
+    # trajectory, which is what lets two executor legs golden-diff
+    _state = {
+        "params": {
+            "w1": (scale * rng.randn(_cfg["dim"], _cfg["hidden"])
+                   ).astype(np.float32),
+            "b1": np.zeros(_cfg["hidden"], np.float32),
+            "w2": (scale * rng.randn(_cfg["hidden"], _cfg["classes"])
+                   ).astype(np.float32),
+            "b2": np.zeros(_cfg["classes"], np.float32),
+        },
+        "vel": None,
+        "step": 0,
+        "finished": False,
+        "tr_loss": None,
+        "val_loss": None,
+    }
+    _state["vel"] = {k: np.zeros_like(v)
+                     for k, v in _state["params"].items()}
+
+
+def taskfn(emit):
+    # params + this step's deterministic minibatch indices ride every
+    # job value (state-threading contract, DESIGN §26) — same shapes
+    # every step, so the compiled plane never retraces
+    p = _state["params"]
+    x_train = _data[0]
+    for i in range(_cfg["n_shards"]):
+        rng = np.random.RandomState(
+            1000 + 7919 * _state["step"] + i)      # mr_train's schedule
+        idx = rng.randint(0, len(x_train), _cfg["bunch"])
+        emit(i, {"w1": p["w1"].tolist(), "b1": p["b1"].tolist(),
+                 "w2": p["w2"].tolist(), "b2": p["b2"].tolist(),
+                 "idx": idx.tolist()})
+
+
+def mapfn(key, value, emit):
+    w1 = jnp.asarray(value["w1"], jnp.float32)
+    b1 = jnp.asarray(value["b1"], jnp.float32)
+    w2 = jnp.asarray(value["w2"], jnp.float32)
+    b2 = jnp.asarray(value["b2"], jnp.float32)
+    idx = jnp.asarray(value["idx"], jnp.int32)
+    x = jnp.take(_data[0], idx, 0)
+    y = jnp.take(_data[1], idx, 0)
+
+    # forward: 2-layer tanh MLP + softmax cross-entropy (mean over the
+    # bunch) — then the hand-written backward pass (the oracle's
+    # surface has no jax.grad: a transformed function is an indirect
+    # call; the VJP below is the same gradient)
+    h = jnp.tanh(x @ w1 + b1)
+    logits = h @ w2 + b2
+    z = logits - jnp.max(logits, axis=1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(z), axis=1, keepdims=True))
+    onehot = jnp.asarray(y[:, None] == jnp.arange(b2.shape[0])[None, :],
+                         jnp.float32)
+    loss = -jnp.mean(jnp.sum(onehot * (z - lse), axis=1))
+
+    dlogits = (jnp.exp(z - lse) - onehot) / x.shape[0]
+    gw2 = jnp.transpose(h) @ dlogits
+    gb2 = jnp.sum(dlogits, axis=0)
+    dh = dlogits @ jnp.transpose(w2)
+    dpre = dh * (1.0 - h * h)
+    gw1 = jnp.transpose(x) @ dpre
+    gb1 = jnp.sum(dpre, axis=0)
+
+    emit(0, {"g": gw1, "count": 1})
+    emit(1, {"g": gb1, "count": 1})
+    emit(2, {"g": gw2, "count": 1})
+    emit(3, {"g": gb2, "count": 1})
+    emit(4, {"g": loss, "count": 1})
+
+
+def partitionfn(key):
+    return int(key) % NUM_REDUCERS
+
+
+def reducefn(key, values):
+    g = jnp.asarray(values[0]["g"])
+    c = jnp.asarray(values[0]["count"])
+    for i in range(1, len(values)):
+        g = g + jnp.asarray(values[i]["g"])
+        c = c + jnp.asarray(values[i]["count"])
+    return {"g": g, "count": c}
+
+
+reducefn.associative_reducer = True
+reducefn.commutative_reducer = True
+
+
+def _val_loss(params):
+    x, y = _data[2], _data[3]
+    h = np.tanh(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    z = logits - logits.max(axis=1, keepdims=True)
+    lse = np.log(np.exp(z).sum(axis=1, keepdims=True))
+    return float(-np.mean((z - lse)[np.arange(len(y)), y]))
+
+
+def finalfn(pairs):
+    names = {W1: "w1", B1: "b1", W2: "w2", B2: "b2"}
+    params, vel = _state["params"], _state["vel"]
+    tr_loss = None
+    grads = {}
+    for key, vs in pairs:
+        v = vs[0]
+        if int(key) == LOSS:
+            tr_loss = float(np.asarray(v["g"])) / v["count"]
+        else:
+            grads[names[int(key)]] = (np.asarray(v["g"], np.float32)
+                                      / v["count"])
+    for name, p in params.items():
+        step = (_cfg["momentum"] * vel[name]
+                - _cfg["lr"] * grads[name]).astype(np.float32)
+        vel[name] = step
+        params[name] = p + step
+    _state["step"] += 1
+    _state["tr_loss"] = tr_loss
+    _state["val_loss"] = _val_loss(params)
+    _state["finished"] = _state["step"] >= _cfg["max_steps"]
+    return False if _state["finished"] else "loop"
+
+
+def read_state():
+    """Final host state for tests/benches: params, step, losses."""
+    return _state
+
+
+def images_seen() -> int:
+    """Training images consumed so far (the bench's throughput
+    numerator): shards x bunch per completed step."""
+    return _state["step"] * _cfg["n_shards"] * _cfg["bunch"]

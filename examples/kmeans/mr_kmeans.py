@@ -7,15 +7,33 @@ with centroids in place of model weights:
 
     init        — build data; seed centroids into the persistent table
                   (the conf-table role, common.lua:57-77)
-    taskfn      — emit n_shards point shards
-    mapfn       — read centroids from the table; assign shard points;
-                  emit per-cluster partial (sum, count) + ("SSE", …)
-    partitionfn — cluster id hash % NUM_REDUCERS
+    taskfn      — read centroids from the table and THREAD THEM THROUGH
+                  the job values: emit n_shards jobs, each carrying the
+                  current centroids as an array-shaped record
+    mapfn       — pure array program: assign this shard's points to the
+                  centroids riding the job value; emit per-cluster masked
+                  partial (sum, count) + the SSE under the sentinel key k
+    partitionfn — cluster id % NUM_REDUCERS (numeric keys)
     reducefn    — elementwise partial sums (assoc+commut+idempotent flags
                   → combiner + merge fast path, SURVEY.md §2.5)
     finalfn     — recompute centroids, commit to the table, loop until
                   the max centroid shift < tol (the "loop" protocol,
                   server.lua:387-403)
+
+**In-graph eligible (DESIGN §26).** The data-plane functions are written
+against the static lowerability oracle's surface (analysis/contracts.py):
+mapfn/reducefn are jnp-only array programs over array-shaped records,
+partitionfn is pure integer math, and all cross-iteration state
+(centroids) enters through the taskfn job values — so under
+``engine="auto"`` the whole map→shuffle→reduce runs as ONE jitted
+program (engine/ingraph.py), re-fed fresh centroid arrays each "loop"
+iteration without retracing. The same module runs unchanged on the
+distributed store plane (``engine="store"``) — emitted jax arrays
+normalize to plain records via core/serialize.to_plain — which is the
+golden twin the compiled plane is allclose-diffed against
+(tests/test_ingraph.py). Emission structure is uniform across jobs
+(every shard emits every cluster key exactly once, empty clusters as
+masked zero-sums), which is what the collective lowering tier requires.
 
 The TPU-native fast path of the same algorithm is models/kmeans.py; the
 two must agree (golden-diff discipline, SURVEY.md §4) — see
@@ -31,6 +49,7 @@ pointed at the same MongoDB by its connection string,
 execute_server.lua:25-35).
 """
 
+import jax.numpy as jnp
 import numpy as np
 
 from lua_mapreduce_tpu.coord.filestore import FileJobStore
@@ -76,44 +95,52 @@ def init(args):
 
 
 def taskfn(emit):
+    # the state-threading contract (DESIGN §26): centroids ride every
+    # job value as an array-shaped record, so on the compiled plane the
+    # loop re-feeds fresh arrays into the SAME jitted program each
+    # iteration (same shapes → zero retrace), and on the store plane
+    # mapfn no longer reads the persistent table per job
+    pt = _table(read_only=True)
+    centroids = pt["centroids"]
     for i in range(_cfg["n_shards"]):
-        emit(i, i)
+        emit(i, {"centroids": centroids})
 
 
-def _shard_points(shard: int) -> np.ndarray:
+def _shard_points(shard):
     return _x[int(shard)::_cfg["n_shards"]]
 
 
-def mapfn(key, shard, emit):
-    pt = _table(read_only=True)
-    centroids = np.asarray(pt["centroids"], np.float32)
-    x = _shard_points(shard)
-    d2 = (np.sum(x ** 2, axis=1)[:, None]
-          - 2.0 * x @ centroids.T
-          + np.sum(centroids ** 2, axis=1)[None, :])
-    nearest = np.argmin(d2, axis=1)
-    sse = float(d2[np.arange(len(x)), nearest].sum())
-    for j in range(centroids.shape[0]):
+def mapfn(key, value, emit):
+    c = jnp.asarray(value["centroids"], jnp.float32)
+    x = jnp.asarray(_shard_points(key), jnp.float32)
+    d2 = (jnp.sum(x * x, axis=1)[:, None] - 2.0 * (x @ jnp.transpose(c))
+          + jnp.sum(c * c, axis=1)[None, :])
+    nearest = jnp.argmin(d2, axis=1)
+    # every cluster key is emitted by every shard (masked zero partials
+    # for empty assignments): uniform emission structure is the
+    # collective lowering tier's contract, and finalfn's count>0 guard
+    # keeps the empty-partition tolerance (SURVEY.md §6)
+    for j in range(c.shape[0]):
         sel = nearest == j
-        if sel.any():       # empty partitions are tolerated (SURVEY.md §6)
-            emit(int(j), {"sum": x[sel].sum(axis=0).tolist(),
-                          "count": int(sel.sum())})
-    emit("SSE", {"sse": sse})
+        emit(j, {"sum": jnp.sum(jnp.where(sel[:, None], x, 0.0), axis=0),
+                 "count": jnp.sum(sel)})
+    # the SSE rides under the sentinel key k (one past the last cluster
+    # id) — numeric keys keep partitionfn pure integer math
+    emit(c.shape[0], {"sum": jnp.sum(jnp.min(d2, axis=1)),
+                      "count": x.shape[0]})
 
 
 def partitionfn(key):
-    return sum(str(key).encode()) % NUM_REDUCERS
+    return int(key) % NUM_REDUCERS
 
 
 def reducefn(key, values):
-    if key == "SSE":
-        return {"sse": sum(v["sse"] for v in values)}
-    acc = np.asarray(values[0]["sum"], np.float64)
-    count = values[0]["count"]
-    for v in values[1:]:
-        acc = acc + np.asarray(v["sum"], np.float64)
-        count += v["count"]
-    return {"sum": acc.tolist(), "count": count}
+    s = jnp.asarray(values[0]["sum"])
+    c = jnp.asarray(values[0]["count"])
+    for i in range(1, len(values)):
+        s = s + jnp.asarray(values[i]["sum"])
+        c = c + jnp.asarray(values[i]["count"])
+    return {"sum": s, "count": c}
 
 
 reducefn.associative_reducer = True
@@ -125,12 +152,16 @@ def finalfn(pairs):
     pt = _table()
     old = np.asarray(pt["centroids"], np.float32)
     new = old.copy()
+    k = old.shape[0]
     sse = None
     for key, vs in pairs:
         v = vs[0]
-        if key == "SSE":
-            sse = v["sse"]
-        else:
+        if int(key) == k:
+            sse = float(np.asarray(v["sum"]))
+        elif v["count"] > 0:
+            # empty clusters (count 0 masked partials) keep their old
+            # centroid — the pre-conversion semantics, where an empty
+            # cluster simply emitted no pair
             new[int(key)] = np.asarray(v["sum"], np.float64) / v["count"]
     shift = float(np.abs(new - old).max())
     it = pt["iter"] + 1

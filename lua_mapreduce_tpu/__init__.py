@@ -39,6 +39,9 @@ _LAZY = {
                             "PermanentStoreError"),
     "RetryPolicy": ("lua_mapreduce_tpu.faults.retry", "RetryPolicy"),
     "FaultPlan": ("lua_mapreduce_tpu.faults.plan", "FaultPlan"),
+    # in-graph engine (DESIGN §26)
+    "InGraphEngine": ("lua_mapreduce_tpu.engine.ingraph", "InGraphEngine"),
+    "LoweringError": ("lua_mapreduce_tpu.engine.ingraph", "LoweringError"),
     # lmr-trace (DESIGN §22)
     "Tracer": ("lua_mapreduce_tpu.trace.span", "Tracer"),
     "TraceCollection": ("lua_mapreduce_tpu.trace.collect",
@@ -73,6 +76,8 @@ __all__ = [
     "MemJobStore",
     "FileJobStore",
     "PersistentTable",
+    "InGraphEngine",
+    "LoweringError",
     "StoreError",
     "TransientStoreError",
     "PermanentStoreError",
@@ -96,8 +101,8 @@ def utest():
     from lua_mapreduce_tpu import analysis, faults, sched, trace
     from lua_mapreduce_tpu.core import heap, merge, segment, serialize
     from lua_mapreduce_tpu.coord import jobstore, persistent_table
-    from lua_mapreduce_tpu.engine import (contract, placement, premerge,
-                                          push, server, worker)
+    from lua_mapreduce_tpu.engine import (contract, ingraph, placement,
+                                          premerge, push, server, worker)
     from lua_mapreduce_tpu.store import memfs, router
     from lua_mapreduce_tpu.utils import stats
 
@@ -105,9 +110,12 @@ def utest():
     # where any jax compute would initialize — and hang on — a wedged
     # accelerator tunnel; jax-computing modules (ops/*) self-test under
     # the cpu-pinned pytest conftest instead (tests/test_q8.py etc.)
+    # ingraph's utest is host-only by design (knob resolution + the
+    # static oracle consult); its compiled tiers live in
+    # tests/test_ingraph.py under the cpu-pinned conftest
     for mod in (tuples, heap, serialize, segment, merge, jobstore, memfs,
                 contract, router, persistent_table, stats, placement,
-                premerge, push, worker, server, analysis, faults, trace,
-                sched):
+                premerge, push, worker, server, ingraph, analysis, faults,
+                trace, sched):
         if hasattr(mod, "utest"):
             mod.utest()

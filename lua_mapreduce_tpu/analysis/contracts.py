@@ -24,8 +24,9 @@ Three layers, in increasing strictness:
    and unordered iteration (sets, unsorted ``os.listdir``/``glob``)
    all break that assumption silently.
 
-3. **Lowerability** — the three-way verdict ROADMAP item 3's
-   ``engine/ingraph.py`` consumes, per function:
+3. **Lowerability** — the three-way verdict the in-graph engine
+   (``engine/ingraph.py``, DESIGN §26) consumes at task-load time for
+   its ``engine="auto"`` selection, per function:
 
    - ``in-graph``     — a pure array/numeric program (arithmetic,
      subscripts, numeric builtins, jnp/np/math calls, eligible local

@@ -136,6 +136,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "evict to the staged spill path — graceful "
                         "degradation instead of OOM (counted "
                         "push_evictions)")
+    p.add_argument("--engine", choices=("auto", "ingraph", "store"),
+                   default=None,
+                   help="execution engine (docs/DESIGN.md §26; default "
+                        "auto, or LMR_ENGINE): 'auto' consults the "
+                        "static lowerability oracle at task load and "
+                        "compiles in-graph-verdicted tasks to ONE "
+                        "jitted shard_map program running on this "
+                        "server (no jobs dispatched), falling back to "
+                        "the distributed store plane otherwise — a "
+                        "logged, traced ('lowering' span) decision; "
+                        "'ingraph' forces the compiled plane and "
+                        "RAISES on any lowering failure (the CI hard "
+                        "mode); 'store' opts out. Written to the task "
+                        "doc and sticky on resume")
     p.add_argument("--trace", action="store_true",
                    help="lmr-trace (docs/DESIGN.md §22): record "
                         "claim/body/publish/commit spans and per-op "
@@ -204,7 +218,8 @@ def main(argv=None) -> int:
                     replication=args.replication,
                     speculation=args.speculation_factor,
                     speculation_cap=args.speculation_cap,
-                    push=args.push).configure(spec)
+                    push=args.push,
+                    engine=args.engine).configure(spec)
 
     for _ in range(args.inline_workers):
         w = Worker(store).configure(max_iter=10_000)

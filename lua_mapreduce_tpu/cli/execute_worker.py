@@ -57,6 +57,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "64, or LMR_PUSH_BUDGET_MB): over-budget "
                         "partitions evict to the staged spill path "
                         "instead of OOMing (counted push_evictions)")
+    p.add_argument("--engine", choices=("auto", "ingraph", "store"),
+                   default=None,
+                   help="execution engine (docs/DESIGN.md §26) — "
+                        "fleet-launcher parity: in-graph iterations run "
+                        "ON THE SERVER (this worker simply sees no jobs "
+                        "for them), so the flag only validates and "
+                        "exports LMR_ENGINE for any LocalExecutor the "
+                        "user task spawns in-process; a launcher can "
+                        "pass one uniform --engine to every process")
     p.add_argument("--phases", default="map,reduce",
                    help="comma list of phases this worker claims "
                         "(heterogeneous pools: dedicated mapper hosts "
@@ -99,6 +108,10 @@ def main(argv=None) -> int:
     if args.trace:
         from lua_mapreduce_tpu.trace.span import Tracer, install_tracer
         install_tracer(Tracer(annotate=bool(args.profile)))
+    if args.engine is not None:
+        import os
+        from lua_mapreduce_tpu.engine.ingraph import resolve_engine
+        os.environ["LMR_ENGINE"] = resolve_engine(args.engine)
     phases = tuple(s.strip() for s in args.phases.split(",") if s.strip())
     for ph in phases:
         if ph not in ("map", "reduce"):

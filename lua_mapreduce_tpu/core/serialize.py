@@ -82,6 +82,54 @@ def _plain(v: Any) -> Any:
     return v
 
 
+def _needs_plain(v: Any) -> bool:
+    """Does ``v`` contain anything :func:`to_plain` would convert?
+    The identity probe that keeps the hot store-plane emit path
+    allocation-free: plain scalars and containers of them answer False
+    without any rebuilding."""
+    if v is None or type(v) in (bool, int, float, str):
+        return False
+    if isinstance(v, dict):
+        return any(_needs_plain(x) for x in v.values())
+    if isinstance(v, (list, tuple)):
+        return any(_needs_plain(x) for x in v)
+    return True
+
+
+def to_plain(v: Any) -> Any:
+    """Normalize an emitted value to the plain-Python record surface.
+
+    IDENTITY — the original object, no copies — for everything the
+    engine historically carried: None/bool/int/float/str and containers
+    of them (emit is the engine's hottest loop; a deep rebuild per
+    record would tax every store-plane map job). Array-likes (numpy
+    ndarrays/scalars, concrete jax arrays — anything exposing
+    ``tolist``) convert to nested Python lists / scalars, which is
+    byte-identical to the user having called ``.tolist()`` before
+    emitting; containers holding them are rebuilt (tuples as lists).
+    This is the ONE conversion point both execution planes share: the
+    store plane applies it at emit, at combiner output, and at reduce
+    output (engine/job.py), the in-graph engine applies it to fetched
+    device results (engine/ingraph.py) — so a task written against jnp
+    arrays serializes to the same record bytes on either plane.
+
+    A JAX TRACER reaching this path raises jax's own concretization
+    error (``tolist`` on a tracer): in-graph user code leaked a traced
+    value onto the host path, and silently stringifying it would
+    corrupt records — loud is correct.
+    """
+    if not _needs_plain(v):
+        return v
+    if isinstance(v, dict):
+        return {k: to_plain(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [to_plain(x) for x in v]
+    tolist = getattr(v, "tolist", None)
+    if tolist is not None:
+        return to_plain(tolist())
+    return v
+
+
 def serialized_size(value: Any) -> int:
     """Byte size of a value's serialized form — used for the taskfn value cap
     (reference server.lua:263-267, MAX_TASKFN_VALUE_SIZE)."""
@@ -195,6 +243,17 @@ def utest() -> None:
     assert key_lt("a", "b")
     assert key_lt((1, 2), (1, 3)) and key_lt((1,), (1, 2))
     assert sorted_keys(["b", 2, "a", 1]) == [1, 2, "a", "b"]
+
+    # to_plain: IDENTITY (same object) for plain shapes, tolist for
+    # array-likes, container rebuild only when a leaf converted
+    plain = {"a": [1, 2.5, "x"], "b": None}
+    assert to_plain(plain) is plain
+    assert to_plain(tuples.intern((1, 2))) is tuples.intern((1, 2))
+    import numpy as _np
+    assert to_plain(_np.int32(3)) == 3 and type(to_plain(_np.int32(3))) is int
+    assert to_plain(_np.arange(3)) == [0, 1, 2]
+    assert to_plain({"g": _np.float32(1.5)}) == {"g": 1.5}
+    assert to_plain((1, _np.int32(2))) == [1, 2]
 
     assert serialized_size("xx") == 4  # '"xx"'
     try:

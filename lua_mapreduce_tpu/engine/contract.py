@@ -23,6 +23,17 @@ Reducer property flags live on the reducefn's module
 ``commutative_reducer``, ``idempotent_reducer``. All three together enable
 the map-side combiner-by-reducefn and the merge fast path
 (job.lua:104-106, 264-284).
+
+One contract, two execution planes (DESIGN §26): a resolved TaskSpec
+runs per-record on the distributed store plane (engine/job.py), and —
+when the static lowerability oracle (analysis/contracts.py) verdicts
+its data-plane functions ``in-graph`` — as ONE jitted shard_map program
+on the compiled plane (engine/ingraph.py), selected automatically by
+the executors' ``engine="auto"`` knob. The associative+commutative
+flags additionally license the compiled plane's psum fold tier. The
+hand-written array-native surface (explicitly traced tasks rather than
+auto-lowered ones) remains parallel/array_task.ArrayTaskSpec +
+parallel/tpu_engine.TpuExecutor.
 """
 
 from __future__ import annotations

@@ -22,7 +22,7 @@ from lua_mapreduce_tpu.core.native_merge import (native_merge_records,
                                                  native_premerge)
 from lua_mapreduce_tpu.core.segment import check_format
 from lua_mapreduce_tpu.core.serialize import (assert_serializable, dump_record,
-                                              sorted_keys)
+                                              sorted_keys, to_plain)
 from lua_mapreduce_tpu.engine.contract import TaskSpec
 from lua_mapreduce_tpu.faults.replicate import reading_view, spill_writer
 from lua_mapreduce_tpu.store.base import Store
@@ -52,16 +52,24 @@ def make_map_emit(result: Dict[Any, List[Any]], combiner):
     Groups values per interned key in memory; when a key accumulates more
     than MAX_MAP_RESULT values and a combiner exists, combine in place
     (job.lua:92-96) to bound memory.
+
+    Emitted keys/values pass through :func:`to_plain` first (identity
+    for the historical plain-Python surface): array-emitting tasks —
+    the in-graph-eligible numeric style, DESIGN §26 — serialize on this
+    plane exactly as if the user had called ``.tolist()``, which is
+    what keeps the two execution planes' record bytes comparable.
     """
     def emit(key: Any, value: Any) -> None:
-        key = _intern_if_seq(key)
-        value = _intern_if_seq(value)
+        key = _intern_if_seq(to_plain(key))
+        value = _intern_if_seq(to_plain(value))
         bucket = result.get(key)
         if bucket is None:
             bucket = result[key] = []
         bucket.append(value)
         if combiner is not None and len(bucket) > MAX_MAP_RESULT:
-            result[key] = [combiner(key, bucket)]
+            # combiner output normalizes like emitted values do — a
+            # jnp-style combinerfn (DESIGN §26) returns arrays
+            result[key] = [to_plain(combiner(key, bucket))]
     return emit
 
 
@@ -175,7 +183,9 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
         for key in sorted_keys(result.keys()):
             values = result[key]
             if combiner is not None and len(values) > 1:
-                values = [combiner(key, values)]
+                # same to_plain normalization as the emit path — an
+                # array-returning combinerfn must not crash the spill
+                values = [to_plain(combiner(key, values))]
             for v in values:
                 assert_serializable(v, f"map value for key {key!r}")
             part = int(spec.partitionfn(key))
@@ -326,7 +336,10 @@ def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
             if fast and len(values) == 1:
                 reduced = values[0]
             else:
-                reduced = reducefn(key, values)
+                # array-valued reducefn outputs (the in-graph-eligible
+                # numeric style) normalize to the plain record surface
+                # exactly like emitted map values do
+                reduced = to_plain(reducefn(key, values))
             assert_serializable(reduced, f"reduce value for key {key!r}")
             builder.write(dump_record(key, [reduced]) + "\n")
         times.finished = time.time()

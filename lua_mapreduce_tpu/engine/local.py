@@ -124,7 +124,8 @@ class LocalExecutor:
                  batch_k: int = 1, segment_format: str = "v1",
                  replication: Optional[int] = None,
                  push: Optional[bool] = None,
-                 push_budget_mb: Optional[float] = None):
+                 push_budget_mb: Optional[float] = None,
+                 engine: Optional[str] = None):
         self.spec = spec
         self.map_parallelism = max(1, map_parallelism)
         self.max_iterations = max_iterations
@@ -163,6 +164,21 @@ class LocalExecutor:
         self._view = reading_view(self.store, self.replication)
         self.result_store = (get_storage_from(spec.result_storage)
                              if spec.result_storage else self.store)
+        # execution engine (DESIGN §26; None = LMR_ENGINE env, else
+        # "auto"): "auto" consults the static lowerability oracle at
+        # task load and runs in-graph-verdicted tasks as ONE jitted
+        # shard_map program (engine/ingraph.py) — falling back to this
+        # store plane on any non-in-graph verdict or trace-time
+        # failure; "ingraph" forces the compiled plane (failures
+        # raise); "store" opts out. The decision is a `lowering` trace
+        # span either way.
+        from lua_mapreduce_tpu.engine.ingraph import (IngraphRunner,
+                                                      select_engine)
+        self.engine_decision = select_engine(spec, engine)
+        self.engine = self.engine_decision.chosen
+        self._ingraph = IngraphRunner(
+            spec, self.engine_decision,
+            log=lambda m: print(f"[local] {m}", file=sys.stderr))
         self.stats = TaskStats()
         self.finished_value: Any = None
 
@@ -228,8 +244,19 @@ class LocalExecutor:
             from lua_mapreduce_tpu.engine.push import sweep_push_files
             sweep_push_files(self._view, spec.result_ns)
 
-        jobs = collect_task_jobs(spec)
-        if self.pipeline:
+        # in-graph engine (DESIGN §26): the whole data plane — map,
+        # shuffle, reduce — runs as one jitted program and the result
+        # files land directly; taskfn/finalfn stay host-side below. A
+        # trace-time failure under engine=auto degrades to the store
+        # plane permanently (counted ingraph_fallbacks, logged, traced)
+        # and THIS iteration re-runs through the store path right here.
+        ran_ingraph = self._ingraph.active and \
+            self._ingraph.run_iteration(self.result_store, iteration)
+
+        if ran_ingraph:
+            pass                 # results published by the compiled plane
+        elif self.pipeline:
+            jobs = collect_task_jobs(spec)
             (map_times, pre_times, pre_failed,
              reduce_times) = self._run_pipelined(jobs)
             it_stats.map.fold(map_times)
@@ -237,6 +264,7 @@ class LocalExecutor:
             it_stats.overlap_fraction = overlap_fraction(map_times, pre_times)
             it_stats.reduce.fold(reduce_times)
         else:
+            jobs = collect_task_jobs(spec)
             map_times = self._run_jobs([
                 (lambda k=k, v=v, i=i: self._traced(
                     "map", i, lambda: run_map_job(

@@ -128,7 +128,8 @@ class Server:
                  replication: Optional[int] = None,
                  speculation: Optional[float] = None,
                  speculation_cap: int = 2,
-                 push: Optional[bool] = None):
+                 push: Optional[bool] = None,
+                 engine: Optional[str] = None):
         # coord RPCs ride the transient-fault retry layer (DESIGN §19);
         # the scavenge/requeue/drain housekeeping must not abort an
         # iteration over one store blip
@@ -174,6 +175,18 @@ class Server:
         # push-off resume's discovery would not consult.
         from lua_mapreduce_tpu.engine.push import resolve_push
         self.push = resolve_push(push)
+        # execution engine (DESIGN §26; None = LMR_ENGINE env, else
+        # "auto"): "auto" consults the static lowerability oracle at
+        # task load — an in-graph-verdicted task's data plane runs as
+        # ONE jitted program ON THIS SERVER (no jobs inserted; the
+        # worker pool idles through those iterations) and falls back
+        # to the distributed store plane on any non-in-graph verdict
+        # or trace failure; "ingraph" forces (failures raise); "store"
+        # opts out. Task-doc deployed like push/replication, and
+        # STICKY on resume so a crashed run keeps its plane.
+        from lua_mapreduce_tpu.engine.ingraph import resolve_engine
+        self.engine = resolve_engine(engine)
+        self._ingraph = None           # IngraphRunner, built in loop()
         self.spec: Optional[TaskSpec] = None
         self.stats = TaskStats()
         self.finished_value: Any = None
@@ -296,6 +309,14 @@ class Server:
                     check_replication
                 self.replication = check_replication(
                     task.get("replication", self.replication) or 1)
+                # the engine knob is sticky like the shuffle mode: a
+                # crashed in-graph run inserted no jobs, so a store
+                # resume would wait on phases that never open (and the
+                # reverse would strand claimable jobs) — the doc wins
+                from lua_mapreduce_tpu.engine.ingraph import \
+                    resolve_engine as _resolve_engine
+                self.engine = _resolve_engine(
+                    task.get("engine", self.engine))
                 # batch_k / segment_format are perf knobs with no
                 # crash-consistency tie to on-disk state (readers sniff
                 # spill formats per file; unlike the shuffle mode), so
@@ -306,7 +327,8 @@ class Server:
                     "batch_k": self.batch_k,
                     "segment_format": self.segment_format,
                     "replication": self.replication,
-                    "speculation": self.speculation})
+                    "speculation": self.speculation,
+                    "engine": self.engine})
                 self._notify_jobs()
                 if status == TaskStatus.REDUCE.value:
                     skip_map = True
@@ -336,6 +358,9 @@ class Server:
                 # the straggler factor (DESIGN §21): nonzero makes idle
                 # workers probe for speculative duplicate leases
                 "speculation": self.speculation,
+                # the execution engine knob (DESIGN §26), sticky on
+                # resume like the shuffle mode
+                "engine": self.engine,
                 "started": time.time(),
             })
             self._notify_jobs()      # task appeared: wake waiting workers
@@ -363,6 +388,18 @@ class Server:
         result_store = (get_storage_from(self.spec.result_storage)
                         if self.spec.result_storage else self._data_store)
 
+        # engine selection (DESIGN §26): consult the oracle once per
+        # task load; the decision is a `lowering` trace span and the
+        # chosen plane is logged. In-graph iterations run on THIS
+        # server — the fleet's TPU-plane host — with no jobs inserted.
+        from lua_mapreduce_tpu.engine.ingraph import (IngraphRunner,
+                                                      select_engine)
+        decision = select_engine(self.spec, self.engine)
+        self._ingraph = IngraphRunner(self.spec, decision,
+                                      log=self._ingraph_log)
+        if decision.chosen == "ingraph":
+            self._log(f"engine: in-graph ({decision.reason})")
+
         while True:
             self._spill_repairs.clear()
             self._spec_taken_at.clear()
@@ -375,7 +412,22 @@ class Server:
             rounds0 = self.store.round_counts()
             faults0 = COUNTERS.snapshot()
 
-            if not skip_map:
+            # in-graph engine (DESIGN §26): the data plane runs as one
+            # jitted program on this server — no jobs, no phases, the
+            # result files land directly. A trace-time failure under
+            # engine=auto degrades to the store plane permanently
+            # (counted, logged, traced, doc-recorded) and THIS
+            # iteration re-runs through the normal phases below.
+            ingraph_done = False
+            if not skip_map and self._ingraph.active:
+                delete_results(result_store, self.spec.result_ns)
+                ingraph_done = self._ingraph.run_iteration(result_store,
+                                                           iteration)
+                if not ingraph_done:
+                    self.store.update_task({"engine": "store"})
+                    self.engine = "store"
+
+            if not skip_map and not ingraph_done:
                 delete_results(result_store, self.spec.result_ns)
                 n_map = self._prepare_map(store)
                 with self._phase_span("map", iteration):
@@ -395,12 +447,14 @@ class Server:
                                                                  pre_times)
             skip_map = False
 
-            n_red = self._prepare_reduce(store)
-            if n_red:
-                with self._phase_span("reduce", iteration):
-                    self._wait_phase(RED_NS, n_red, "reduce", progress)
-            it_stats.reduce.fold(self._phase_times(RED_NS),
-                                 failed=self.store.counts(RED_NS)[Status.FAILED])
+            if not ingraph_done:
+                n_red = self._prepare_reduce(store)
+                if n_red:
+                    with self._phase_span("reduce", iteration):
+                        self._wait_phase(RED_NS, n_red, "reduce", progress)
+                it_stats.reduce.fold(
+                    self._phase_times(RED_NS),
+                    failed=self.store.counts(RED_NS)[Status.FAILED])
 
             verdict: Any = None
             if self.spec.finalfn is not None:
@@ -1055,6 +1109,13 @@ class Server:
     def _log(self, msg: str) -> None:
         if self.verbose:
             print(f"[server] {msg}", flush=True)
+
+    def _ingraph_log(self, msg: str) -> None:
+        """Engine-selection/fallback messages surface unconditionally
+        (the pre_merge-failure stderr convention): a silent plane
+        switch is exactly what DESIGN §26 forbids."""
+        import sys
+        print(f"[server] ingraph: {msg}", file=sys.stderr, flush=True)
 
 
 def utest() -> None:

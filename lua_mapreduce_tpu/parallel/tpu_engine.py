@@ -20,6 +20,16 @@ Everything under jit is traced once: no data-dependent Python control flow,
 static shapes, XLA-fused combiners (the MAX_MAP_RESULT streaming threshold
 of the host path, job.lua:92-96, has no device analog — on TPU the combine
 is a register/VMEM-level fusion, which is the whole point).
+
+This module is the EXPLICIT array-native surface: users hand it an
+:class:`ArrayTaskSpec` already written as a traceable array program.
+Since the fusion of the repo's two halves (DESIGN §26), ordinary
+six-function tasks (engine/contract.TaskSpec) whose data plane the
+static oracle verdicts ``in-graph`` reach this plane AUTOMATICALLY:
+engine/ingraph.py lowers them to the same shard_map-over-mesh shapes,
+reusing this module's ``_CROSS`` collective table and parallel/mesh.py
+rather than reimplementing them — TpuExecutor stays the right tool
+when you want to write the array program yourself.
 """
 
 from __future__ import annotations

@@ -43,7 +43,13 @@ def report(col) -> dict:
             "phases": col.phase_waterfall(),
             "premerge_overlap": col.premerge_overlap(),
             "ops": col.op_stats(),
-            "speculation": col.speculation_outcomes()}
+            "speculation": col.speculation_outcomes(),
+            # engine per iteration + the lowering decision chain
+            # (DESIGN §26): a silent in-graph→store fallback must be
+            # visible in the default report, not only in raw spans
+            "engines": {str(it): eng for it, eng
+                        in col.engines_by_iteration().items()},
+            "lowering": col.lowering_decisions()}
 
 
 def _bar(frac: float, width: int = 32) -> str:
@@ -67,6 +73,18 @@ def render_text(col, top: int) -> str:
             out.append(f"  {r['phase']:>10} |{bar:<32}| "
                        f"{r['window_s']:8.3f}s window  "
                        f"{r['busy_s']:8.3f}s busy  {r['jobs']} jobs")
+    if rep["engines"]:
+        parts = [f"it{it}={eng}" for it, eng in rep["engines"].items()]
+        out.append("\nengine per iteration: " + "  ".join(parts))
+    for d in rep["lowering"]:
+        if d["span"] == "lowering":
+            out.append(f"lowering: engine={d.get('engine')} "
+                       f"(requested={d.get('requested')}, "
+                       f"verdict={d.get('verdict')}) — "
+                       f"{d.get('reason', '')}")
+        else:
+            out.append(f"lowering: RUNTIME FALLBACK it{d['it']} — "
+                       f"{d.get('reason', '')}")
     if rep["premerge_overlap"] is not None:
         out.append(f"\npre-merge overlap (from spans): "
                    f"{rep['premerge_overlap']:.2%} "

@@ -173,6 +173,41 @@ class TraceCollection:
                                 "claim->commit window")
         return problems
 
+    # -- engine selection (in-graph lowering, DESIGN §26) -------------------
+
+    def lowering_decisions(self) -> List[dict]:
+        """The ``lowering`` spans' payloads — the engine-selection
+        decision (requested/chosen engine, oracle verdict, per-function
+        reasons) plus any runtime ``ingraph.fallback`` degrades, in
+        time order: the timeline proof that a store-plane fallback was
+        a DECISION, not a silent drop."""
+        out = []
+        for s in sorted(self.spans, key=lambda s: (s["t0"], s["t1"])):
+            if s["name"] in ("lowering", "ingraph.fallback"):
+                entry = {"span": s["name"], "it": s.get("it", 0),
+                         "t0": s["t0"]}
+                entry.update(s.get("attrs") or {})
+                out.append(entry)
+        return out
+
+    def engines_by_iteration(self) -> Dict[int, str]:
+        """Which engine actually executed each iteration's data plane:
+        ``ingraph`` when the compiled program ran (an ``ingraph.run``
+        span), ``store`` when job bodies / phase barriers did. An
+        iteration showing BOTH ran in-graph first and degraded mid-
+        iteration — it reports as ``store`` (that is where its results
+        came from), with the fallback visible in
+        :meth:`lowering_decisions`."""
+        out: Dict[int, str] = {}
+        for s in self.spans:
+            it = s.get("it", 0)
+            if s["name"].endswith(_BODY_SUFFIX) \
+                    or s["name"].startswith("phase."):
+                out[it] = "store"
+            elif s["name"] == "ingraph.run":
+                out.setdefault(it, "ingraph")
+        return {it: out[it] for it in sorted(out)}
+
     def speculation_outcomes(self) -> List[dict]:
         """Per speculated (iteration, job): the winner/loser shape of
         its duplicate execution. ``winner`` is the worker whose commit
@@ -398,6 +433,26 @@ def utest() -> None:
     assert rows["map"]["jobs"] == 4 and rows["pre_merge"]["jobs"] == 1
     top = col.slowest_jobs(1)
     assert top[0]["job"] == 1 and top[0]["executions"] == 2
+
+    # engine surfacing (DESIGN §26): the lowering decision chain and
+    # the per-iteration engine map, mid-run fallback included —
+    # iteration 2 starts in-graph, degrades, and finishes on the store
+    # plane, so it must report as "store" with the fallback listed
+    espans = [
+        sp("lowering", -1.0, -0.9, ns="ingraph", job=None,
+           engine="ingraph", requested="auto", verdict="in-graph"),
+        sp("ingraph.run", 0.0, 1.0, ns="ingraph", job=1, it=1),
+        sp("ingraph.fallback", 1.5, 1.5, ns="ingraph", job=None, it=2,
+           reason="boom"),
+        sp("map.body", 2.0, 3.0, it=2),
+    ]
+    ecol = TraceCollection(espans)
+    assert ecol.engines_by_iteration() == {1: "ingraph", 2: "store"}
+    decs = ecol.lowering_decisions()
+    assert decs[0]["span"] == "lowering" and decs[0]["engine"] == "ingraph"
+    assert decs[1]["span"] == "ingraph.fallback" \
+        and decs[1]["reason"] == "boom"
+    assert col.lowering_decisions() == []      # untouched runs: empty
 
     doc = col.to_chrome()
     assert validate_chrome(doc) == []

@@ -78,6 +78,8 @@ COUNTER_FOLD = {
     "spec_wasted_s": ("spec_wasted_s",),
     "push_frames": ("push_frames",),
     "push_evictions": ("push_evictions",),
+    "ingraph_iterations": ("ingraph_iterations",),
+    "ingraph_fallbacks": ("ingraph_fallbacks",),
 }
 _FLOAT_COUNTERS = frozenset({"spec_wasted_s"})
 
@@ -141,6 +143,15 @@ class IterationStats:
     #                    path under memory-budget pressure (the
     #                    degrade-to-staged rung; >0 proves a budgeted
     #                    run survived via eviction, not OOM)
+    # in-graph engine accounting (DESIGN §26), same fold:
+    #   ingraph_iterations — iterations whose whole data plane ran as
+    #                        the compiled shard_map/jit program
+    #                        (engine/ingraph.py) instead of store jobs
+    #   ingraph_fallbacks  — runtime degrades to the store plane (the
+    #                        oracle accepted the task but lowering
+    #                        raised at trace time — logged, traced as
+    #                        an ``ingraph.fallback`` span, never a
+    #                        crash under engine=auto)
     store_retries: int = 0
     store_faults: int = 0
     infra_releases: int = 0
@@ -155,6 +166,8 @@ class IterationStats:
     spec_wasted_s: float = 0.0
     push_frames: int = 0
     push_evictions: int = 0
+    ingraph_iterations: int = 0
+    ingraph_fallbacks: int = 0
 
     def fold_fault_counters(self, delta: Dict[str, float]
                             ) -> "IterationStats":
@@ -203,6 +216,8 @@ class IterationStats:
             "spec_wasted_s": self.spec_wasted_s,
             "push_frames": self.push_frames,
             "push_evictions": self.push_evictions,
+            "ingraph_iterations": self.ingraph_iterations,
+            "ingraph_fallbacks": self.ingraph_fallbacks,
             "cluster_time": self.cluster_time,
             "wall_time": self.wall_time,
         }

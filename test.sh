@@ -89,12 +89,26 @@ echo "lmr-analyze: lint+deep clean, no stale suppressions, protocol model-checke
 # statically validate — plugin signatures, emit arity, determinism
 # hazards — and classify to its pinned lowerability verdict: the
 # wordcount matrix is store-plane (mapfn reads files), extsort is
-# store-plane with in-graph-eligible partition/reduce (the numeric
-# path ROADMAP item 3's engine/ingraph.py will lift), the sched bench
-# task is fully in-graph eligible
+# store-plane with in-graph-eligible partition/reduce (lifted by
+# engine/ingraph.py's jit tier when forced), the sched bench task is
+# fully in-graph eligible, and the converted iterative examples
+# (kmeans / ALS / digits SGD — state threaded through job values,
+# DESIGN §26) pin in-graph so engine=auto keeps compiling them
 python -m lua_mapreduce_tpu.analysis task examples.wordcount --expect store-plane
 python -m lua_mapreduce_tpu.analysis task examples.extsort.sorttask --expect store-plane --expect-ingraph-fn
 python -m lua_mapreduce_tpu.analysis task benchmarks/coord_task.py --expect store-plane
 python -m lua_mapreduce_tpu.analysis task benchmarks/sched_task.py --expect in-graph
+python -m lua_mapreduce_tpu.analysis task examples.kmeans.mr_kmeans --expect in-graph
+python -m lua_mapreduce_tpu.analysis task examples.als.mr_als --expect in-graph
+python -m lua_mapreduce_tpu.analysis task examples.digits.mr_sgd --expect in-graph
 echo "task contracts: all shipped task modules classify to their pinned verdicts"
+# in-graph engine smoke gate (DESIGN §26): the golden-diff suite —
+# integer workloads byte-identical compiled-vs-interpreted, float
+# workloads allclose, one compile per loop task, the
+# oracle-accepts/lowering-raises fallback degrading (never crashing)
+# with the counter bumped — plus a tiny paired bench round proving
+# plane selection + state agreement end-to-end on the CPU mesh
+JAX_PLATFORMS=cpu python -m pytest tests/test_ingraph.py -q
+JAX_PLATFORMS=cpu python benchmarks/ingraph_bench.py --smoke
+echo "ingraph smoke: compiled plane byte/allclose-identical, fallback degrades"
 python -m pytest tests/ -q --full
