@@ -288,9 +288,10 @@ def _replication_fields() -> dict:
     small live run of benchmarks/replication_bench (1 paired round,
     overhead only — the recovery legs need the distributed topology
     and stay in the committed artifact), then the committed artifact's
-    headline numbers: fault-free overhead of r=2, write amplification,
-    and the failover-vs-map-re-run recovery speedup. Never sinks the
-    flagship metric."""
+    headline numbers: fault-free overhead of r=2, write amplification
+    for r=2 and the erasure-coded 4+1/4+2 stripes (DESIGN §27), the
+    failover-vs-map-re-run recovery speedup, and the coded decode
+    ratios. Never sinks the flagship metric."""
     import os
     here = os.path.dirname(os.path.abspath(__file__))
     out = {}
@@ -321,6 +322,16 @@ def _replication_fields() -> dict:
             art["recovery"]["failover"]["recovery_s"]
         out["replication_map_rerun_recovery_s"] = \
             art["recovery"]["map_rerun"]["recovery_s"]
+        out["coded_write_amplification_4p1"] = \
+            art["coded_overhead"]["c4p1"]["write_amplification"]
+        out["coded_write_amplification_4p2"] = \
+            art["coded_overhead"]["c4p2"]["write_amplification"]
+        out["coded_decode_read_ms_per_file"] = \
+            art["decode_micro"]["decode_read_ms_per_file"]
+        out["coded_recovery_vs_failover"] = \
+            art["recovery"]["coded_recovery_vs_failover"]
+        out["coded_recovery_speedup_vs_rerun"] = \
+            art["recovery"]["coded_recovery_speedup_vs_rerun"]
     except Exception:
         pass
     return out

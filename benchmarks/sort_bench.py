@@ -27,7 +27,15 @@ the fleet actually flushed — not inferred from wall clocks.
 Outputs are byte-compared across legs AND checked globally sorted (the
 range partitioner makes partition order the total order).
 
-Usage: python benchmarks/sort_bench.py [--smoke] [n_workers] [total_mb] [rounds]
+``--smoke-coded`` is the erasure-coded acceptance leg (DESIGN §27):
+the same extsort scenario under ``coding="4+1"`` with one data block
+of EVERY stripe destroyed at the reduce barrier — the coded analog of
+"every primary destroyed" — must decode inline to byte-identical,
+globally sorted output with zero map re-runs and zero repetition
+charges.
+
+Usage: python benchmarks/sort_bench.py [--smoke|--smoke-coded]
+                                       [n_workers] [total_mb] [rounds]
 Artifact: benchmarks/results/sort.json
 """
 
@@ -273,8 +281,113 @@ def smoke() -> dict:
     return out
 
 
+def smoke_coded() -> dict:
+    """The test.sh coded-shuffle chaos gate (DESIGN §27): the extsort
+    scenario on the distributed engine under ``coding="4+1"``, with the
+    FIRST data block of EVERY stripe destroyed at the reduce barrier —
+    the coded analog of the replication gate's "every primary
+    destroyed" (any ≤ m losses per stripe). The reducers must decode
+    inline from the k survivors: byte-identical to the uncoded
+    fault-free twin, globally sorted, ``decode_reads > 0``, ZERO map
+    re-runs, ZERO repetition charges. Fast, no artifact written."""
+    import threading
+
+    from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+    from lua_mapreduce_tpu.core.constants import Status
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.server import Server
+    from lua_mapreduce_tpu.engine.worker import (MAP_NS, PRE_NS, RED_NS,
+                                                 Worker)
+    from lua_mapreduce_tpu.store.router import get_storage_from
+
+    init_args = {"n_jobs": 8, "records_per_job": 64, "n_partitions": 4}
+    scratch = tempfile.mkdtemp(prefix="sort-coded-smoke")
+    prev = os.environ.get("LMR_DISABLE_NATIVE")
+    os.environ["LMR_DISABLE_NATIVE"] = "1"   # decode rides the portable plane
+
+    def leg(tag: str, coding, destroy: bool):
+        spill = os.path.join(scratch, tag)
+        os.makedirs(spill)
+        spec = TaskSpec(taskfn=MOD, mapfn=MOD, partitionfn=MOD,
+                        reducefn=MOD, init_args=init_args,
+                        storage=f"shared:{spill}")
+        store = MemJobStore()
+        raw = get_storage_from(spec.storage)
+        plane = dict(coding=coding) if coding else {}
+        server = Server(store, poll_interval=0.01, batch_k=2,
+                        **plane).configure(spec)
+        final = {}
+        st = threading.Thread(
+            target=lambda: final.setdefault("stats", server.loop()),
+            daemon=True)
+        mapper = Worker(store).configure(max_iter=8000, max_sleep=0.02,
+                                         phases=("map",))
+        mt = threading.Thread(target=mapper.execute, daemon=True)
+        st.start()
+        mt.start()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                if store.counts(RED_NS)[Status.WAITING] > 0:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.005)
+        else:
+            raise AssertionError(f"{tag}: never reached the reduce barrier")
+        destroyed = 0
+        if destroy:
+            victims = raw.list("^0.*^result.*")
+            assert victims, "coded leg staged no stripes to destroy"
+            for name in victims:
+                raw.remove(name)
+            destroyed = len(victims)
+        reducer = Worker(store).configure(max_iter=8000, max_sleep=0.05)
+        rt = threading.Thread(target=reducer.execute, daemon=True)
+        rt.start()
+        st.join(timeout=120)
+        assert not st.is_alive(), f"{tag}: server wedged"
+        mt.join(timeout=10)
+        rt.join(timeout=10)
+        # zero repetition charges: the loss is never the job's fault
+        for ns in (MAP_NS, PRE_NS, RED_NS):
+            for d in store.jobs(ns):
+                assert d["repetitions"] == 0, \
+                    (f"{tag}: {ns} job {d['_id']} charged "
+                     f"{d['repetitions']} repetitions")
+        result = {n: "".join(raw.lines(n)) for n in raw.list("result.P*")
+                  if n.count(".") == 1}
+        return result, final["stats"].iterations[-1], spill, destroyed
+
+    try:
+        clean, _, _, _ = leg("clean", None, False)
+        coded, it, spill, destroyed = leg("coded", "4+1", True)
+        assert coded == clean, \
+            "coded output differs from the uncoded fault-free run"
+        assert it.decode_reads > 0, "the destroyed blocks never forced a decode"
+        assert it.map_reruns == 0, "parity failed to absorb the block kills"
+        sorted_check = _check_sorted(spill)
+        assert sorted_check["sorted"], sorted_check
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+        if prev is None:
+            os.environ.pop("LMR_DISABLE_NATIVE", None)
+        else:
+            os.environ["LMR_DISABLE_NATIVE"] = prev
+    return {"identical_output": True, "sorted_check": sorted_check,
+            "decode_reads": it.decode_reads, "map_reruns": it.map_reruns,
+            "blocks_destroyed": destroyed}
+
+
 if __name__ == "__main__":
-    args = [a for a in sys.argv[1:] if a != "--smoke"]
+    args = [a for a in sys.argv[1:]
+            if a not in ("--smoke", "--smoke-coded")]
+    if "--smoke-coded" in sys.argv[1:]:
+        res = smoke_coded()
+        print(json.dumps(res))
+        print("extsort coded smoke: every stripe degraded, decoded "
+              "byte-identical, zero re-runs / repetition charges")
+        raise SystemExit(0)
     if "--smoke" in sys.argv[1:]:
         res = smoke()
         print(json.dumps({k: res[k] for k in

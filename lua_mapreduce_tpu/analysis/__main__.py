@@ -76,6 +76,13 @@ def _protocol_suite(args):
     runs.append(("notify-wakeup", dataclasses.replace(
         base, n_jobs=2, batch_k=min(args.batch_k, 2),
         allow_notify=True)))
+    # the erasure-coded decode ladder (DESIGN §27): block-at-a-time
+    # loss (lose_parity) + decode-repair + the rerun rung, exhaustively
+    # — same 2-job box as replica-recovery, with the budget spent one
+    # block at a time instead of one copy at a time
+    runs.append(("coded-recovery", dataclasses.replace(
+        base, n_jobs=2, batch_k=min(args.batch_k, 2),
+        data_loss_budget=2, coded=True)))
     if args.seed_bug:
         bugs = [args.seed_bug]
     else:
@@ -111,6 +118,12 @@ def _protocol_suite(args):
             # one lost-notification event to be reachable
             extra = dict(n_jobs=2, batch_k=min(args.batch_k, 2),
                          allow_notify=True)
+        elif bug in proto_mod.CODED_BUGS:
+            # coded-edge bugs need the stripe data plane and enough
+            # budget to degrade a stripe (and, for the decode-blind
+            # requeue, to re-run a producer into the mid-commit window)
+            extra = dict(n_jobs=2, batch_k=min(args.batch_k, 2),
+                         data_loss_budget=2, coded=True)
         cfg = dataclasses.replace(base, bug=bug, **extra)
         res = proto_mod.check_protocol(cfg)
         entry = {"run": f"seeded:{bug}", "states": res.states,

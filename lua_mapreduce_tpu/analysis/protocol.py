@@ -108,7 +108,17 @@ Seedable bugs (``ModelConfig(bug=...)``):
   (the budget-bounded ``lose_notify`` environment event) parks the
   worker forever and claimable jobs strand — the hang the Waiter's
   degradation ladder exists to prevent (requires
-  ``allow_notify=True``).
+  ``allow_notify=True``);
+- ``"coded_decode_lost_stripe"`` — the scavenger's repair rung decodes
+  stripes with fewer than k surviving blocks: data is conjured from
+  nothing, masking a loss the producer must regenerate (requires
+  ``coded=True`` and ``data_loss_budget ≥ 1``);
+- ``"coded_requeue_skips_decode"`` — the scavenger treats ANY block
+  loss as total loss (never tries the decode rung) AND trusts its own
+  stale classification, firing the producer requeue without the
+  expect=(WRITTEN,) status CAS: it yanks jobs mid-commit exactly like
+  the replica-plane CAS bug, but now on stripes that were perfectly
+  decodable (requires ``coded=True`` and ``data_loss_budget ≥ 1``).
 
 **Watch/notify wakeups (DESIGN §23).** With
 ``ModelConfig(allow_notify=True)`` each worker may go to SLEEP when its
@@ -125,6 +135,24 @@ is a no-op by construction), the full lifecycle invariants survive
 every sleep/wake interleaving, and in the correct model no quiescent
 state strands a claimable job on a sleeping worker — delete the
 timeout fallback (the seeded bug) and exactly that hang is re-found.
+
+**Erasure-coded recovery (DESIGN §27).** With ``ModelConfig(coded=True)``
+the data plane models a k+m stripe instead of r whole copies: the
+budget-bounded ``lose_parity`` event degrades a published output ONE
+BLOCK at a time (intact → decodable-but-under-width → below-k lost —
+it takes m+1 events to kill a stripe where ``lose_replica`` killed a
+copy outright), while ``lose_all`` keeps the blackout/dead-backend
+shape. The scavenger's ladder is unchanged in form — ``repair`` now
+means decode-from-survivors + re-encode back to full stripe width
+(job state untouched), ``rerun_requeue`` stays the last rung — but
+gains the DECODE-CONSERVATION invariant: no repair step may take a
+job's output from below-k-survivors to readable. Decode is linear
+algebra, not necromancy; only a producer re-run regenerates a stripe
+that lost more than m blocks, and a scavenger that claims otherwise is
+silently serving garbage. Two seeded bugs live on exactly these edges
+(``coded_decode_lost_stripe``, ``coded_requeue_skips_decode``); the
+second one's shortest trace replays against BOTH real stores and
+diverges at the WRITTEN expectation of the requeue CAS.
 """
 
 from __future__ import annotations
@@ -153,10 +181,15 @@ _ALLOWED_EDGES = {
 
 KNOWN_BUGS = ("commit_skips_owner_cas", "requeue_ignores_finished",
               "scavenge_skips_lost_data", "lost_requeue_skips_written_cas",
-              "spec_commit_skips_winner_cas", "lost_wakeup_no_fallback")
+              "spec_commit_skips_winner_cas", "lost_wakeup_no_fallback",
+              "coded_decode_lost_stripe", "coded_requeue_skips_decode")
 
 # bugs living on the replica-recovery edge need loss events to surface
 LOSS_BUGS = ("scavenge_skips_lost_data", "lost_requeue_skips_written_cas")
+
+# bugs living on the erasure-coded decode ladder need the coded data
+# plane (block-at-a-time loss) plus a loss budget to be reachable
+CODED_BUGS = ("coded_decode_lost_stripe", "coded_requeue_skips_decode")
 
 # bugs living on the duplicate-lease edge need speculation enabled
 SPEC_BUGS = ("spec_commit_skips_winner_cas",)
@@ -181,13 +214,17 @@ _SP_TAKEN0 = 10     # taken by worker w encodes as _SP_TAKEN0 + w
 # repetition change) — the zero-charge rule of the speculation edges
 _SPEC_PURE_OPS = frozenset({"speculate", "claim_spec", "spec_cancel"})
 
-# replica-set state of a job's published output
+# replica-set state of a job's published output.  Under coded=True the
+# same ladder reads as stripe survivorship: INTACT = full k+m width,
+# UNDER = ≥k survivors (readable via decode, repairable by re-encode),
+# LOST = below k survivors (only a producer re-run regenerates it)
 _D_LOST = 0      # every copy gone — only a producer re-run regenerates
 _D_UNDER = 1     # readable, but below full r-way redundancy
 _D_INTACT = 2    # full redundancy
 
 # environment events: enumerable, but never count as protocol progress
-_ENV_OPS = frozenset({"die", "lose_replica", "lose_all", "lose_notify"})
+_ENV_OPS = frozenset({"die", "lose_replica", "lose_all", "lose_parity",
+                      "lose_notify"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -200,6 +237,7 @@ class ModelConfig:
     allow_death: bool = True
     allow_fail: bool = False
     data_loss_budget: int = 0
+    coded: bool = False
     allow_spec: bool = False
     allow_notify: bool = False
     notify_loss_budget: int = 1
@@ -226,6 +264,16 @@ class ModelConfig:
             raise ValueError(f"bug {self.bug!r} lives on the "
                              "replica-recovery edge: it needs "
                              "data_loss_budget ≥ 1 to be reachable")
+        if self.coded and self.data_loss_budget < 1:
+            raise ValueError("coded=True without a data_loss_budget is "
+                             "inert: lose_parity is the only edge the "
+                             "coded plane adds, and it is budget-gated")
+        if self.bug in CODED_BUGS and (
+                not self.coded or self.data_loss_budget < 1):
+            raise ValueError(f"bug {self.bug!r} lives on the "
+                             "erasure-coded decode ladder: it needs "
+                             "coded=True and data_loss_budget ≥ 1 to "
+                             "be reachable")
         if self.bug in SPEC_BUGS and not self.allow_spec:
             raise ValueError(f"bug {self.bug!r} lives on the "
                              "duplicate-lease edge: it needs "
@@ -603,7 +651,20 @@ class LeaseModel:
             for j, (s, r, o, a, d, sp) in enumerate(jobs):
                 if s != _WRI:
                     continue
-                if d == _D_INTACT:
+                if cfg.coded:
+                    # k+m stripe: blocks die ONE at a time. A first
+                    # loss leaves the stripe decodable (UNDER — still
+                    # ≥ k survivors); another drops it below k (LOST).
+                    # Killing a stripe costs two budget charges where
+                    # lose_replica's whole-copy semantics cost one —
+                    # the durability the coding buys, made enumerable.
+                    if d != _D_LOST:
+                        out.append((
+                            ("lose_parity", j),
+                            (repl_job(j, (s, r, o, a, d - 1, sp)),
+                             workers, commits, budget - 1, wakes,
+                             nbudget)))
+                elif d == _D_INTACT:
                     out.append((
                         ("lose_replica", j),
                         (repl_job(j, (s, r, o, a, _D_UNDER, sp)), workers,
@@ -615,9 +676,14 @@ class LeaseModel:
                          commits, budget - 1, wakes, nbudget)))
         # scavenger pass, reconstruct rung: every under-replicated
         # output is healed from a survivor — job state UNTOUCHED (the
-        # whole point of the trade)
+        # whole point of the trade). Under coded=True the same rung is
+        # decode-from-survivors + re-encode to full width; the seeded
+        # bug also "repairs" below-k stripes — data from nothing, which
+        # the decode-conservation step invariant catches
+        repair_from = (_D_UNDER, _D_LOST) \
+            if cfg.bug == "coded_decode_lost_stripe" else (_D_UNDER,)
         under = tuple(j for j, rec in enumerate(jobs)
-                      if rec[0] == _WRI and rec[4] == _D_UNDER)
+                      if rec[0] == _WRI and rec[4] in repair_from)
         if under:
             nj = list(jobs)
             for j in under:
@@ -635,6 +701,13 @@ class LeaseModel:
             if self.cfg.bug == "lost_requeue_skips_written_cas":
                 lost = tuple(j for j, rec in enumerate(jobs)
                              if rec[4] == _D_LOST
+                             and rec[0] in (_WRI, _FIN, _RUN))
+            elif self.cfg.bug == "coded_requeue_skips_decode":
+                # the decode-blind scavenger: ANY block loss reads as
+                # total loss (the decode rung is never tried), and its
+                # stale classification is trusted — no WRITTEN CAS
+                lost = tuple(j for j, rec in enumerate(jobs)
+                             if rec[4] in (_D_UNDER, _D_LOST)
                              and rec[0] in (_WRI, _FIN, _RUN))
             else:
                 lost = tuple(j for j, rec in enumerate(jobs)
@@ -690,11 +763,20 @@ class LeaseModel:
                        label: tuple) -> Optional[str]:
         ojobs, ocommits = old[0], old[2]
         njobs, ncommits = new[0], new[2]
-        for j, ((os_, or_, oo, _, _, osp), (ns_, nr, no, _, _, nsp)) in \
+        for j, ((os_, or_, oo, _, od, osp), (ns_, nr, no, _, nd, nsp)) in \
                 enumerate(zip(ojobs, njobs)):
             if nr < or_:
                 return (f"repetitions of job {j} decreased {or_}→{nr} "
                         f"on {label}")
+            if label[0] == "repair" and od == _D_LOST and nd != _D_LOST:
+                # decode-conservation (DESIGN §27): repair reconstructs
+                # from ≥ k survivors; a stripe below k has no decode —
+                # a scavenger that "heals" it is fabricating bytes and
+                # masking a loss only a producer re-run can cover
+                return (f"repair resurrected job {j}'s output from "
+                        f"below-k survivors on {label} — decode cannot "
+                        "reconstruct a stripe with fewer than k blocks; "
+                        "only a producer re-run regenerates it")
             if label[0] in _WAIT_PURE_OPS and (os_, or_, oo, osp) != \
                     (ns_, nr, no, nsp):
                 # sleep/wake/lost-notify must be invisible to every job:
@@ -882,7 +964,7 @@ def replay_trace(store, trace: Sequence[tuple], config: ModelConfig,
     for i, label in enumerate(trace):
         op = label[0]
         if op in ("exec", "exec_fail", "spec_exec", "die", "tick",
-                  "lose_replica", "lose_all", "repair",
+                  "lose_replica", "lose_all", "lose_parity", "repair",
                   "sleep", "notify_wake", "timeout_wake", "lose_notify"):
             # loss events / replica repair live on the data plane, and
             # sleep/wake edges live in the Waiter layer (sched/waiter.py)
@@ -1090,3 +1172,31 @@ def utest() -> None:
     rep4 = replay_trace(MemJobStore(), hang.violation.trace, hang.config,
                         final_state=hang.violation.state)
     assert rep4["ok"], rep4    # the wedge reproduces on the real store
+
+    # erasure-coded recovery (DESIGN §27): block-at-a-time loss +
+    # decode-repair keep the full invariant set exhaustively; the
+    # conjured-decode and decode-blind-requeue bugs are re-found, and
+    # the requeue bug's trace diverges at the WRITTEN CAS on BOTH real
+    # stores (the ISSUE's survivor-set-decode-vs-requeue edge)
+    import tempfile
+    from lua_mapreduce_tpu.coord.filestore import FileJobStore
+
+    coded = dataclasses.replace(small, data_loss_budget=2, coded=True)
+    res5 = check_protocol(coded)
+    assert res5.ok and res5.states > res.states
+
+    conj = check_protocol(dataclasses.replace(
+        coded, bug="coded_decode_lost_stripe"))
+    assert not conj.ok, "seeded conjured-decode bug not found"
+    assert "below-k" in conj.violation.message
+
+    blind = check_protocol(dataclasses.replace(
+        coded, n_workers=2, bug="coded_requeue_skips_decode"))
+    assert not blind.ok, "seeded decode-blind requeue not found"
+    assert "illegal status edge" in blind.violation.message
+    with tempfile.TemporaryDirectory() as td:
+        for st in (MemJobStore(), FileJobStore(td)):
+            rep5 = replay_trace(st, blind.violation.trace, blind.config)
+            assert not rep5["ok"], (type(st).__name__, rep5)
+            assert rep5["label"][0] in ("rerun_requeue", "commit_a",
+                                        "commit_b", "claim"), rep5

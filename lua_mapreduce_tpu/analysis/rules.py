@@ -17,6 +17,7 @@ dataflow engine that nobody can audit):
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterable, List, Optional, Sequence, Set, Tuple
 
 from lua_mapreduce_tpu.analysis.lint import FileContext, Finding, Rule
@@ -487,6 +488,53 @@ class RawBytesContractRule(Rule):
 # copy, whatever the negotiated replication factor says.
 _PLAIN_SPILL_FACTORIES = {"writer_for", "SegmentWriter", "TextWriter"}
 
+# literal shapes of the coded stripe plane (faults/coded.py, DESIGN
+# §27): "^<i>.<t>^" block prefixes and the "^M^" manifest marker.
+# Matched against the LITERAL text of a string (for f-strings, the
+# concatenated constant parts: f"^{i}.{t}^{name}" reduces to "^.^") —
+# the documented analysis limit: names assembled through .join()/
+# concatenation of variables are out of reach, literal prefixes are
+# the shape every real offender has.
+_STRIPE_BLOCK_RE = re.compile(r"\^(?:\d+|\*)?\.?\^|\^(?:\d+|\*)\.")
+_STRIPE_MANIFEST_MARKER = "^M^"
+_CODED_HOME = "faults/coded.py"
+
+
+def _docstring_consts(tree: ast.Module) -> Set[int]:
+    """id()s of every docstring Constant — prose that legitimately
+    spells stripe names when documenting them."""
+    out: Set[int] = set()
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))]
+    for s in scopes:
+        body = getattr(s, "body", None)
+        if body and isinstance(body[0], ast.Expr) \
+                and isinstance(body[0].value, ast.Constant) \
+                and isinstance(body[0].value.value, str):
+            out.add(id(body[0].value))
+    return out
+
+
+def _stripe_literals(ctx: FileContext):
+    """(node, literal_text) for every non-docstring string literal or
+    f-string in the file, literal parts concatenated (an f-string
+    counts once as a whole — its part constants are not re-yielded)."""
+    skip = _docstring_consts(ctx.tree)
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.JoinedStr):
+            skip.update(id(v) for v in n.values)
+    for n in ast.walk(ctx.tree):
+        if id(n) in skip:
+            continue
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            yield n, n.value
+        elif isinstance(n, ast.JoinedStr):
+            yield n, "".join(v.value for v in n.values
+                             if isinstance(v, ast.Constant)
+                             and isinstance(v.value, str))
+
 
 class ReplicatedSpillRule(Rule):
     id = "LMR009"
@@ -502,21 +550,38 @@ class ReplicatedSpillRule(Rule):
         "under-replicated, invisible until the one copy is lost and a "
         "map re-run pays for it. (Result-file publishes use the plain "
         "store builder and are exempt: final results are deliberately "
-        "not replicated.)")
-    paths = ("engine/",)
+        "not replicated.) Coded corollary (DESIGN §27): a \"^i.t^\" "
+        "stripe-block name spelled as a literal outside faults/coded.py "
+        "is a publish (or read) that bypasses the codec — a hand-rolled "
+        "block misses the stripe manifest's CRC/placement contract and "
+        "the scavenger's repair accounting; only the coded module may "
+        "mint block names.")
+    paths = ("engine/", "faults/")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
-        for n in ast.walk(ctx.tree):
-            if not isinstance(n, ast.Call):
-                continue
-            c = _chain(n.func)
-            if c and c[-1] in _PLAIN_SPILL_FACTORIES:
-                yield self.finding(
-                    ctx, n,
-                    f"{c[-1]}(...) in engine/ publishes a single "
-                    "unreplicated copy — route the spill through "
-                    "faults.replicate.spill_writer so the negotiated "
-                    "replication factor applies")
+        if ctx.rel.startswith("engine/"):
+            for n in ast.walk(ctx.tree):
+                if not isinstance(n, ast.Call):
+                    continue
+                c = _chain(n.func)
+                if c and c[-1] in _PLAIN_SPILL_FACTORIES:
+                    yield self.finding(
+                        ctx, n,
+                        f"{c[-1]}(...) in engine/ publishes a single "
+                        "unreplicated copy — route the spill through "
+                        "faults.replicate.spill_writer so the negotiated "
+                        "replication factor applies")
+        if ctx.rel != _CODED_HOME:
+            for node, text in _stripe_literals(ctx):
+                if _STRIPE_BLOCK_RE.search(text):
+                    yield self.finding(
+                        ctx, node,
+                        "stripe-block name constructed directly "
+                        f"({text!r}) — \"^i.t^\" blocks exist only "
+                        "behind the coded codec's manifest/CRC/"
+                        "placement contract; use the faults.coded "
+                        "helpers (stripe_patterns for matching, "
+                        "CodedStore/publish_stripe for I/O)")
 
 
 # --- LMR008: classified raisables across the retry boundary ----------------
@@ -760,8 +825,13 @@ class PushInboxPublishRule(Rule):
         "single unreplicated copy that one lost target silently "
         "erases. Heuristic scope (the documented analysis limits): "
         "builds whose name argument carries a literal INBOX/.PUSH. "
-        "part, receivers resolved within one function scope.")
-    paths = ("engine/",)
+        "part, receivers resolved within one function scope. Coded "
+        "corollary (DESIGN §27): a \"^M^\" stripe-manifest name "
+        "spelled as a literal outside faults/coded.py forges the "
+        "visibility gate itself — a hand-written manifest makes a "
+        "partial stripe readable (or hides a complete one), so only "
+        "the coded module may mint manifest names.")
+    paths = ("engine/", "faults/")
 
     @staticmethod
     def _literal_parts(node) -> str:
@@ -774,31 +844,43 @@ class PushInboxPublishRule(Rule):
         return ""
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
-        for _scope, body in _scopes(ctx.tree):
-            ok: Set[Tuple[str, ...]] = set()
-            for n in _own_walk(body):
-                if isinstance(n, ast.Assign) \
-                        and isinstance(n.value, ast.Call):
-                    c = _chain(n.value.func)
-                    if c and c[-1] == "spill_writer":
-                        for t in n.targets:
-                            tc = _chain(t)
-                            if tc:
-                                ok.add(tc)
-            for call in _calls(body):
-                if not (isinstance(call.func, ast.Attribute)
-                        and call.func.attr == "build" and call.args):
-                    continue
-                text = self._literal_parts(call.args[0])
-                if not any(m in text for m in _PUSH_NAME_MARKERS):
-                    continue
-                recv = _chain(call.func.value)
-                if recv is not None and recv in ok:
-                    continue
-                yield self.finding(
-                    ctx, call,
-                    "inbox/manifest publish built outside spill_writer "
-                    "— a raw builder lands ONE unreplicated copy; "
-                    "route the publish through "
-                    "faults.replicate.spill_writer so the negotiated "
-                    "replication factor applies")
+        if ctx.rel.startswith("engine/"):
+            for _scope, body in _scopes(ctx.tree):
+                ok: Set[Tuple[str, ...]] = set()
+                for n in _own_walk(body):
+                    if isinstance(n, ast.Assign) \
+                            and isinstance(n.value, ast.Call):
+                        c = _chain(n.value.func)
+                        if c and c[-1] == "spill_writer":
+                            for t in n.targets:
+                                tc = _chain(t)
+                                if tc:
+                                    ok.add(tc)
+                for call in _calls(body):
+                    if not (isinstance(call.func, ast.Attribute)
+                            and call.func.attr == "build" and call.args):
+                        continue
+                    text = self._literal_parts(call.args[0])
+                    if not any(m in text for m in _PUSH_NAME_MARKERS):
+                        continue
+                    recv = _chain(call.func.value)
+                    if recv is not None and recv in ok:
+                        continue
+                    yield self.finding(
+                        ctx, call,
+                        "inbox/manifest publish built outside "
+                        "spill_writer — a raw builder lands ONE "
+                        "unreplicated copy; route the publish through "
+                        "faults.replicate.spill_writer so the "
+                        "negotiated replication factor applies")
+        if ctx.rel != _CODED_HOME:
+            for node, text in _stripe_literals(ctx):
+                if _STRIPE_MANIFEST_MARKER in text:
+                    yield self.finding(
+                        ctx, node,
+                        "stripe-manifest name constructed directly "
+                        f"({text!r}) — \"^M^\" manifests ARE the "
+                        "stripe visibility gate; minting one outside "
+                        "faults.coded can expose a partial stripe. "
+                        "Match them with faults.coded.manifest_pattern/"
+                        "stripe_patterns, publish through the codec")

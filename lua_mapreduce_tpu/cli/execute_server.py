@@ -108,6 +108,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "lost copies instead of re-running map jobs — "
                         "docs/DESIGN.md §20. r=1 is byte-identical to "
                         "the unreplicated path")
+    p.add_argument("--coding", type=str, default=None, metavar="K+M",
+                   help="erasure-coded shuffle spec 'k+m' (e.g. 4+1), "
+                        "written to the task doc as the fleet default "
+                        "(or LMR_CODING): each spill stripes into k data "
+                        "+ m Reed-Solomon parity blocks on distinct "
+                        "placement targets, any m losses decode inline, "
+                        "at (k+m)/k write amplification instead of "
+                        "replication's r — docs/DESIGN.md §27. Mutually "
+                        "exclusive with --replication")
     p.add_argument("--speculation-factor", type=float, default=None,
                    help="straggler factor (default 0 = off, or "
                         "LMR_SPECULATION): a RUNNING job older than "
@@ -216,6 +225,7 @@ def main(argv=None) -> int:
                     batch_k=args.batch_k,
                     segment_format=args.segment_format,
                     replication=args.replication,
+                    coding=args.coding,
                     speculation=args.speculation_factor,
                     speculation_cap=args.speculation_cap,
                     push=args.push,

@@ -36,6 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shuffle replication factor THIS worker publishes "
                         "and reads with (default: follow the task "
                         "document's fleet default — DESIGN §20)")
+    p.add_argument("--coding", type=str, default=None, metavar="K+M",
+                   help="erasure-coding spec 'k+m' THIS worker publishes "
+                        "and reads with (default: follow the task "
+                        "document's deployed value — DESIGN §27)")
     p.add_argument("--idle-poll-ms", type=float, default=None,
                    help="idle-poll CAP in ms (lmr-sched, DESIGN §23): "
                         "the longest an idle worker waits between "
@@ -128,6 +132,8 @@ def main(argv=None) -> int:
         worker.configure(segment_format=args.segment_format)
     if args.replication is not None:
         worker.configure(replication=args.replication)
+    if args.coding is not None:
+        worker.configure(coding=args.coding)
     if args.push is not None:
         worker.configure(push=args.push)
     if args.push_budget_mb is not None:

@@ -102,7 +102,7 @@ def map_output_name(result_ns: str, part: int, map_key: Any) -> str:
 def run_map_job(spec: TaskSpec, store: Store, job_id: str,
                 map_key: Any, map_value: Any,
                 segment_format: str = "v1",
-                replication: int = 1,
+                replication=1,
                 push: bool = False,
                 push_pool=None,
                 spec_lineage: str = None) -> JobTimes:
@@ -125,8 +125,11 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
     or ``"v2"`` framed binary segments (core/segment.py) — negotiated via
     the task document; readers sniff per file, so mixed formats in one
     namespace are always valid. ``replication`` (DESIGN §20, negotiated
-    the same way) fans each run file out to r placement copies; r=1 is
-    byte-identical to the unreplicated path.
+    the same way) is the unified redundancy value: an int fans each run
+    file out to r placement copies, a ``"k+m"``/Coding spec publishes
+    erasure-coded stripes instead (DESIGN §27) — every choke point
+    below (reading_view / spill_writer / PushWriter) dispatches on it;
+    1 is byte-identical to the unreplicated path.
 
     ``push`` (DESIGN §24) switches the publish side to the streaming
     shuffle: each partition's records land as JSEG0001 frame files in
@@ -223,7 +226,7 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
 def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
                      spill_file: str,
                      segment_format: str = "v1",
-                     replication: int = 1) -> JobTimes:
+                     replication=1) -> JobTimes:
     """Eagerly consolidate committed sorted runs into one spill run —
     the pipelined-shuffle work unit (engine/premerge.py).
 
@@ -282,7 +285,7 @@ def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
 
 def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
                    part_key: str, run_files: List[str],
-                   result_file: str, replication: int = 1) -> JobTimes:
+                   result_file: str, replication=1) -> JobTimes:
     """Execute one reduce job: k-way merge a partition's runs — raw
     mapper runs and/or pre-merged spills, in the caller-given canonical
     order (the merge concatenates equal-key values in file-list order,

@@ -123,6 +123,7 @@ class LocalExecutor:
                  premerge_min_runs: int = 4, premerge_max_runs: int = 8,
                  batch_k: int = 1, segment_format: str = "v1",
                  replication: Optional[int] = None,
+                 coding: Optional[str] = None,
                  push: Optional[bool] = None,
                  push_budget_mb: Optional[float] = None,
                  engine: Optional[str] = None):
@@ -143,11 +144,14 @@ class LocalExecutor:
         # framed binary segments; results stay v1 text either way
         from lua_mapreduce_tpu.core.segment import check_format
         self.segment_format = check_format(segment_format)
-        # shuffle replication factor (DESIGN §20): spills fan out to r
-        # placement copies and every read fails over to any survivor.
-        # r=1 (the default) is byte-identical to the unreplicated path.
-        from lua_mapreduce_tpu.engine.placement import resolve_replication
-        self.replication = resolve_replication(replication)
+        # shuffle redundancy (DESIGN §20/§27): spills fan out to r
+        # placement copies (replication) or k+m erasure-coded stripe
+        # blocks (coding="k+m" / LMR_CODING) and every read fails over
+        # or decodes from survivors. self.replication carries the
+        # unified value — an int or a Coding; 1 (the default) is
+        # byte-identical to the unreplicated path.
+        from lua_mapreduce_tpu.faults.coded import resolve_redundancy
+        self.replication = resolve_redundancy(replication, coding)
         # push-based streaming shuffle (DESIGN §24): map output lands as
         # manifest-gated inbox frames under ONE shared memory-budgeted
         # buffer pool (the executor's map threads are its "worker").

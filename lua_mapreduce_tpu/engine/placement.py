@@ -49,6 +49,18 @@ NUM_TAGS = 8
 
 _REPLICA_RE = re.compile(r"^~(\d+)\.(\d+)~(.+)$")
 
+# erasure-coded stripe names (faults/coded.py, DESIGN §27) reuse the
+# same self-describing shape with a distinct sigil: block ``i`` of a
+# stripe lives at ``^<i>.<tag>^<base>`` on tag (primary_tag(base)+i) %
+# NUM_TAGS — the replica formula, so k+m blocks occupy k+m DISTINCT
+# tags and any single-tag loss costs at most one block per stripe. The
+# per-stripe manifest is the ``M``-sigil variant. Construction of these
+# names is coded.py's monopoly (lint rule LMR012); placement only
+# PARSES them, because tag routing (the blackout kind's question) and
+# logical-name stripping must work for every physical copy shape.
+_BLOCK_RE = re.compile(r"^\^(\d+)\.(\d+)\^(.+)$")
+_MANIFEST_RE = re.compile(r"^\^M\^(.+)$")
+
 
 def check_replication(r) -> int:
     """Validate a replication factor: an int in [1, NUM_TAGS]."""
@@ -101,17 +113,46 @@ def parse_replica(name: str) -> Optional[Tuple[int, int, str]]:
     return int(m.group(1)), int(m.group(2)), m.group(3)
 
 
+def parse_block(name: str) -> Optional[Tuple[int, int, str]]:
+    """``(i, tag, base_name)`` of a coded-stripe block name, or None
+    for anything else (plain names, replicas, stripe manifests)."""
+    m = _BLOCK_RE.match(name)
+    if not m:
+        return None
+    return int(m.group(1)), int(m.group(2)), m.group(3)
+
+
 def base_name(name: str) -> str:
-    """The logical (primary) name behind any copy name."""
-    parsed = parse_replica(name)
-    return name if parsed is None else parsed[2]
+    """The logical (primary) name behind any physical copy name —
+    replica, coded block, stripe manifest, or a replica OF a stripe
+    manifest (stripped iteratively: ``~1.5~^M^f`` resolves to ``f``)."""
+    while True:
+        parsed = parse_replica(name)
+        if parsed is not None:
+            name = parsed[2]
+            continue
+        blk = parse_block(name)
+        if blk is not None:
+            name = blk[2]
+            continue
+        man = _MANIFEST_RE.match(name)
+        if man is not None:
+            name = man.group(1)
+            continue
+        return name
 
 
 def tag_of(name: str) -> int:
     """Which placement target an op on ``name`` touches: the embedded
-    tag of a replica name, the hashed tag of a primary."""
+    tag of a replica or coded-block name, the hashed tag of anything
+    else (primaries, stripe manifests)."""
     parsed = parse_replica(name)
-    return primary_tag(name) if parsed is None else parsed[1]
+    if parsed is not None:
+        return parsed[1]
+    blk = parse_block(name)
+    if blk is not None:
+        return blk[1]
+    return primary_tag(name)
 
 
 def replica_pattern(pattern: str) -> str:
@@ -141,6 +182,22 @@ def utest() -> None:
         assert not fnmatch.fnmatchcase(n, "result.P*")
         assert fnmatch.fnmatchcase(n, replica_pattern("result.P*.M*"))
     assert parse_replica(name) is None and base_name(name) == name
+
+    # coded-stripe names (constructed ONLY by faults/coded.py — LMR012)
+    # parse to the same tag-routing and logical-stripping answers
+    from lua_mapreduce_tpu.faults.coded import (Coding, block_names,
+                                                manifest_copies)
+    blocks = block_names(name, Coding(4, 2))
+    assert len({tag_of(n) for n in blocks}) == 6     # distinct targets
+    for i, n in enumerate(blocks):
+        assert parse_block(n) == (i, tag_of(n), name)
+        assert base_name(n) == name
+        assert not fnmatch.fnmatchcase(n, "result.P*")   # glob-transparent
+    for n in manifest_copies(name, Coding(4, 2)):    # manifest + replicas
+        assert parse_block(n) is None
+        assert base_name(n) == name                  # iterative stripping
+        assert not fnmatch.fnmatchcase(n, "result.P*")
+    assert parse_block(name) is None
 
     # ~full-range factors still land on distinct tags
     assert len({tag_of(n) for n in replica_names(name, NUM_TAGS)}) \

@@ -14,7 +14,12 @@ of staging behind a barrier. This module is that layer:
   JSEG0001 frame files (core/segment.py) the moment a buffer reaches
   ~frame size — ``<ns>.P<p>.INBOX-<map>-<seq>`` — through
   ``faults.replicate.spill_writer`` (lint LMR009/LMR012), so r-way
-  replication and placement tags apply to pushed frames unchanged;
+  replication and placement tags apply to pushed frames unchanged —
+  and under an erasure-coding spec (``--coding k+m``, DESIGN §27) each
+  full frame stripes individually while the map's final partial frames
+  across partitions publish as ONE shared group stripe
+  (:func:`group_base`), amortizing parity overhead below what staged
+  per-file striping pays; eviction tails stay streaming-replicated;
 - a per-worker :class:`BufferPool` bounds the memory the push layer may
   hold (``--push-budget-mb``): going over budget **evicts** the oldest
   partition buffer to the classic staged path — its records (and the
@@ -61,11 +66,15 @@ import threading
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from lua_mapreduce_tpu.core.serialize import dump_record, load_record
+from lua_mapreduce_tpu.faults.coded import (CaptureStore, Coding,
+                                            check_redundancy, publish_stripe,
+                                            stripe_patterns, tail_redundancy)
 from lua_mapreduce_tpu.faults.replicate import reading_view, spill_writer
 from lua_mapreduce_tpu.faults.retry import COUNTERS
 
 INBOX_TAG = "INBOX"
 PUSH_NS = "PUSH"               # manifests: <ns>.PUSH.M<mapkey>[.s<lin>]
+CODE_TAG = "CODE"              # group stripes: <ns>.CODE.<mapkey>[-s<lin>]
 
 # decoded bytes a partition buffers before its frame publishes — aligned
 # with core/segment.FRAME_BYTES so one inbox file is ~one JSEG frame.
@@ -124,6 +133,16 @@ def frag_name(ns: str, part: int, map_key: str, lineage: Optional[str],
     lin = f"-s{lineage}" if lineage else ""
     return (f"{ns}.P{part}.{INBOX_TAG}-{map_key}{lin}-{seq:05d}"
             + ("T" if tail else ""))
+
+
+def group_base(ns: str, map_key: str, lineage: Optional[str]) -> str:
+    """The LOGICAL base name of one map execution's coded group stripe
+    (DESIGN §27): the stripe layer derives the ``^``-sigil block names
+    from it (faults/coded.py — never constructed here, LMR012). Clones
+    quarantine under their lineage tag exactly like fragments, so a
+    clone's group blocks never collide with the original's."""
+    lin = f"-s{lineage}" if lineage else ""
+    return f"{ns}.{CODE_TAG}.{map_key}{lin}"
 
 
 def inbox_re(ns: str) -> "re.Pattern":
@@ -222,7 +241,9 @@ class PushWriter:
         self._store = store
         self._ns = ns
         self._map_key = str(map_key)
-        self._r = int(replication)
+        # unified redundancy value: int replication or a Coding spec —
+        # spill_writer dispatches per frame, finish() groups under coding
+        self._r = check_redundancy(replication)
         self._pool = pool or BufferPool(resolve_push_budget(None))
         self._lineage = lineage
         self._frame_bytes = int(frame_bytes)
@@ -301,7 +322,12 @@ class PushWriter:
         _, part, st = min(victims)
         st.tail = frag_name(self._ns, part, self._map_key, self._lineage,
                             st.seq, tail=True)
-        st.tail_writer = spill_writer(self._store, "v2", self._r,
+        # the tail exists to BOUND memory, so it never stripes (a stripe
+        # buffers its whole payload): under coding it degrades to
+        # (m+1)-way streaming replication — same loss tolerance, zero
+        # buffering (tail_redundancy; identity for plain replication)
+        st.tail_writer = spill_writer(self._store, "v2",
+                                      tail_redundancy(self._r),
                                       codec=self._codec)
         for key, line in st.lines:
             st.tail_writer.add_line(key, line)
@@ -319,15 +345,53 @@ class PushWriter:
                       if st.frags or st.tail is not None},
         }
 
+    def _finish_group(self, leftovers) -> None:
+        """The coded bandwidth half (DESIGN §27): at map end, every
+        partition's final partial frame is serialized through the
+        NORMAL spill encoding into a capture and the concatenated
+        members stripe ONCE — one coded combination serving several
+        reducer inboxes, so the parity + manifest overhead (and the
+        per-stripe padding a sub-frame fragment would otherwise pay) is
+        amortized across partitions instead of charged per fragment.
+        A duplicate execution re-publishes the same group base whole —
+        blocks first, member manifests last — so the set is consistent
+        again before discovery runs (the phase barrier orders
+        consumption, exactly the publish-if-absent reasoning above)."""
+        cap = CaptureStore()
+        for part, st in leftovers:
+            name = frag_name(self._ns, part, self._map_key, self._lineage,
+                             st.seq)
+            w = spill_writer(cap, "v2", 1, codec=self._codec)
+            try:
+                for key, line in st.lines:
+                    w.add_line(key, line)
+                w.build(name)
+            finally:
+                w.close()
+            st.frags.append(name)
+            st.seq += 1
+            self._pool.uncharge(st.bytes)
+            st.lines, st.bytes = [], 0
+            COUNTERS.bump("push_frames")
+        publish_stripe(self._store, cap.files, self._r,
+                       group_base=group_base(self._ns, self._map_key,
+                                             self._lineage))
+        COUNTERS.bump("push_group_stripes")
+
     def finish(self) -> dict:
         """Publish final partial frames, build eviction tails, then the
         manifest — the lineage becomes *complete* (every named file
         exists) strictly before it can become *visible*. Returns the
         manifest dict (promote and tests consume it)."""
+        leftovers = [(part, st) for part, st in sorted(self._parts.items())
+                     if st.tail_writer is None and st.lines]
         for part, st in sorted(self._parts.items()):
             if st.tail_writer is not None:
                 st.tail_writer.build(st.tail)
-            elif st.lines:
+        if isinstance(self._r, Coding) and len(leftovers) > 1:
+            self._finish_group(leftovers)
+        else:
+            for part, st in leftovers:
                 self._flush_frag(part, st)
         man = self.manifest()
         if self._lineage:
@@ -592,8 +656,17 @@ def sweep_push_files(view, ns: str) -> None:
     server's ``_clean_runs``): stale inbox fragments AND manifests from
     a previous iteration must never leak into this one's discovery —
     a stale canonical manifest would win the publish-if-absent race
-    against the fresh lineage and name already-consumed files."""
-    for pattern in (f"{ns}.P*.{INBOX_TAG}-*", f"{ns}.{PUSH_NS}.M*"):
+    against the fresh lineage and name already-consumed files.
+
+    Coded group-stripe BLOCKS (shared by several members, so no single
+    member's remove may drop them — DESIGN §27) are swept here by their
+    physical stripe patterns: once the member manifests above are gone
+    the blocks are unreachable garbage, and this is also where a losing
+    clone's orphaned group blocks (invisible since its members were
+    swept at discovery) finally go."""
+    patterns = [f"{ns}.P*.{INBOX_TAG}-*", f"{ns}.{PUSH_NS}.M*"]
+    patterns += stripe_patterns(f"{ns}.{CODE_TAG}.*")
+    for pattern in patterns:
         for name in view.list(pattern):
             try:
                 view.remove(name)
@@ -707,3 +780,35 @@ def utest() -> None:
     sweep_push_files(store3, ns)
     assert store3.list(f"{ns}.P*.{INBOX_TAG}-*") == []
     assert store3.list(f"{ns}.{PUSH_NS}.M*") == []
+
+    # coded push (DESIGN §27): full frames stripe individually, the
+    # final partial frames of SEVERAL partitions publish as one group
+    # stripe, the eviction tail stays streaming-replicated — and the
+    # whole lineage reads back byte-identical through the coded view
+    store4 = MemStore()
+    cw = PushWriter(store4, ns, "00000004", replication="4+1",
+                    pool=BufferPool(budget_bytes=400), frame_bytes=128)
+    for i in range(80):
+        cw.add(i % 4, f"c{i:04d}", [i])
+    cman = cw.finish()
+    cw.close()
+    view4 = reading_view(store4, "4+1")
+    cby_part = manifest_files_by_part(cman)
+    assert set(cby_part) == {0, 1, 2, 3}
+    cnames = [n for files in cby_part.values() for n in files]
+    plain4 = store4.list(f"{ns}.P*.{INBOX_TAG}-*")
+    assert plain4 and all(n.endswith("T") for n in plain4), \
+        f"only replicated TAILS may have plain primaries: {plain4}"
+    assert all(view4.exists(n) for n in cnames)
+    gbase = group_base(ns, "00000004", None)
+    assert store4.list(stripe_patterns(gbase)[0]), "no group stripe published"
+    for part, files in cby_part.items():
+        recs = [k for nm in files for k, _ in record_stream(view4, nm)]
+        assert recs == sorted(recs) and len(recs) == 20, (part, recs)
+    # discovery resolves the coded lineage like any other
+    got4 = discover_push(store4, ns, ["00000004"], replication="4+1")
+    assert got4 == {p: fs for p, fs in sorted(cby_part.items())}
+    # iteration hygiene sweeps member stripes AND shared group blocks
+    sweep_push_files(reading_view(store4, "4+1"), ns)
+    leftover4 = store4.list("*") + store4.list(stripe_patterns("*")[0])
+    assert leftover4 == [], f"coded sweep left {leftover4}"

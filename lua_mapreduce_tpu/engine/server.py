@@ -107,6 +107,16 @@ class Server:
     Written to the task doc as the fleet default, like
     ``segment_format``; r=1 is byte-identical to the unreplicated path.
 
+    ``coding`` (DESIGN §27; None = ``LMR_CODING`` env, else off) is the
+    erasure-coded alternative to replication — mutually exclusive with
+    it: publishes stripe into k data + m parity blocks on distinct
+    placement tags ((k+m)/k write amplification), readers decode from
+    any k survivors, and the scavenge path rebuilds stripes instead of
+    copies. Internally the two share ONE redundancy value
+    (``self.replication`` carries the int r or the Coding), so every
+    downstream path — reading views, scavenger, task doc, resume
+    stickiness — is common.
+
     ``speculation`` (DESIGN §21; None = ``LMR_SPECULATION`` env, else 0
     = off) is the straggler factor: every housekeeping pass compares
     each RUNNING job's age against the fleet per-namespace duration
@@ -126,6 +136,7 @@ class Server:
                  premerge_max_runs: int = 8, batch_k: int = 1,
                  segment_format: str = "v1",
                  replication: Optional[int] = None,
+                 coding: Optional[str] = None,
                  speculation: Optional[float] = None,
                  speculation_cap: int = 2,
                  push: Optional[bool] = None,
@@ -157,10 +168,12 @@ class Server:
         # is free of crash-consistency ties (unlike the shuffle mode).
         from lua_mapreduce_tpu.core.segment import check_format
         self.segment_format = check_format(segment_format)
-        # shuffle replication factor (DESIGN §20): the fleet default,
-        # written to the task doc like segment_format
-        from lua_mapreduce_tpu.engine.placement import resolve_replication
-        self.replication = resolve_replication(replication)
+        # shuffle redundancy (DESIGN §20/§27): the fleet default,
+        # written to the task doc like segment_format. ONE unified
+        # value: an int replication factor OR a Coding ("k+m" erasure
+        # stripes) — the choke points downstream dispatch on the type
+        from lua_mapreduce_tpu.faults.coded import resolve_redundancy
+        self.replication = resolve_redundancy(replication, coding)
         # speculative execution (DESIGN §21): the straggler factor (0 =
         # off) and the per-namespace live-clone cap, task-doc deployed —
         # workers gate their clone-claim probe on the doc marker, so an
@@ -301,14 +314,14 @@ class Server:
                 # run's data visibility, so a push-off resume would
                 # silently drop everything the crashed run pushed
                 self.push = bool(task.get("push", self.push))
-                # replication shares the pipeline rule: a crashed r>1
+                # redundancy shares the pipeline rule: a crashed r>1
                 # run may hold data ONLY in replica copies (primary lost
-                # mid-crash) — an r=1 resume could not see it, so the
-                # doc's factor wins on resume
-                from lua_mapreduce_tpu.engine.placement import \
-                    check_replication
-                self.replication = check_replication(
-                    task.get("replication", self.replication) or 1)
+                # mid-crash), and a crashed coded run holds data ONLY in
+                # stripe blocks behind manifests — a plain resume could
+                # not see either, so the doc's deployed value wins on
+                # resume (coding spec first, then the factor)
+                from lua_mapreduce_tpu.faults.coded import doc_redundancy
+                self.replication = doc_redundancy(task, self.replication)
                 # the engine knob is sticky like the shuffle mode: a
                 # crashed in-graph run inserted no jobs, so a store
                 # resume would wait on phases that never open (and the
@@ -321,20 +334,23 @@ class Server:
                 # crash-consistency tie to on-disk state (readers sniff
                 # spill formats per file; unlike the shuffle mode), so
                 # the resuming server's configuration wins over the doc's
-                self.store.update_task({
+                from lua_mapreduce_tpu.faults.coded import doc_fields
+                self.store.update_task(dict({
                     "pipeline": self.pipeline,
                     "push": self.push,
                     "batch_k": self.batch_k,
                     "segment_format": self.segment_format,
-                    "replication": self.replication,
                     "speculation": self.speculation,
-                    "engine": self.engine})
+                    "engine": self.engine},
+                    # JSON-safe redundancy pair: int factor + coding spec
+                    **doc_fields(self.replication)))
                 self._notify_jobs()
                 if status == TaskStatus.REDUCE.value:
                     skip_map = True
         if self.spec is None:
             raise RuntimeError("configure() a TaskSpec before loop()")
         if task is None:
+            from lua_mapreduce_tpu.faults.coded import doc_fields
             self.store.put_task({
                 "_id": "unique",
                 "status": TaskStatus.WAIT.value,
@@ -352,9 +368,12 @@ class Server:
                 # the fleet's spill encoding (workers with no explicit
                 # segment_format follow this; readers sniff per file)
                 "segment_format": self.segment_format,
-                # the fleet's shuffle replication factor (workers with
-                # no explicit replication follow this — DESIGN §20)
-                "replication": self.replication,
+                # the fleet's shuffle redundancy (workers with no
+                # explicit knob of their own follow this — DESIGN §20):
+                # a JSON-safe pair of the int replication factor and the
+                # "k+m" coding spec ("" when erasure coding is off,
+                # DESIGN §27)
+                **doc_fields(self.replication),
                 # the straggler factor (DESIGN §21): nonzero makes idle
                 # workers probe for speculative duplicate leases
                 "speculation": self.speculation,
@@ -639,7 +658,8 @@ class Server:
             lost.extend(err.get("lost_files") or ())
             self._log(f"worker error [{err['worker']}]: "
                       f"{err['msg'].splitlines()[-1] if err['msg'] else ''}")
-        if self.replication > 1:
+        from lua_mapreduce_tpu.faults.coded import redundancy_on
+        if redundancy_on(self.replication):
             if lost:
                 self._recover_lost(sorted(set(lost)))
             if self._spill_repairs:
@@ -1070,8 +1090,9 @@ class Server:
         every interval — scavenge BROKEN≥3→FAILED, requeue stale RUNNING,
         drain + surface worker errors, report progress — until every job is
         WRITTEN or FAILED."""
+        from lua_mapreduce_tpu.faults.coded import redundancy_on
         namespaces = (ns,)
-        if ns == RED_NS and self.replication > 1:
+        if ns == RED_NS and redundancy_on(self.replication):
             # recovery re-runs ride the map/pre namespaces DURING the
             # reduce phase (DESIGN §20): they need the same scavenge +
             # stale-requeue upkeep, or a SIGKILLed re-run would wedge

@@ -51,7 +51,8 @@ MAX_INFRA_POLL_FAILURES = 10
 
 _CONFIG_KEYS = ("max_iter", "max_sleep", "max_tasks", "max_jobs", "phases",
                 "heartbeat_s", "batch_k", "batch_lease_s", "segment_format",
-                "replication", "idle_poll_ms", "push", "push_budget_mb")
+                "replication", "coding", "idle_poll_ms", "push",
+                "push_budget_mb")
 
 
 def resolve_idle_poll_s(idle_poll_ms, max_sleep: float) -> float:
@@ -130,13 +131,16 @@ class Worker:
         # per file, so any mix of formats in one namespace is valid.
         self.segment_format = None
         self._task_segment_format = None        # last task doc's value
-        # shuffle replication factor (DESIGN §20): None = follow the
-        # task document's fleet default (the server-deployed r); an
-        # explicit configure(replication=...) wins. r=1 keeps every
-        # spill publish, read, and remove byte-identical to the
-        # unreplicated path.
+        # shuffle redundancy (DESIGN §20/§27): None = follow the task
+        # document's fleet default (the server-deployed replication
+        # factor or "k+m" coding spec); an explicit
+        # configure(replication=...) or configure(coding=...) wins.
+        # r=1 keeps every spill publish, read, and remove
+        # byte-identical to the unreplicated path.
         self.replication = None
+        self.coding = None
         self._task_replication = None           # last task doc's value
+        self._task_coding = None                # last task doc's value
         # push-based streaming shuffle (DESIGN §24): None = follow the
         # task document's fleet default (the server-deployed marker);
         # an explicit configure(push=...) wins. The memory budget is a
@@ -183,9 +187,12 @@ class Worker:
                 from lua_mapreduce_tpu.core.segment import check_format
                 check_format(v)
             if k == "replication" and v is not None:
-                from lua_mapreduce_tpu.engine.placement import \
-                    check_replication
-                check_replication(v)
+                # the unified knob: an int factor OR a "k+m" coding spec
+                from lua_mapreduce_tpu.faults.coded import check_redundancy
+                check_redundancy(v)
+            if k == "coding" and v is not None:
+                from lua_mapreduce_tpu.faults.coded import parse_coding
+                parse_coding(v)
             if k == "idle_poll_ms" and v is not None and float(v) <= 0:
                 raise ValueError(f"idle_poll_ms must be > 0, got {v}")
             setattr(self, k, v)
@@ -269,6 +276,7 @@ class Worker:
             self._infra_released.clear()
         self._task_segment_format = task.get("segment_format")
         self._task_replication = task.get("replication")
+        self._task_coding = task.get("coding")
         self._task_push = task.get("push")
         self._speculation = float(task.get("speculation") or 0.0)
         # fleet duration aggregate (DESIGN §21): remember the doc's
@@ -358,7 +366,9 @@ class Worker:
             # in a single dual-phase-worker fleet a released lost-data
             # reduce job would otherwise be reclaimed every poll,
             # starving its own requeued producer forever.
-            if int(task.get("replication") or 1) > 1:
+            from lua_mapreduce_tpu.faults.coded import (doc_redundancy,
+                                                        redundancy_on)
+            if redundancy_on(doc_redundancy(task)):
                 if "map" in self.phases:
                     jobs = self.store.claim_batch(
                         MAP_NS, self.name, self._effective_k(MAP_NS, task))
@@ -495,12 +505,20 @@ class Worker:
         the task document's fleet default, else v1."""
         return self.segment_format or self._task_segment_format or "v1"
 
-    def _replication(self) -> int:
-        """The shuffle replication factor this worker publishes and
-        reads with: its own override, else the task document's fleet
-        default, else 1 (off)."""
-        return int(self.replication if self.replication is not None
-                   else (self._task_replication or 1))
+    def _replication(self):
+        """The unified shuffle redundancy this worker publishes and
+        reads with — an int replication factor or a Coding: its own
+        coding override, else its own replication override, else the
+        task document's deployed value (coding spec first), else 1
+        (off)."""
+        from lua_mapreduce_tpu.faults.coded import (check_redundancy,
+                                                    doc_redundancy)
+        if self.coding is not None:
+            return check_redundancy(self.coding)
+        if self.replication is not None:
+            return check_redundancy(self.replication)
+        return doc_redundancy({"replication": self._task_replication,
+                               "coding": self._task_coding})
 
     def _push_on(self) -> bool:
         """Whether this worker publishes map output through the push
@@ -590,7 +608,8 @@ class Worker:
                     store.remove(name)
                 times.finished = times.written = time.time()
                 return times
-            if replication > 1:
+            from lua_mapreduce_tpu.faults.coded import redundancy_on
+            if redundancy_on(replication):
                 # every copy gone: a RECOVERABLE loss, not a dead job —
                 # release (no repetition charge) and name the files so
                 # the server's scavenger repairs them or requeues their

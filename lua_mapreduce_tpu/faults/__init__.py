@@ -18,7 +18,12 @@ The robustness subsystem (DESIGN §19). Four pieces:
   r-way spill publish fanout (:func:`spill_writer`), failover reads
   (:class:`ReplicatedStore`), and scavenger reconstruction
   (:func:`repair`), addressed by the deterministic placement function
-  in engine/placement.py.
+  in engine/placement.py;
+- ``coded`` — the erasure-coded data plane (DESIGN §27): GF(256)
+  Reed–Solomon k+m striping behind the same three choke points
+  (``spill_writer``/``reading_view``/``repair`` dispatch on the
+  unified redundancy knob), replication-grade durability at
+  (k+m)/k write amplification.
 """
 
 from lua_mapreduce_tpu.faults.errors import (ConcurrentInsertError,
@@ -30,6 +35,10 @@ from lua_mapreduce_tpu.faults.errors import (ConcurrentInsertError,
                                              classify_exception,
                                              describe_classification,
                                              is_transient_fault)
+from lua_mapreduce_tpu.faults.coded import (CodedStore, Coding,
+                                            check_redundancy, parse_coding,
+                                            redundancy_on, repair_stripe,
+                                            resolve_redundancy)
 from lua_mapreduce_tpu.faults.errors import LostShuffleDataError
 from lua_mapreduce_tpu.faults.plan import FaultPlan
 from lua_mapreduce_tpu.faults.replicate import (ReplicatedStore,
@@ -51,6 +60,8 @@ __all__ = [
     "ConcurrentInsertError", "LostShuffleDataError", "classify_exception",
     "is_transient_fault", "describe_classification",
     "ReplicatedStore", "reading_view", "repair", "spill_writer",
+    "Coding", "CodedStore", "parse_coding", "check_redundancy",
+    "redundancy_on", "resolve_redundancy", "repair_stripe",
     "RetryPolicy", "FaultCounters", "COUNTERS", "configure_retry",
     "retry_settings", "default_policy",
     "FaultPlan",
@@ -62,7 +73,7 @@ __all__ = [
 
 def utest() -> None:
     """Run the subsystem's module self-tests."""
-    from lua_mapreduce_tpu.faults import (errors, plan, replicate, retry,
-                                          wrappers)
-    for mod in (errors, retry, plan, wrappers, replicate):
+    from lua_mapreduce_tpu.faults import (coded, errors, plan, replicate,
+                                          retry, wrappers)
+    for mod in (errors, retry, plan, wrappers, replicate, coded):
         mod.utest()

@@ -27,7 +27,13 @@ Fault kinds (the failure modes the store/coord planes must survive):
                            (engine/placement.py) fails transient for a
                            clock window: the whole-failure-domain shape
                            ("all replicas on one backend died") the
-                           replicated shuffle must absorb (DESIGN §20)
+                           replicated shuffle must absorb (DESIGN §20).
+                           Coded-stripe blocks and manifest copies
+                           (faults/coded.py, DESIGN §27) route by the
+                           tag embedded in their physical names — a
+                           dark domain costs each stripe at most the
+                           one block it placed there, exactly the shape
+                           inline decode-from-survivors absorbs
 - ``slow``               — every data-plane op by workers matching
                            ``slow_worker`` sleeps ``slow_ms`` for a
                            clock window: the DEGRADED-MACHINE shape
@@ -384,6 +390,19 @@ def utest() -> None:
     vt[0] = 5.0                                  # window over
     assert bo.decide("read_range", "ns.P0.M1") is None
     assert bo.fired["blackout"] == 7
+    # coded-stripe blocks route by their EMBEDDED tag (placement
+    # parse_block): a dark tag darkens exactly the one block each
+    # stripe placed there — the ≤m-loss shape decode absorbs (§27)
+    from lua_mapreduce_tpu.faults.coded import Coding, block_names
+    blocks = block_names("cns.P0.M1", Coding(4, 1))
+    vt3 = [0.0]
+    bo2 = FaultPlan(11, blackout_tag=tag_of(blocks[2]), blackout_s=5.0,
+                    clock=lambda: vt3[0], sleep=lambda s: None)
+    assert sum(tag_of(b) == bo2.blackout_tag for b in blocks) == 1
+    for b2 in blocks:
+        want = "transient" if tag_of(b2) == bo2.blackout_tag else None
+        assert bo2.decide("read_range", b2) == want
+
     spec2 = FaultPlan(5, blackout_tag=3, blackout_s=0.25,
                       blackout_from_s=0.1).to_spec()
     q2 = FaultPlan.from_spec(spec2)

@@ -113,17 +113,28 @@ class _TeeBuilder(FileBuilder):
 
 
 def spill_writer(store: Store, segment_format: str = "v1",
-                 replication: int = 1, codec: str = "zlib"):
+                 replication=1, codec: str = "zlib", coding=None):
     """The ONE factory every spill producer uses (LMR009): a
-    v1/v2 record writer whose ``build(name)`` publishes ``replication``
-    copies at the placement function's addresses. ``replication=1``
-    returns exactly ``writer_for``'s plain writer — zero overhead."""
+    v1/v2 record writer whose ``build(name)`` publishes with the
+    configured redundancy at the placement function's addresses —
+    ``r`` full copies under replication, a k+m erasure-coded stripe
+    under ``coding="k+m"`` (faults/coded.py, DESIGN §27). The unified
+    knob: ``replication`` accepts an int OR a Coding/"k+m" spec (the
+    engines thread one value through), ``coding`` is the explicit
+    override. ``replication=1`` returns exactly ``writer_for``'s plain
+    writer — zero overhead."""
     from lua_mapreduce_tpu.core.segment import (SegmentWriter, TextWriter,
                                                 check_format, writer_for)
+    from lua_mapreduce_tpu.faults.coded import (Coding, check_redundancy,
+                                                stripe_builder)
     check_format(segment_format)
-    if check_replication(replication) == 1:
+    red = check_redundancy(coding if coding is not None else replication)
+    if isinstance(red, Coding):
+        builder: FileBuilder = stripe_builder(store, red)
+    elif red == 1:
         return writer_for(store, segment_format, codec=codec)
-    builder = _TeeBuilder(store, replication)
+    else:
+        builder = _TeeBuilder(store, red)
     if segment_format == "v2":
         return SegmentWriter(builder, codec=codec)
     return TextWriter(builder)
@@ -251,14 +262,23 @@ class ReplicatedStore(Store):
         return self._inner.classify(exc)
 
 
-def reading_view(store: Store, replication: int) -> Store:
-    """The engines' wrap point: the failover view when replication is
-    on, the store itself (identity — zero overhead) when it is not."""
-    if check_replication(replication) <= 1:
+def reading_view(store: Store, replication) -> Store:
+    """The engines' wrap point: the decode view when coding is on, the
+    failover view when replication is, the store itself (identity —
+    zero overhead) when neither. ``replication`` is the unified
+    redundancy value: int, Coding, or a "k+m" spec string."""
+    from lua_mapreduce_tpu.faults.coded import (CodedStore, Coding,
+                                                check_redundancy)
+    red = check_redundancy(replication)
+    if isinstance(red, Coding):
+        if isinstance(store, CodedStore):
+            return store
+        return CodedStore(store, red)
+    if red <= 1:
         return store
     if isinstance(store, ReplicatedStore):
         return store
-    return ReplicatedStore(store, replication)
+    return ReplicatedStore(store, red)
 
 
 # --------------------------------------------------------------------------
@@ -266,10 +286,12 @@ def reading_view(store: Store, replication: int) -> Store:
 # --------------------------------------------------------------------------
 
 
-def repair(store: Store, name: str, replication: int) -> str:
+def repair(store: Store, name: str, replication) -> str:
     """Restore full ``r``-way redundancy of ``name`` from any readable
     copy — the scavenger's cheap alternative to re-running the
-    producing map job.
+    producing map job. Under a coding spec this dispatches to
+    :func:`faults.coded.repair_stripe` (decode-from-survivors rebuild),
+    same verdict vocabulary.
 
     Returns ``"intact"`` (every copy already readable and whole),
     ``"repaired"`` (at least one copy rebuilt from a survivor),
@@ -281,8 +303,13 @@ def repair(store: Store, name: str, replication: int) -> str:
     by construction (atomic publishes + readback-verify below), so the
     first readable copy is trusted as the source; copies whose size
     disagrees with it are rebuilt too."""
+    from lua_mapreduce_tpu.faults.coded import (Coding, check_redundancy,
+                                                repair_stripe)
+    red = check_redundancy(replication)
+    if isinstance(red, Coding):
+        return repair_stripe(store, name, red)
     classify = _classifier(store)
-    copies = replica_names(name, check_replication(replication))
+    copies = replica_names(name, check_replication(red))
     data = None
     whole = set()
     for copy_name in copies:
@@ -391,3 +418,19 @@ def utest() -> None:
 
     assert reading_view(raw, 1) is raw                # identity when off
     assert not hasattr(reading_view(raw, 2), "local_path")
+
+    # coded dispatch: the unified knob routes "k+m" through the stripe
+    # layer (faults/coded.py, DESIGN §27) at every choke point
+    from lua_mapreduce_tpu.faults.coded import CodedStore, Coding
+    cs = MemStore()
+    with spill_writer(cs, "v1", "4+1") as w:
+        w.add("k", [9])
+        w.build("cns.P0.M00000001")
+    cview = reading_view(cs, Coding(4, 1))
+    assert isinstance(cview, CodedStore)
+    assert list(cview.lines("cns.P0.M00000001")) == ['["k",[9]]\n']
+    assert cs.list("cns.P*") == []            # no plain primary exists
+    assert cview.list("cns.P*") == ["cns.P0.M00000001"]
+    assert repair(cs, "cns.P0.M00000001", "4+1") == "intact"
+    assert reading_view(cview, "4+1") is cview
+    assert not hasattr(cview, "local_path")

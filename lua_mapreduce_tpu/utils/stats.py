@@ -72,6 +72,8 @@ COUNTER_FOLD = {
     "replica_repairs": ("replica_repairs",),
     "map_reruns_avoided": ("map_reruns_avoided",),
     "map_reruns": ("map_reruns",),
+    "decode_reads": ("decode_reads",),
+    "stripe_repairs": ("stripe_repairs",),
     "spec_launched": ("spec_launched",),
     "spec_wins": ("spec_wins",),
     "spec_cancelled": ("spec_cancelled",),
@@ -127,6 +129,14 @@ class IterationStats:
     #                        climbs
     #   map_reruns         — last-resort producer requeues (every
     #                        replica of a file gone)
+    # erasure-coded shuffle accounting (DESIGN §27), same fold:
+    #   decode_reads       — stripes reassembled from parity survivors
+    #                        after a block loss/corruption (one per
+    #                        logical file — the inline recovery twin of
+    #                        failover_reads)
+    #   stripe_repairs     — stripes the scavenger rebuilt to full k+m
+    #                        blocks from ≥k survivors (the coded twin
+    #                        of replica_repairs)
     # speculative-execution accounting (DESIGN §21), same fold:
     #   spec_launched  — duplicate leases the straggler detector opened
     #   spec_wins      — commit races a CLONE won (the original's
@@ -160,6 +170,8 @@ class IterationStats:
     replica_repairs: int = 0
     map_reruns_avoided: int = 0
     map_reruns: int = 0
+    decode_reads: int = 0
+    stripe_repairs: int = 0
     spec_launched: int = 0
     spec_wins: int = 0
     spec_cancelled: int = 0
@@ -210,6 +222,8 @@ class IterationStats:
             "replica_repairs": self.replica_repairs,
             "map_reruns_avoided": self.map_reruns_avoided,
             "map_reruns": self.map_reruns,
+            "decode_reads": self.decode_reads,
+            "stripe_repairs": self.stripe_repairs,
             "spec_launched": self.spec_launched,
             "spec_wins": self.spec_wins,
             "spec_cancelled": self.spec_cancelled,

@@ -67,6 +67,17 @@ echo "push smoke: golden matrix identical, eviction degrades, chaos held"
 # push vs staged byte-identical, globally sorted, frames actually
 # pushed (the full GB-scale artifact is benchmarks/results/sort.json)
 python benchmarks/sort_bench.py --smoke
+# coded-shuffle chaos smoke gate (DESIGN §27): a data block of every
+# 4+1 stripe destroyed — decode-from-survivors must deliver
+# byte-identical output with zero map re-runs; then the acceptance
+# leg: the extsort sort under coding with every stripe degraded at
+# the reduce barrier, byte-identical + globally sorted + zero
+# repetition charges (write amplification 1.3x where r=2 pays 2.0x —
+# benchmarks/results/replication.json coded_overhead carries the
+# measured numbers)
+python -m pytest tests/test_chaos.py -q -k "coded and smoke"
+python benchmarks/sort_bench.py --smoke-coded
+echo "coded smoke: degraded stripes decode inline, zero re-runs"
 # lmr-analyze gate: the framework-aware lint pass AND the
 # interprocedural deep pass (DESIGN §25: whole-program call graph +
 # context propagation — LMR013 flock-reachable IO, LMR014 unclassified

@@ -522,6 +522,83 @@ def test_lmr012_spill_writer_and_other_names_pass(tmp_path):
     assert all(f.rule != "LMR012" for f in got)
 
 
+# --- LMR009/LMR012 coded stripe-name hygiene (DESIGN §27) -------------------
+
+def test_lmr009_stripe_block_literals_flagged(tmp_path):
+    # "^i.t^" block names minted outside faults/coded.py bypass the
+    # codec's manifest/CRC/placement contract — every literal spelling
+    # (f-string with interpolated index/tag, fully literal, wildcard)
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        def read_block(store, base, i, t):
+            return store.read_range(f"^{i}.{t}^{base}", 0, 8)
+
+        def guess_block(store, base):
+            return store.exists("^0.3^" + base)
+
+        def scan_blocks(store, pat):
+            return store.list(f"^*^{pat}")
+        """)
+    assert [f.rule for f in got] == ["LMR009"] * 3
+    assert "faults.coded" in got[0].message
+
+
+def test_lmr009_stripe_block_negatives_pass(tmp_path):
+    # the codec's own home mints block names; helper calls and
+    # docstrings documenting the shape stay legal everywhere
+    got = _lint_snippet(tmp_path, "faults/coded.py", """\
+        def block_names(name, i, t):
+            return f"^{i}.{t}^{name}"
+        """)
+    assert all(f.rule != "LMR009" for f in got)
+    got = _lint_snippet(tmp_path, "engine/fx.py", '''\
+        from lua_mapreduce_tpu.faults.coded import stripe_patterns
+
+        def scan(store, pat):
+            """Lists physical stripe files (^0.3^x blocks etc.)."""
+            out = []
+            for sp in stripe_patterns(pat):
+                out += store.list(sp)
+            return out
+        ''')
+    assert all(f.rule != "LMR009" for f in got)
+
+
+def test_lmr012_manifest_literal_flagged(tmp_path):
+    # a hand-built "^M^" name forges the stripe visibility gate
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        def forge_gate(store, name):
+            with store.builder() as b:
+                b.write("{}")
+                b.build(f"^M^{name}")
+        """)
+    assert [f.rule for f in got if f.rule == "LMR012"] == ["LMR012"]
+    msg = [f for f in got if f.rule == "LMR012"][0].message
+    assert "visibility gate" in msg
+    # same marker in faults/ (the scavenger's neighborhood) trips too
+    got = _lint_snippet(tmp_path, "faults/fx.py", """\
+        def peek(store, name):
+            return store.exists("^M^" + name)
+        """)
+    assert [f.rule for f in got if f.rule == "LMR012"] == ["LMR012"]
+
+
+def test_lmr012_manifest_negatives_pass(tmp_path):
+    # the coded module itself, the pattern helpers, and docstrings
+    got = _lint_snippet(tmp_path, "faults/coded.py", """\
+        def manifest_name(name):
+            return f"^M^{name}"
+        """)
+    assert all(f.rule != "LMR012" for f in got)
+    got = _lint_snippet(tmp_path, "engine/fx.py", '''\
+        from lua_mapreduce_tpu.faults.coded import manifest_pattern
+
+        def scan_manifests(store, pat):
+            """Stripe manifests (^M^x) gate block visibility."""
+            return store.list(manifest_pattern(pat))
+        ''')
+    assert all(f.rule != "LMR012" for f in got)
+
+
 # --- LMR007 jax purity -----------------------------------------------------
 
 def test_lmr007_impure_traced_functions_flagged(tmp_path):
@@ -797,11 +874,80 @@ def test_replay_lost_data_requeue_on_real_stores(tmp_path, make_store):
     assert rep["ok"], rep
 
 
+def test_protocol_coded_recovery_edge_exhaustive():
+    """The erasure-coded decode ladder (DESIGN §27): block-at-a-time
+    lose_parity events, decode-repair, and the last-resort requeue keep
+    the FULL invariant set — including decode-conservation (no repair
+    of a below-k stripe)."""
+    for cfg in (proto.ModelConfig(n_workers=1, n_jobs=2, batch_k=2,
+                                  data_loss_budget=2, coded=True),
+                proto.ModelConfig(n_workers=2, n_jobs=2, batch_k=1,
+                                  data_loss_budget=2, coded=True)):
+        res = proto.check_protocol(cfg)
+        assert res.ok, res.violation.message
+        assert res.quiescent > 0
+
+
+def test_protocol_finds_decode_of_lost_stripe():
+    """A scavenger whose repair rung also 'heals' below-k stripes is
+    fabricating data — caught by the decode-conservation invariant on
+    the repair step itself."""
+    cfg = proto.ModelConfig(n_workers=1, n_jobs=2, batch_k=1,
+                            data_loss_budget=1, coded=True,
+                            bug="coded_decode_lost_stripe")
+    res = proto.check_protocol(cfg, max_states=200_000)
+    assert not res.ok
+    assert "below-k" in res.violation.message
+    assert res.violation.trace[-1][0] == "repair"
+
+
+def test_protocol_finds_decode_blind_requeue():
+    """A scavenger that treats ANY block loss as total loss (never
+    tries the decode rung) and skips the WRITTEN CAS yanks jobs out of
+    a concurrent commit — the illegal FINISHED→WAITING edge."""
+    cfg = proto.ModelConfig(n_workers=2, n_jobs=2, batch_k=1,
+                            data_loss_budget=2, coded=True,
+                            bug="coded_requeue_skips_decode")
+    res = proto.check_protocol(cfg, max_states=400_000)
+    assert not res.ok
+    assert "illegal status edge" in res.violation.message
+    assert res.violation.trace[-1][0] == "rerun_requeue"
+
+
+@pytest.mark.parametrize("make_store", [
+    lambda tmp: MemJobStore(),
+    lambda tmp: FileJobStore(str(tmp / "js"), engine="python"),
+], ids=["mem", "file-py"])
+def test_replay_decode_blind_requeue_diverges_on_real_stores(
+        tmp_path, make_store):
+    """The decode-blind requeue bug's trace DIVERGES on both real
+    stores: the expect=(WRITTEN,) CAS of the requeue refuses the step
+    the buggy model allowed."""
+    cfg = proto.ModelConfig(n_workers=2, n_jobs=2, batch_k=1,
+                            data_loss_budget=2, coded=True,
+                            bug="coded_requeue_skips_decode")
+    res = proto.check_protocol(cfg, max_states=400_000)
+    assert not res.ok
+    rep = proto.replay_trace(make_store(tmp_path), res.violation.trace,
+                             cfg)
+    assert not rep["ok"], rep
+    assert rep["label"][0] in ("rerun_requeue", "commit_a", "commit_b",
+                               "claim")
+
+
 def test_model_rejects_oversize_and_unknown_bug():
     with pytest.raises(ValueError):
         proto.ModelConfig(n_workers=9)
     with pytest.raises(ValueError):
         proto.ModelConfig(bug="nope")
+    with pytest.raises(ValueError):
+        # coded bugs are unreachable without the coded plane + budget
+        proto.ModelConfig(bug="coded_requeue_skips_decode",
+                          data_loss_budget=2)
+    with pytest.raises(ValueError):
+        # an inert coded plane (no budget → no lose_parity) is a
+        # config error, not a vacuous pass
+        proto.ModelConfig(coded=True)
 
 
 def test_mark_broken_requires_running_status(tmp_path):

@@ -108,7 +108,7 @@ def _plan(seed, heavy=False):
 
 
 def _run_local(tmp_path, backend, pipeline, tag, plan=None, replication=1,
-               push=False, push_budget_mb=None):
+               push=False, push_budget_mb=None, coding=None):
     _install_module()
     spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
                     reducefn=_MOD,
@@ -118,20 +118,21 @@ def _run_local(tmp_path, backend, pipeline, tag, plan=None, replication=1,
         ex = LocalExecutor(spec, map_parallelism=3, pipeline=pipeline,
                            premerge_min_runs=2,
                            segment_format="v2" if pipeline else "v1",
-                           replication=replication, push=push,
-                           push_budget_mb=push_budget_mb)
+                           replication=replication, coding=coding,
+                           push=push, push_budget_mb=push_budget_mb)
         stats = ex.run()
     finally:
         install_fault_plan(None)
     got = {k: v[0] for k, v in ex.results()}
     assert got == GOLDEN
     return _result_bytes(spec.storage,
-                         only_results=replication > 1 or push), stats
+                         only_results=replication > 1 or push
+                         or coding is not None), stats
 
 
 def _run_distributed(tmp_path, backend, pipeline, tag, plan=None,
                      n_workers=2, replication=1, speculation=0.0,
-                     straggler=False, batch_k=2, push=False):
+                     straggler=False, batch_k=2, push=False, coding=None):
     _install_module()
     spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
                     reducefn=_MOD,
@@ -142,7 +143,7 @@ def _run_distributed(tmp_path, backend, pipeline, tag, plan=None,
         server = Server(store, poll_interval=0.01, pipeline=pipeline,
                         premerge_min_runs=2, batch_k=batch_k,
                         segment_format="v2" if pipeline else "v1",
-                        replication=replication,
+                        replication=replication, coding=coding,
                         speculation=speculation, push=push).configure(spec)
         # ``straggler`` names the LAST worker "straggler-0" (the slow
         # FaultPlan kind routes by worker name) and gives it a head
@@ -197,8 +198,8 @@ def _run_distributed(tmp_path, backend, pipeline, tag, plan=None,
     # legitimately leaves identical-bytes run files behind (its commit
     # lands nowhere), exactly like replica-kill legs leave dead copies
     return _result_bytes(spec.storage,
-                         only_results=replication > 1
-                         or speculation > 0 or push), stats
+                         only_results=replication > 1 or speculation > 0
+                         or push or coding is not None), stats
 
 
 def _wait_for_claim(store, timeout=30.0):
@@ -647,14 +648,19 @@ def test_push_chaos_spec_straggler_quarantine(tmp_path):
             f"quarantined fragment {name} visible outside its lineage"
 
 
-def test_push_chaos_sigkill_pusher_midframe(tmp_path):
+def _sigkill_pusher_leg(tmp_path, modname, coding=None):
     """SIGKILL a pushing mapper mid-frame (a real subprocess worker,
     slowed by the plan so it is verifiably mid-push when killed) with
     speculation on and the stale-requeue DISABLED: only a clone's
     first-commit-wins coverage can finish the job, so completion with
     zero repetition charges is load-bearing, not luck. The victim's
     partial inbox (frames with no manifest) stays invisible and is
-    swept; output is byte-identical to the fault-free staged twin."""
+    swept; output is byte-identical to the fault-free staged twin.
+
+    With ``coding`` set the same storm runs on the erasure-coded push
+    plane (DESIGN §27): the kill lands mid-STRIPE, and the manifest
+    gate — member manifests published strictly after every block — is
+    what keeps the victim's partial stripe invisible."""
     import json as _json
     import os
     import signal
@@ -664,7 +670,7 @@ def test_push_chaos_sigkill_pusher_midframe(tmp_path):
 
     from lua_mapreduce_tpu.coord.filestore import FileJobStore
 
-    clean, _ = _run_local(tmp_path, "mem", False, "push-kill-c")
+    clean, _ = _run_local(tmp_path, "mem", False, f"kill-{modname}-c")
 
     _install_module()
     # the distributed fleet round-trips user modules by import path:
@@ -672,7 +678,7 @@ def test_push_chaos_sigkill_pusher_midframe(tmp_path):
     # can import
     moddir = tmp_path / "mods"
     moddir.mkdir()
-    (moddir / "pushkill_wc.py").write_text(
+    (moddir / f"{modname}.py").write_text(
         "CORPUS = " + repr(CORPUS) + "\n"
         "def taskfn(emit):\n"
         "    for k, v in sorted(CORPUS.items()): emit(k, v)\n"
@@ -687,8 +693,8 @@ def test_push_chaos_sigkill_pusher_midframe(tmp_path):
     import sys as _sys
     _sys.path.insert(0, str(moddir))
     try:
-        spec = TaskSpec(taskfn="pushkill_wc", mapfn="pushkill_wc",
-                        partitionfn="pushkill_wc", reducefn="pushkill_wc",
+        spec = TaskSpec(taskfn=modname, mapfn=modname,
+                        partitionfn=modname, reducefn=modname,
                         storage=f"shared:{spill}")
         plan = FaultPlan(229, slow_worker="victim-*", slow_ms=250.0,
                          slow_s=3600.0)
@@ -714,7 +720,8 @@ def test_push_chaos_sigkill_pusher_midframe(tmp_path):
         store = FileJobStore(str(coord))
         server = Server(store, poll_interval=0.05, push=True,
                         stale_timeout_s=None,   # ONLY speculation saves it
-                        speculation=2.0, batch_k=1).configure(spec)
+                        speculation=2.0, batch_k=1,
+                        coding=coding).configure(spec)
         final = {}
         st = threading.Thread(
             target=lambda: final.setdefault("stats", server.loop()),
@@ -735,17 +742,27 @@ def test_push_chaos_sigkill_pusher_midframe(tmp_path):
             time.sleep(0.05)
         else:
             raise AssertionError("victim never claimed a lease")
-        healthy = [spawn(f"healthy-{i}") for i in range(2)]
+        # plain mode: the healthy fleet races the slowed victim from the
+        # start. Coded mode: the victim's first physical artifact is a
+        # stripe BLOCK near the end of its job body, so a pre-spawned
+        # fleet's clone would commit the job before the mid-stripe
+        # window ever opens — spawn the fleet AFTER the kill instead
+        # (the contract under test is identical: only a clone's
+        # zero-charge coverage may finish the dead victim's job)
+        healthy = [] if coding is not None \
+            else [spawn(f"healthy-{i}") for i in range(2)]
 
         # kill the victim the moment it is verifiably MID-PUSH: a
         # frame of one of its claimed jobs landed, more output pending
+        from lua_mapreduce_tpu.engine.placement import parse_block
         deadline = time.time() + 90
         killed = False
         while time.time() < deadline and not killed:
             frags = []
             if spill.exists():
                 frags = [f for f in os.listdir(spill)
-                         if ".INBOX-" in f]
+                         if (parse_block(f) if coding is not None
+                             else ".INBOX-" in f)]
             if frags:
                 try:
                     # the victim must HOLD a live lease right now — the
@@ -759,10 +776,13 @@ def test_push_chaos_sigkill_pusher_midframe(tmp_path):
                     running = []
                 # ... and be verifiably MID-FRAME: a frame of one of
                 # ITS running jobs already landed, its manifest/commit
-                # have not (it is still RUNNING)
+                # have not (it is still RUNNING). Coded artifacts spell
+                # the map key two ways: individually striped frames
+                # embed the .INBOX- fragment name in each block, group
+                # stripes embed the key in the .CODE. group base.
                 from lua_mapreduce_tpu.engine.job import map_key_str
                 keys = {map_key_str(d["_id"]) for d in running}
-                mid_frame = any(f".INBOX-{k}-" in f
+                mid_frame = any(f".INBOX-{k}-" in f or f".CODE.{k}" in f
                                 for k in keys for f in frags)
                 if mid_frame:
                     victim.send_signal(signal.SIGKILL)
@@ -770,6 +790,8 @@ def test_push_chaos_sigkill_pusher_midframe(tmp_path):
                     break
             time.sleep(0.05)
         assert killed, "victim never got mid-push before the deadline"
+        if not healthy:
+            healthy = [spawn(f"healthy-{i}") for i in range(2)]
 
         st.join(timeout=120)
         assert not st.is_alive(), \
@@ -801,3 +823,210 @@ def test_push_chaos_sigkill_pusher_midframe(tmp_path):
     # else can have finished the victim's job
     it = stats.iterations[-1]
     assert it.spec_launched >= 1, "detector never opened a shadow lease"
+
+    if coding is not None:
+        # the manifest gate, structurally: every stripe block left on
+        # disk either belongs to a COMPLETE stripe (its logical name is
+        # visible and fully readable through the coded view) or its
+        # manifest never landed — in which case the logical name must
+        # be invisible. A readable-but-partial stripe would be a torn
+        # read waiting to happen; the gate makes that state
+        # unrepresentable.
+        from lua_mapreduce_tpu.engine.placement import base_name, parse_block
+        from lua_mapreduce_tpu.faults.replicate import reading_view
+        raw = get_storage_from(spec.storage)
+        view = reading_view(raw, coding)
+        blocks = [f for f in os.listdir(spill) if parse_block(f)]
+        assert blocks, "coded sigkill leg never published a stripe block"
+        for f in blocks:
+            base = base_name(f)
+            if view.exists(base):
+                assert view.size(base) >= 0  # complete => readable
+    return stats
+
+
+def test_push_chaos_sigkill_pusher_midframe(tmp_path):
+    _sigkill_pusher_leg(tmp_path, "pushkill_wc")
+
+
+def test_coded_chaos_sigkill_pusher_midstripe(tmp_path):
+    """The ISSUE 16 SIGKILL-mid-stripe chaos gate: the same storm on
+    the coded push plane — a partial stripe (blocks with no member
+    manifest) stays invisible, a clone covers the killed producer, and
+    the output is byte-identical with zero repetition charges."""
+    _sigkill_pusher_leg(tmp_path, "codedkill_wc", coding="4+1")
+
+
+# --- erasure-coded shuffle legs (DESIGN §27) ---------------------------------
+#
+# The ISSUE 16 chaos gate: the replication bar carried over verbatim to
+# the coded plane at ~1.3x write amplification instead of 2x. A
+# FaultPlan destroys one block of EVERY stripe (the coded analog of
+# 'every primary destroyed' — any <= m losses per stripe must decode
+# inline), a whole placement tag goes dark during a coded push run, a
+# producer is SIGKILLed mid-stripe (above), and a corrupted parity
+# block must be caught by the block CRC and treated as one more lost
+# block, not served.
+
+def _kill_block0_plan(seed):
+    """Every read of the FIRST data block of every stripe fails
+    permanently — one destroyed block per stripe, the r-1-of-r kill
+    translated to k+m (the pattern's ^0. prefix never matches a
+    manifest copy, a plain tail, or a list() pattern argument)."""
+    return FaultPlan(seed, permanent=1.0, pattern="^0.*^result.*",
+                     max_per_key=100_000, latency_ms=0)
+
+
+def test_coded_smoke_decode(tmp_path):
+    """The test.sh coded chaos gate: one fast leg — a data block of
+    every stripe destroyed, parity decodes inline, zero map re-runs,
+    byte-identical output."""
+    clean, _ = _run_local(tmp_path, "mem", False, "cod-smoke-c")
+    plan = _kill_block0_plan(251)
+    chaotic, stats = _run_local(tmp_path, "mem", False, "cod-smoke-f",
+                                plan=plan, coding="4+1")
+    assert chaotic == clean, "coded decode leg output differs"
+    assert plan.total_fired() > 0
+    it = stats.iterations[-1]
+    assert it.decode_reads > 0, "plan never forced a decode"
+    assert it.map_reruns_avoided > 0
+    assert it.map_reruns == 0, "parity failed to absorb the block kills"
+
+
+@pytest.mark.parametrize("pipeline", [False, True],
+                         ids=["barrier", "pipelined"])
+@pytest.mark.parametrize("backend", ["mem", "shared", "object"])
+def test_coded_chaos_distributed_matrix(tmp_path, backend, pipeline):
+    """The acceptance matrix on the distributed engine under coding
+    4+1: one block of every stripe destroyed across
+    {mem,shared,object} x {barrier,pipelined} — byte-identical to the
+    fault-free twin, zero repetition bumps (asserted per job inside
+    _run_distributed), zero map re-runs: pure decode reads."""
+    tag = f"cod-{backend}-{int(pipeline)}"
+    clean, _ = _run_distributed(tmp_path, backend, pipeline, tag + "-c")
+    plan = _kill_block0_plan(257)
+    chaotic, stats = _run_distributed(tmp_path, backend, pipeline,
+                                      tag + "-f", plan=plan, coding="4+1")
+    assert chaotic == clean, "coded decode leg output differs"
+    assert plan.total_fired() > 0
+    it = stats.iterations[-1]
+    assert it.decode_reads > 0, "plan never forced a decode"
+    assert it.map_reruns == 0, "parity failed to absorb the block kills"
+
+
+def test_coded_chaos_blackout_push(tmp_path):
+    """m placement tags dark (m=1 for 4+1) for the WHOLE of a coded
+    PUSH run — every stripe block, group-stripe block, manifest copy
+    and replicated eviction tail routed onto the dark tag is
+    unreadable. Each stripe spans k+m distinct tags so it loses at
+    most one block; each manifest and tail has m+1 copies on distinct
+    tags: the run completes byte-identical with ZERO map re-runs."""
+    from lua_mapreduce_tpu.engine.placement import replica_pattern
+    from lua_mapreduce_tpu.faults.coded import stripe_patterns
+
+    clean, _ = _run_local(tmp_path, "mem", True, "cod-bo-c")
+    # scope the blackout to the whole shuffle plane, in every physical
+    # spelling: plain names (staged runs, eviction tails), ~-replica
+    # copies, ^-stripe blocks and manifest copies, and the shared
+    # group-stripe blocks under the CODE tag
+    shuffle = ["result.P[0-9]*.M*", "result.P[0-9]*.SPILL-*",
+               "result.P[0-9]*.INBOX-*", "result.PUSH.M*", "result.CODE.*"]
+    phys = []
+    for p in shuffle:
+        phys += [p, replica_pattern(p)]
+        for sp in stripe_patterns(p):
+            phys += [sp, replica_pattern(sp)]
+    plan = FaultPlan(241, blackout_tag=2, blackout_s=3600.0,
+                     pattern="|".join(phys), latency_ms=0)
+    chaotic, stats = _run_local(tmp_path, "mem", True, "cod-bo-f",
+                                plan=plan, push=True, coding="4+1")
+    assert chaotic == clean, "coded blackout leg output differs"
+    assert plan.fired.get("blackout", 0) > 0, "the dark tag was never hit"
+    it = stats.iterations[-1]
+    assert it.push_frames > 0
+    assert it.decode_reads + it.failover_reads > 0, \
+        "the blackout never forced a degraded read"
+    assert it.map_reruns == 0
+
+
+def test_coded_chaos_corrupt_parity_block(tmp_path):
+    """A corrupted parity block is DETECTED by the per-block CRC and
+    treated as one more lost block — never folded into a decode. With
+    4+2, one data block destroyed AND one parity block corrupted on
+    the same stripe still leaves k readable blocks: the reduce decodes
+    inline, zero map re-runs, byte-identical output."""
+    import time
+
+    clean, _ = _run_distributed(tmp_path, "shared", False, "cod-crc-c")
+
+    _install_module()
+    spec = TaskSpec(taskfn=_MOD, mapfn=_MOD, partitionfn=_MOD,
+                    reducefn=_MOD,
+                    storage=_storage(tmp_path, "shared", "cod-crc-f"))
+    store = MemJobStore()
+    server = Server(store, poll_interval=0.01, premerge_min_runs=2,
+                    batch_k=2, coding="4+2").configure(spec)
+    # map-only worker first: the reduce phase is reached with NO reduce
+    # consumer, so the corruption below races nothing
+    mapper = Worker(store).configure(max_iter=4000, max_sleep=0.02,
+                                     phases=("map",))
+    final = {}
+    st = threading.Thread(
+        target=lambda: final.setdefault("stats", server.loop()),
+        daemon=True)
+    mt = threading.Thread(target=mapper.execute, daemon=True)
+    st.start()
+    mt.start()
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            if store.counts(RED_NS)[Status.WAITING] > 0:
+                break
+        except Exception:
+            pass
+        time.sleep(0.01)
+    else:
+        raise AssertionError("never reached the reduce phase")
+
+    # mutate the stripe on disk, under the engine: delete the block-0
+    # file of one partition-0 run and flip one byte inside the SAME
+    # stripe's first parity block (index k=4) — the decode that the
+    # deletion forces must reject the corrupted parity on CRC and
+    # reconstruct from the remaining k survivors
+    import os
+
+    from lua_mapreduce_tpu.engine.placement import base_name
+
+    spill_dir = str(tmp_path / "shared-cod-crc-f")
+    data0 = [f for f in os.listdir(spill_dir)
+             if f.startswith("^0.") and "result.P0." in f]
+    assert data0, "partition 0 produced no stripe blocks"
+    victim_base = base_name(data0[0])
+    stripe = [f for f in os.listdir(spill_dir)
+              if f.endswith(victim_base) and "^" in f]
+    parity = [f for f in stripe if f.startswith("^4.")]
+    assert parity, f"stripe of {victim_base} has no parity block"
+    ppath = os.path.join(spill_dir, parity[0])
+    blob = open(ppath, "rb").read()
+    pos = min(10, len(blob) - 1)
+    with open(ppath, "wb") as fh:
+        fh.write(blob[:pos] + bytes([blob[pos] ^ 0xFF]) + blob[pos + 1:])
+    os.remove(os.path.join(spill_dir, data0[0]))
+
+    reducer = Worker(store).configure(max_iter=4000, max_sleep=0.05)
+    rt = threading.Thread(target=reducer.execute, daemon=True)
+    rt.start()
+    st.join(timeout=60)
+    assert not st.is_alive(), "server wedged after the block mutation"
+    mt.join(timeout=10)
+    rt.join(timeout=10)
+
+    raw = get_storage_from(spec.storage)
+    got = {k: v[0] for k, v in iter_results(raw, "result")}
+    assert got == GOLDEN
+    assert _result_bytes(spec.storage, only_results=True) == clean
+    it = final["stats"].iterations[-1]
+    assert it.decode_reads > 0, "the mutation never forced a decode"
+    assert it.map_reruns == 0, \
+        "corrupt parity + one lost data block must decode, not re-run"
