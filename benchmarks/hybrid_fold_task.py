@@ -1,0 +1,58 @@
+"""Reduce-leg hybrid bench task (DESIGN §28): interpreted map, compiled
+reduce fold.
+
+The mirror image of benchmarks/hybrid_task.py: mapfn is deliberately
+host-bound (sorted() keeps it off the compiled plane) so ONLY the
+reduce stage qualifies — engine=hybrid runs the identical interpreted
+map/shuffle as engine=store and the paired wall ratio isolates the
+jitted ACI fold against the host accumulator loop. Values are float32
+so the two planes may reassociate the fold; ingraph_bench compares the
+results allclose (atol 1e-4), not byte-for-byte. Runs the "loop"
+protocol like its sibling so the fold's one compile amortises.
+"""
+
+import hashlib
+
+N_JOBS = 16
+KEYS = 8
+EMITS = 64
+ITERS = 16
+
+_STEP = {"n": 0}
+
+
+def taskfn(emit):
+    for j in range(N_JOBS):
+        emit(j, {"vals": [((j * EMITS + i) * 37 % 1009) / 8.0
+                          for i in range(EMITS)]})
+
+
+def mapfn(key, value, emit):
+    vals = sorted(value["vals"])
+    for i in range(EMITS):
+        emit(i % KEYS, float(vals[i]))
+
+
+def partitionfn(key):
+    h = hashlib.blake2b(str(int(key)).encode(),
+                        digest_size=2).hexdigest()
+    return int(h, 16) % 2
+
+
+def reducefn(key, values):
+    acc = values[0]
+    for i in range(1, len(values)):
+        acc = acc + values[i]
+    return acc
+
+
+def finalfn(pairs):
+    _STEP["n"] += 1
+    if _STEP["n"] < ITERS:
+        return "loop"
+    _STEP["n"] = 0              # self-reset: back-to-back bench legs
+    return None
+
+
+reducefn.associative_reducer = True
+reducefn.commutative_reducer = True

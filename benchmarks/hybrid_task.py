@@ -1,0 +1,75 @@
+"""Extsort-shaped hybrid bench task (DESIGN §28): compiled map+combine
+leg, host-hash partition, ACI reduce.
+
+The stage split engine=auto negotiates here is the one the hybrid rung
+exists for: mapfn (op-dense jnp transform) and combinerfn (the reducefn
+alias) are in-graph eligible and batch through ONE shard_map program
+per iteration, partitionfn is a host-side blake2b bucket that pins the
+whole-task verdict to store-plane, and the spill/shuffle tail is the
+ordinary interpreted JSEG path. Integer dtype end to end so the
+store-vs-hybrid comparison is BYTE-identical, not allclose.
+
+Every job emits the SAME key set (0..EMITS-1) the same number of
+times — the uniformity the batched shard_map tier requires — and the
+task runs the "loop" protocol for ITERS iterations, so the ONE
+compile of the map+combine program amortises exactly the way a real
+multi-pass sort's repeated claim batches would. The interpreted store
+plane pays per-op eager dispatch for every map call every iteration;
+that gap, not the arithmetic itself, is what
+benchmarks/ingraph_bench.py's hybrid_sort leg measures.
+"""
+
+import hashlib
+
+import jax.numpy as jnp
+
+N_JOBS = 16
+VEC = 256
+EMITS = 16
+OPS = 48
+ITERS = 128
+
+_STEP = {"n": 0}
+
+
+def taskfn(emit):
+    for j in range(N_JOBS):
+        emit(j, {"vals": [((j * VEC + i) * 2654435761) % 1000003
+                          for i in range(VEC)]})
+
+
+def mapfn(key, value, emit):
+    v = jnp.asarray(value["vals"], jnp.int32)
+    for _ in range(OPS):
+        v = (v * 3 + 7) % 65521
+    for i in range(EMITS):
+        # every key twice: the in-graph combiner has real work per key;
+        # the key set is job-independent (the batched tier's contract)
+        emit(i, v[i * (VEC // EMITS)])
+        emit(i, v[i * (VEC // EMITS) + 1])
+
+
+def partitionfn(key):
+    h = hashlib.blake2b(str(int(key)).encode(),
+                        digest_size=2).hexdigest()
+    return int(h, 16) % 4
+
+
+def reducefn(key, values):
+    acc = values[0]
+    for i in range(1, len(values)):
+        acc = acc + values[i]
+    return acc
+
+
+def finalfn(pairs):
+    _STEP["n"] += 1
+    if _STEP["n"] < ITERS:
+        return "loop"
+    _STEP["n"] = 0              # self-reset: back-to-back bench legs
+    return None
+
+
+reducefn.associative_reducer = True
+reducefn.commutative_reducer = True
+combinerfn = reducefn

@@ -107,6 +107,106 @@ def _allclose(a, b) -> bool:
     return bool(np.allclose(a, b, rtol=1e-4, atol=1e-4))
 
 
+# -- hybrid legs (DESIGN §28) -------------------------------------------------
+#
+# Two loop-protocol workloads on the stage-granular plane, store vs
+# hybrid under the same paired-rounds protocol (the one compile
+# amortises over ITERS iterations exactly as digits/kmeans do):
+#
+# - **hybrid_sort** — benchmarks/hybrid_task.py, the extsort shape the
+#   rung exists for: compiled map+combine batch, host blake2b
+#   partition, interpreted shuffle tail. Integer dtype: the two legs'
+#   result.P files must be BYTE-identical. Acceptance: median paired
+#   speedup >= 1.5.
+# - **hybrid_fold** — benchmarks/hybrid_fold_task.py, the mirror split:
+#   host-bound map, compiled reduce fold. float32, results compared
+#   allclose (atol 1e-4 — the jitted fold may reassociate). Measured,
+#   not gated: on CPU the host accumulator over small decoded floats is
+#   already near-free, the number documents where the split's win
+#   actually lives (the map leg).
+
+def _result_docs(tag: str) -> dict:
+    from lua_mapreduce_tpu.store.router import get_storage_from
+    store = get_storage_from(f"mem:igb-{tag}")
+    return {n: "".join(store.lines(n)) for n in store.list("result.P*")}
+
+
+def _result_rows(tag: str):
+    """Decoded (key, values) rows in deterministic order — the float
+    twin compare (allclose, not bytes)."""
+    from lua_mapreduce_tpu.engine.local import iter_results
+    from lua_mapreduce_tpu.store.router import get_storage_from
+    rows = list(iter_results(get_storage_from(f"mem:igb-{tag}"), "result"))
+    rows.sort(key=lambda r: str(r[0]))
+    return rows
+
+
+def _hybrid_leg(mod: str, engine: str, tag: str) -> dict:
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.local import LocalExecutor
+    spec = TaskSpec(taskfn=mod, mapfn=mod, partitionfn=mod, reducefn=mod,
+                    combinerfn=mod if mod.endswith("hybrid_task") else None,
+                    finalfn=mod, storage=f"mem:igb-{tag}")
+    ex = LocalExecutor(spec, engine=engine, max_iterations=192)
+    t0 = time.perf_counter()
+    ex.run()
+    wall = time.perf_counter() - t0
+    its = ex.stats.iterations
+    return {"wall_s": wall,
+            "results": _result_docs(tag),
+            "rows": _result_rows(tag),
+            "map_legs": sum(it.hybrid_map_legs for it in its),
+            "reduce_legs": sum(it.hybrid_reduce_legs for it in its),
+            "fallbacks": sum(it.hybrid_fallbacks for it in its)}
+
+
+def _hybrid_workload(name: str, mod: str, rounds: int,
+                     float_fold: bool = False,
+                     warmup: bool = True) -> dict:
+    if warmup:
+        # same eager-cache warmup rationale as _workload
+        _hybrid_leg(mod, "store", f"{name}-warm-s")
+        _hybrid_leg(mod, "hybrid", f"{name}-warm-h")
+    store_rows, hy_rows = [], []
+    agree = True
+    for rnd in range(rounds):
+        pair = {}
+        for eng in leg_order(("store", "hybrid"), rnd):
+            pair[eng] = _hybrid_leg(mod, eng, f"{name}-{eng}-{rnd}")
+        store_rows.append(pair["store"])
+        hy_rows.append(pair["hybrid"])
+        if float_fold:
+            a = pair["store"]["rows"]
+            b = pair["hybrid"]["rows"]
+            agree = agree and len(a) == len(b) and all(
+                x[0] == y[0] and _allclose(x[1], y[1])
+                for x, y in zip(a, b))
+        else:
+            agree = agree and (pair["store"]["results"]
+                               == pair["hybrid"]["results"])
+        # the hybrid leg must have RUN its compiled stage, fallback-free,
+        # and the store leg must not have touched the hybrid plane
+        if name == "hybrid_sort":
+            assert pair["hybrid"]["map_legs"] >= 1, pair["hybrid"]
+        else:
+            assert pair["hybrid"]["reduce_legs"] >= 1, pair["hybrid"]
+        assert pair["hybrid"]["fallbacks"] == 0
+        assert pair["store"]["map_legs"] == 0
+        assert pair["store"]["reduce_legs"] == 0
+    sp = paired_speedup(store_rows, hy_rows, "wall_s")
+    med = sp["median_round"]
+    return {
+        "speedup": sp["speedup"],
+        "speedup_pairs": sp["per_round"],
+        "wall_s_store": round(store_rows[med]["wall_s"], 3),
+        "wall_s_hybrid": round(hy_rows[med]["wall_s"], 3),
+        "hybrid_map_legs": hy_rows[med]["map_legs"],
+        "hybrid_reduce_legs": hy_rows[med]["reduce_legs"],
+        "hybrid_fallbacks": hy_rows[med]["fallbacks"],
+        ("results_allclose" if float_fold else "results_identical"): agree,
+    }
+
+
 def _steady_ratio(store_row: dict, ig_row: dict) -> float:
     """Per-iteration medians, the compiled leg's compile-carrying first
     iteration excluded — the asymptotic ratio."""
@@ -173,14 +273,24 @@ def run(rounds: int = 3, digits_steps: int = 60,
     _cpu_env()
     digits = _workload("digits", _digits_leg, digits_steps, rounds)
     kmeans = _workload("kmeans", _kmeans_leg, kmeans_iters, rounds)
+    hybrid_sort = _hybrid_workload(
+        "hybrid_sort", "benchmarks.hybrid_task", rounds)
+    hybrid_fold = _hybrid_workload(
+        "hybrid_fold", "benchmarks.hybrid_fold_task", rounds,
+        float_fold=True)
     return {
         "ingraph_speedup": min(digits["speedup"], kmeans["speedup"]),
         "ingraph_compile_s": max(digits["compile_s"],
                                  kmeans["compile_s"]),
+        "hybrid_speedup": hybrid_sort["speedup"],
         "digits": digits,
         "kmeans": kmeans,
+        "hybrid_sort": hybrid_sort,
+        "hybrid_fold": hybrid_fold,
         "identical_state": digits["state_allclose"]
-        and kmeans["state_allclose"],
+        and kmeans["state_allclose"]
+        and hybrid_sort["results_identical"]
+        and hybrid_fold["results_allclose"],
         "config": {"rounds": rounds, "digits": {**DIGITS_ARGS,
                                                 "max_steps": digits_steps},
                    "kmeans": {**KMEANS_ARGS, "max_iters": kmeans_iters},
@@ -209,9 +319,30 @@ def smoke() -> int:
     return 0 if ok else 1
 
 
+def smoke_hybrid() -> int:
+    """test.sh gate (DESIGN §28): one tiny paired round per hybrid
+    split — the negotiated stage legs must run compiled,
+    fallback-free, byte-identical (int) / allclose (float) to the
+    interpreted twin."""
+    _cpu_env()
+    hs = _hybrid_workload("hybrid_sort", "benchmarks.hybrid_task", 1,
+                          warmup=False)
+    hf = _hybrid_workload("hybrid_fold", "benchmarks.hybrid_fold_task",
+                          1, float_fold=True, warmup=False)
+    ok = hs["results_identical"] and hf["results_allclose"]
+    print(f"hybrid smoke: sort x{hs['speedup']} "
+          f"(map_legs={hs['hybrid_map_legs']}) "
+          f"fold x{hf['speedup']} "
+          f"(reduce_legs={hf['hybrid_reduce_legs']}) "
+          f"bytes/allclose={ok} -> {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
 def main() -> None:
     if "--smoke" in sys.argv:
         raise SystemExit(smoke())
+    if "--smoke-hybrid" in sys.argv:
+        raise SystemExit(smoke_hybrid())
     rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 3
     out = run(rounds=rounds)
     out["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%S")
@@ -219,12 +350,15 @@ def main() -> None:
     with open(RESULTS, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out, indent=2))
-    ok = out["ingraph_speedup"] >= 3.0 and out["identical_state"]
+    ok = (out["ingraph_speedup"] >= 3.0 and out["hybrid_speedup"] >= 1.5
+          and out["identical_state"])
     print(f"acceptance: speedup {out['ingraph_speedup']} >= 3.0 "
           f"(digits {out['digits']['speedup']}, steady "
           f"{out['digits']['steady_state_speedup']}; kmeans "
           f"{out['kmeans']['speedup']}, steady "
           f"{out['kmeans']['steady_state_speedup']}), "
+          f"hybrid_sort {out['hybrid_speedup']} >= 1.5 "
+          f"(fold leg {out['hybrid_fold']['speedup']} measured), "
           f"state allclose={out['identical_state']} -> "
           f"{'PASS' if ok else 'FAIL'}")
 

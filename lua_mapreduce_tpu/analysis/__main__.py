@@ -145,6 +145,13 @@ def _cmd_task(args) -> tuple:
     spec. Fails on findings (always — an invalid task module is never a
     soft result) and on an ``--expect`` verdict mismatch."""
     reports = [contracts_mod.check_task(spec) for spec in args.paths]
+    expected = []
+    for pin in args.expect_stage or ():
+        name, _, want = pin.partition("=")
+        if not want:
+            raise SystemExit(f"--expect-stage needs NAME=VERDICT, "
+                             f"got {pin!r}")
+        expected.append((name, want))
     fail = False
     for rep in reports:
         if any(f.severity == "error" for f in rep.findings):
@@ -155,6 +162,18 @@ def _cmd_task(args) -> tuple:
                 fr.verdict == contracts_mod.VERDICT_INGRAPH
                 for fr in rep.functions.values()):
             fail = True
+        stages = contracts_mod.stage_report(rep)
+        for name, want in expected:
+            if name in stages:
+                got = "compiled" if stages[name]["compiled"] \
+                    else "interpreted"
+            else:
+                fr = rep.functions.get(name)
+                got = fr.verdict if fr is not None else "<missing>"
+            if got != want:
+                print(f"{rep.spec}: --expect-stage {name}={want} "
+                      f"but oracle says {got}", file=sys.stderr)
+                fail = True
     return reports, fail
 
 
@@ -187,6 +206,14 @@ def main(argv=None) -> int:
     ap.add_argument("--expect-ingraph-fn", action="store_true",
                     help="task: require at least one in-graph-eligible "
                          "function")
+    ap.add_argument("--expect-stage", action="append", default=None,
+                    metavar="NAME=VERDICT",
+                    help="task: pin a per-stage lowering verdict "
+                         "(repeatable; DESIGN §28). NAME is a hybrid "
+                         "stage ('map'/'reduce', VERDICT "
+                         "'compiled'/'interpreted') or a function name "
+                         "('mapfn'..., VERDICT 'in-graph'/'store-plane'/"
+                         "'invalid'); a mismatch fails the gate")
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--jobs", type=int, default=3)
     ap.add_argument("--batch-k", type=int, default=2)

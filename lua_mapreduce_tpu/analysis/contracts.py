@@ -547,6 +547,38 @@ def check_task(spec: str) -> TaskReport:
     return TaskReport(spec, task_verdict, functions, findings)
 
 
+# the hybrid plane's leg membership (DESIGN §28): which functions must
+# verdict in-graph for each stage of a store-plane task to compile.
+# Mirrors engine/ingraph.py:hybrid_stage_legs — partitionfn is absent on
+# purpose (it routes host-side on concrete keys in the shared publish
+# tail), and combinerfn only gates the map leg when the task has one.
+STAGE_FNS = {"map": ("mapfn", "combinerfn"), "reduce": ("reducefn",)}
+
+
+def stage_report(rep: TaskReport) -> dict:
+    """Per-stage lowering verdicts for the hybrid plane: for each leg,
+    whether it compiles, each member function's verdict, and the rule
+    ids + oracle reasons blocking it when it does not."""
+    out = {}
+    for stage, fns in STAGE_FNS.items():
+        present = [f for f in fns if f in rep.functions]
+        required_ok = fns[0] in rep.functions
+        frs = [rep.functions[f] for f in present]
+        compiled = required_ok and all(
+            fr.verdict == VERDICT_INGRAPH for fr in frs)
+        out[stage] = {
+            "compiled": compiled,
+            "functions": {f: rep.functions[f].verdict for f in present},
+            "blocking": sorted({fi.rule for fr in frs
+                                if fr.verdict != VERDICT_INGRAPH
+                                for fi in fr.findings}),
+            "reasons": [r for fr in frs
+                        if fr.verdict != VERDICT_INGRAPH
+                        for r in fr.reasons],
+        }
+    return out
+
+
 def report_dict(rep: TaskReport) -> dict:
     return {
         "spec": rep.spec,
@@ -556,6 +588,7 @@ def report_dict(rep: TaskReport) -> dict:
                    "verdict": fr.verdict, "reasons": fr.reasons,
                    "findings": [f.to_json() for f in fr.findings]}
             for name, fr in rep.functions.items()},
+        "stages": stage_report(rep),
         "findings": [f.to_json() for f in rep.findings],
         "count": len(rep.findings),
     }

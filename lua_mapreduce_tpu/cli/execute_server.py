@@ -145,20 +145,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "evict to the staged spill path — graceful "
                         "degradation instead of OOM (counted "
                         "push_evictions)")
-    p.add_argument("--engine", choices=("auto", "ingraph", "store"),
+    p.add_argument("--engine",
+                   choices=("auto", "ingraph", "hybrid", "store"),
                    default=None,
-                   help="execution engine (docs/DESIGN.md §26; default "
-                        "auto, or LMR_ENGINE): 'auto' consults the "
-                        "static lowerability oracle at task load and "
-                        "compiles in-graph-verdicted tasks to ONE "
+                   help="execution engine (docs/DESIGN.md §26/§28; "
+                        "default auto, or LMR_ENGINE): 'auto' consults "
+                        "the static lowerability oracle at task load "
+                        "and compiles in-graph-verdicted tasks to ONE "
                         "jitted shard_map program running on this "
-                        "server (no jobs dispatched), falling back to "
-                        "the distributed store plane otherwise — a "
-                        "logged, traced ('lowering' span) decision; "
-                        "'ingraph' forces the compiled plane and "
-                        "RAISES on any lowering failure (the CI hard "
-                        "mode); 'store' opts out. Written to the task "
-                        "doc and sticky on resume")
+                        "server (no jobs dispatched); tasks with only "
+                        "SOME in-graph stages take the hybrid rung — "
+                        "qualifying map/reduce legs compile on the "
+                        "workers, the rest stays interpreted; pure "
+                        "store-plane tasks fall back entirely. Every "
+                        "decision is logged and traced ('lowering' + "
+                        "per-stage 'lowering.<stage>' spans). 'ingraph' "
+                        "forces the whole-task plane and RAISES on any "
+                        "lowering failure (the CI hard mode); 'hybrid' "
+                        "forces stage-granular lowering and NEVER "
+                        "raises (unqualified legs degrade with counted "
+                        "evidence); 'store' opts out. Written to the "
+                        "task doc (with the per-stage split) and "
+                        "sticky on resume")
     p.add_argument("--trace", action="store_true",
                    help="lmr-trace (docs/DESIGN.md §22): record "
                         "claim/body/publish/commit spans and per-op "

@@ -61,15 +61,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "64, or LMR_PUSH_BUDGET_MB): over-budget "
                         "partitions evict to the staged spill path "
                         "instead of OOMing (counted push_evictions)")
-    p.add_argument("--engine", choices=("auto", "ingraph", "store"),
+    p.add_argument("--engine",
+                   choices=("auto", "ingraph", "hybrid", "store"),
                    default=None,
-                   help="execution engine (docs/DESIGN.md §26) — "
+                   help="execution engine (docs/DESIGN.md §26/§28) — "
                         "fleet-launcher parity: in-graph iterations run "
                         "ON THE SERVER (this worker simply sees no jobs "
-                        "for them), so the flag only validates and "
-                        "exports LMR_ENGINE for any LocalExecutor the "
-                        "user task spawns in-process; a launcher can "
-                        "pass one uniform --engine to every process")
+                        "for them), and the hybrid plane's compiled "
+                        "map/reduce legs follow the task document's "
+                        "server-negotiated per-stage split regardless "
+                        "of this flag; it validates and exports "
+                        "LMR_ENGINE (the standalone-worker fallback "
+                        "when a doc predates the negotiation, and the "
+                        "knob for any LocalExecutor the user task "
+                        "spawns in-process), so a launcher can pass "
+                        "one uniform --engine to every process")
     p.add_argument("--phases", default="map,reduce",
                    help="comma list of phases this worker claims "
                         "(heterogeneous pools: dedicated mapper hosts "

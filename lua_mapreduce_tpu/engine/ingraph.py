@@ -58,7 +58,7 @@ from lua_mapreduce_tpu.core.serialize import (assert_serializable,
 from lua_mapreduce_tpu.engine.contract import TaskSpec
 from lua_mapreduce_tpu.trace.span import active_tracer
 
-ENGINES = ("auto", "ingraph", "store")
+ENGINES = ("auto", "ingraph", "hybrid", "store")
 
 # the data-plane slots the oracle folds into the task verdict
 # (analysis/contracts.py keeps taskfn/finalfn control-plane by
@@ -101,11 +101,16 @@ class EngineDecision:
     payload): what was requested, what the static oracle said per
     data-plane function, and which plane was chosen."""
     requested: str
-    chosen: str                       # "ingraph" | "store"
+    chosen: str                       # "ingraph" | "hybrid" | "store"
     verdict: Optional[str]            # oracle task verdict (None = not run)
     functions: Dict[str, dict]        # fn -> {"verdict", "reasons"}
     reason: str                       # one human-readable line
     oracle_s: float = 0.0
+    # stage-granular qualification (DESIGN §28): leg -> compiled?,
+    # populated only when the hybrid rung was considered. The legs are
+    # "map" (mapfn+combinerfn as one vmapped program) and "reduce"
+    # (reducefn as a jitted fold under the host merge).
+    stages: Optional[Dict[str, bool]] = None
 
 
 def oracle_report(spec: TaskSpec) -> Tuple[str, Dict[str, dict]]:
@@ -147,35 +152,90 @@ def oracle_report(spec: TaskSpec) -> Tuple[str, Dict[str, dict]]:
     return verdict, functions
 
 
+def hybrid_stage_legs(spec: TaskSpec,
+                      functions: Dict[str, dict]) -> Dict[str, bool]:
+    """Which hybrid legs the per-function verdicts qualify (DESIGN §28).
+
+    - ``map``: mapfn verdicts in-graph AND combinerfn (when present)
+      does too — the two fuse into one traced program. partitionfn is
+      NOT required: routing runs host-side on the concrete emitted keys
+      inside the shared publish tail, so a store-plane partitionfn
+      composes with a compiled map leg (extsort's exact shape inverted).
+    - ``reduce``: reducefn present and in-graph — the host merge feeds
+      it as a jitted fold.
+    """
+    from lua_mapreduce_tpu.analysis import contracts
+
+    def _ok(fname):
+        d = functions.get(fname)
+        return d is not None and d["verdict"] == contracts.VERDICT_INGRAPH
+
+    map_ok = _ok("mapfn") and (spec.combinerfn is None or _ok("combinerfn"))
+    reduce_ok = spec.reducefn is not None and _ok("reducefn")
+    return {"map": map_ok, "reduce": reduce_ok}
+
+
 def select_engine(spec: TaskSpec, engine: Optional[str] = None
                   ) -> EngineDecision:
-    """Resolve the engine knob and (for ``auto``/``ingraph``) consult
-    the oracle. Pure decision — no tracing/compiling happens here."""
+    """Resolve the engine knob and (for everything but ``store``)
+    consult the oracle. Pure decision — no tracing/compiling here.
+
+    The ``auto`` ladder (DESIGN §28): task verdict in-graph → whole-task
+    ``ingraph``; else any hybrid leg qualifies → ``hybrid`` with that
+    leg set; else ``store``. Forced ``hybrid`` NEVER raises — unlike
+    forced ``ingraph`` — because the hybrid rung's contract is
+    per-stage best effort: an oracle-rejected leg simply stays
+    interpreted (zero qualifying legs = pure store-plane execution,
+    with the rejection carried in the decision for trace/log/counter
+    evidence).
+    """
     from lua_mapreduce_tpu.analysis import contracts
     requested = resolve_engine(engine)
     t0 = time.time()
     verdict: Optional[str] = None
     functions: Dict[str, dict] = {}
+    stages: Optional[Dict[str, bool]] = None
     if requested != "store":
         verdict, functions = oracle_report(spec)
+
+    def _offender():
+        return next(
+            (f"{n}: {d['reasons'][0]}" for n, d in functions.items()
+             if d["verdict"] != contracts.VERDICT_INGRAPH and d["reasons"]),
+            "data plane not in-graph eligible")
+
+    def _legs_str(legs):
+        on = [n for n, ok in legs.items() if ok]
+        return "+".join(on) if on else "none"
+
     if requested == "store":
         chosen, reason = "store", "engine=store requested"
     elif requested == "ingraph":
         chosen = "ingraph"
         reason = ("engine=ingraph forced (oracle verdict "
                   f"{verdict}; trace failures raise)")
+    elif requested == "hybrid":
+        stages = hybrid_stage_legs(spec, functions)
+        chosen = "hybrid"
+        reason = (f"engine=hybrid forced (compiled legs: "
+                  f"{_legs_str(stages)}; unqualified legs stay "
+                  "interpreted, trace failures degrade)")
     elif verdict == contracts.VERDICT_INGRAPH:
         chosen, reason = "ingraph", "oracle verdict in-graph"
     else:
-        offender = next(
-            (f"{n}: {d['reasons'][0]}" for n, d in functions.items()
-             if d["verdict"] != contracts.VERDICT_INGRAPH and d["reasons"]),
-            "data plane not in-graph eligible")
-        chosen = "store"
-        reason = f"oracle verdict {verdict} ({offender})"
+        stages = hybrid_stage_legs(spec, functions)
+        if any(stages.values()):
+            chosen = "hybrid"
+            reason = (f"oracle verdict {verdict} ({_offender()}); "
+                      f"stage verdicts qualify legs: {_legs_str(stages)}")
+        else:
+            chosen = "store"
+            stages = None
+            reason = f"oracle verdict {verdict} ({_offender()})"
     return EngineDecision(requested=requested, chosen=chosen,
                           verdict=verdict, functions=functions,
-                          reason=reason, oracle_s=time.time() - t0)
+                          reason=reason, oracle_s=time.time() - t0,
+                          stages=stages)
 
 
 def record_lowering(decision: EngineDecision) -> None:
@@ -195,6 +255,22 @@ def record_lowering(decision: EngineDecision) -> None:
         attrs[f"fn.{fname}"] = d["verdict"] + why
     tracer.add("lowering", now - decision.oracle_s, now, ns="ingraph",
                **attrs)
+    if decision.stages is None:
+        return
+    # stage-granular decisions (DESIGN §28): one ``lowering.<stage>``
+    # span per hybrid leg so TraceCollection.lowering_decisions shows
+    # WHICH legs compiled, not just that the hybrid rung was chosen
+    _LEG_FNS = {"map": ("mapfn", "combinerfn"), "reduce": ("reducefn",)}
+    for stage, compiled in decision.stages.items():
+        sattrs = {"stage": stage,
+                  "engine": "hybrid" if compiled else "store",
+                  "compiled": str(bool(compiled)).lower()}
+        for fname in _LEG_FNS[stage]:
+            d = decision.functions.get(fname)
+            if d is not None:
+                why = f" ({d['reasons'][0]})" if d["reasons"] else ""
+                sattrs[f"fn.{fname}"] = d["verdict"] + why
+        tracer.add(f"lowering.{stage}", now, now, ns="hybrid", **sattrs)
 
 
 def record_fallback(reason: str) -> None:
@@ -205,6 +281,19 @@ def record_fallback(reason: str) -> None:
         return
     now = tracer.clock()
     tracer.add("ingraph.fallback", now, now, ns="ingraph", reason=reason)
+
+
+def record_hybrid_fallback(stage: str, reason: str) -> None:
+    """Emit the ``hybrid.fallback`` span: one compiled LEG degraded to
+    the interpreted plane at runtime (oracle accepted the stage, the
+    trace/execution did not). The run continues — only that leg's speed
+    is lost, never its results."""
+    tracer = active_tracer()
+    if tracer is None:
+        return
+    now = tracer.clock()
+    tracer.add("hybrid.fallback", now, now, ns="hybrid", stage=stage,
+               reason=reason)
 
 
 # --------------------------------------------------------------------------

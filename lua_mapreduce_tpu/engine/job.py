@@ -168,13 +168,40 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
     spec.mapfn(map_key, map_value, emit)
     times.finished = time.time()
 
-    # one emit loop for BOTH publish modes — validation (combiner fold,
-    # serializability, partitionfn range) must never diverge between
-    # push-on and push-off runs, or byte-identity silently breaks. Only
-    # the per-record sink differs: staged accumulates per-partition
-    # writers built at the end; push streams frames as buffers fill
-    # (DESIGN §24: the manifest publishes last, so a crash at any point
-    # leaves only invisible orphans).
+    publish_map_groups(spec, store, job_id, result,
+                       segment_format=segment_format,
+                       replication=replication, push=push,
+                       push_pool=push_pool, spec_lineage=spec_lineage)
+
+    times.cpu = time.process_time() - cpu0
+    times.written = time.time()
+    return times
+
+
+def publish_map_groups(spec: TaskSpec, store: Store, job_id: str,
+                       result: Dict[Any, List[Any]],
+                       segment_format: str = "v1",
+                       replication=1,
+                       push: bool = False,
+                       push_pool=None,
+                       spec_lineage: str = None) -> None:
+    """Publish one map job's grouped emissions — the ONE publish tail
+    every map producer shares. ``result`` is the key → value-list
+    grouping make_map_emit accumulates; the interpreted path
+    (run_map_job above) and the compiled hybrid map leg
+    (engine/hybrid.py, DESIGN §28) both land here, so combiner folding,
+    serializability validation, partition routing, and the per-record
+    sink are byte-identical by construction across the planes.
+
+    One emit loop for BOTH publish modes — validation (combiner fold,
+    serializability, partitionfn range) must never diverge between
+    push-on and push-off runs, or byte-identity silently breaks. Only
+    the per-record sink differs: staged accumulates per-partition
+    writers built at the end; push streams frames as buffers fill
+    (DESIGN §24: the manifest publishes last, so a crash at any point
+    leaves only invisible orphans).
+    """
+    combiner = spec.combiner_for_map
     pw = None
     writers: Dict[int, Any] = {}
     if push:
@@ -217,10 +244,6 @@ def run_map_job(spec: TaskSpec, store: Store, job_id: str,
             pw.close()
         for w in writers.values():
             w.close()
-
-    times.cpu = time.process_time() - cpu0
-    times.written = time.time()
-    return times
 
 
 def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
@@ -285,7 +308,8 @@ def run_premerge_job(spec: TaskSpec, store: Store, run_files: List[str],
 
 def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
                    part_key: str, run_files: List[str],
-                   result_file: str, replication=1) -> JobTimes:
+                   result_file: str, replication=1,
+                   reduce_fold=None) -> JobTimes:
     """Execute one reduce job: k-way merge a partition's runs — raw
     mapper runs and/or pre-merged spills, in the caller-given canonical
     order (the merge concatenates equal-key values in file-list order,
@@ -300,6 +324,15 @@ def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
     consumed-run sweep removes every copy; the RESULT file is never
     replicated — final results are the engine's format- and
     replication-invariant surface (DESIGN §20).
+
+    ``reduce_fold`` is the hybrid plane's compiled-reduce hook (DESIGN
+    §28): a callable ``(key, values) -> plain-or-None`` tried where the
+    interpreted reducefn would run. ``None`` means "this group is
+    outside what the fold compiled for" and falls through to the
+    interpreted reducefn — so a retired or partial fold can never
+    change results, only speed. The singleton fast path and the native
+    sum fold both stay AHEAD of it (they are already cheaper than any
+    dispatch).
     """
     times = JobTimes(started=time.time())
     cpu0 = time.process_time()
@@ -339,10 +372,14 @@ def run_reduce_job(spec: TaskSpec, store: Store, result_store: Store,
             if fast and len(values) == 1:
                 reduced = values[0]
             else:
-                # array-valued reducefn outputs (the in-graph-eligible
-                # numeric style) normalize to the plain record surface
-                # exactly like emitted map values do
-                reduced = to_plain(reducefn(key, values))
+                reduced = None
+                if reduce_fold is not None:
+                    reduced = reduce_fold(key, values)
+                if reduced is None:
+                    # array-valued reducefn outputs (the in-graph-
+                    # eligible numeric style) normalize to the plain
+                    # record surface exactly like emitted map values do
+                    reduced = to_plain(reducefn(key, values))
             assert_serializable(reduced, f"reduce value for key {key!r}")
             builder.write(dump_record(key, [reduced]) + "\n")
         times.finished = time.time()

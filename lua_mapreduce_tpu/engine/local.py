@@ -176,11 +176,22 @@ class LocalExecutor:
         # failure; "ingraph" forces the compiled plane (failures
         # raise); "store" opts out. The decision is a `lowering` trace
         # span either way.
+        from lua_mapreduce_tpu.engine.hybrid import HybridRunner
         from lua_mapreduce_tpu.engine.ingraph import (IngraphRunner,
                                                       select_engine)
         self.engine_decision = select_engine(spec, engine)
         self.engine = self.engine_decision.chosen
         self._ingraph = IngraphRunner(
+            spec, self.engine_decision,
+            log=lambda m: print(f"[local] {m}", file=sys.stderr))
+        # hybrid rung (DESIGN §28): per-STAGE compiled legs when the
+        # whole-task verdict rejected in-graph but individual data-plane
+        # functions qualify — the map+combine leg batches the barrier
+        # path's map jobs through one program (spills stay ordinary
+        # frames via the shared publish tail), the reduce fold compiles
+        # multi-value groups under the host merge. Never crashes: any
+        # degrade is counted/logged/traced.
+        self._hybrid = HybridRunner(
             spec, self.engine_decision,
             log=lambda m: print(f"[local] {m}", file=sys.stderr))
         self.stats = TaskStats()
@@ -209,6 +220,19 @@ class LocalExecutor:
         except Exception as exc:
             print(f"[local] trace flush failed ({type(exc).__name__}: "
                   f"{exc}); spans re-buffered", file=sys.stderr)
+
+    def _reduce_one(self, p, files) -> JobTimes:
+        """One reduce job under its span, with the hybrid compiled
+        fold plugged in (identity-of-bytes guaranteed by the fold's
+        None-means-interpret contract) and its per-job counter hook."""
+        t = self._traced(
+            "reduce", p, lambda: run_reduce_job(
+                self.spec, self.store, self.result_store, str(p), files,
+                result_file_name(self.spec.result_ns, p),
+                replication=self.replication,
+                reduce_fold=self._hybrid.reduce_fold()))
+        self._hybrid.note_reduce_job()
+        return t
 
     def _run_jobs(self, fns) -> List[JobTimes]:
         if self.map_parallelism == 1 or len(fns) <= 1:
@@ -256,10 +280,16 @@ class LocalExecutor:
         # and THIS iteration re-runs through the store path right here.
         ran_ingraph = self._ingraph.active and \
             self._ingraph.run_iteration(self.result_store, iteration)
+        # zero-leg forced hybrid leaves its once-per-task evidence here,
+        # inside the iteration's counter window
+        self._hybrid.ensure_evidence()
 
         if ran_ingraph:
             pass                 # results published by the compiled plane
         elif self.pipeline:
+            # the compiled map leg is itself a batch barrier, so it
+            # composes with the BARRIER path only; pipelined map stays
+            # interpreted (the reduce fold below still applies)
             jobs = collect_task_jobs(spec)
             (map_times, pre_times, pre_failed,
              reduce_times) = self._run_pipelined(jobs)
@@ -269,15 +299,24 @@ class LocalExecutor:
             it_stats.reduce.fold(reduce_times)
         else:
             jobs = collect_task_jobs(spec)
-            map_times = self._run_jobs([
-                (lambda k=k, v=v, i=i: self._traced(
-                    "map", i, lambda: run_map_job(
-                        spec, self.store, str(i), k, v,
-                        segment_format=self.segment_format,
-                        replication=self.replication,
-                        push=self.push, push_pool=self._push_pool)))
-                for i, (k, v) in enumerate(jobs)])
-            it_stats.map.fold(map_times)
+            # hybrid compiled map+combine leg (DESIGN §28): the whole
+            # iteration's map jobs as ONE program, published through the
+            # same tail run_map_job uses — a trace-time failure degrades
+            # right here and the interpreted loop below runs instead
+            if not self._hybrid.run_map_leg(
+                    jobs, self.store,
+                    segment_format=self.segment_format,
+                    replication=self.replication, push=self.push,
+                    push_pool=self._push_pool, iteration=iteration):
+                map_times = self._run_jobs([
+                    (lambda k=k, v=v, i=i: self._traced(
+                        "map", i, lambda: run_map_job(
+                            spec, self.store, str(i), k, v,
+                            segment_format=self.segment_format,
+                            replication=self.replication,
+                            push=self.push, push_pool=self._push_pool)))
+                    for i, (k, v) in enumerate(jobs)])
+                it_stats.map.fold(map_times)
 
             if self.push:
                 from lua_mapreduce_tpu.engine.push import discover_push
@@ -288,11 +327,7 @@ class LocalExecutor:
             else:
                 parts = discover_partitions(self._view, spec.result_ns)
             reduce_times = self._run_jobs([
-                (lambda p=p, files=files: self._traced(
-                    "reduce", p, lambda: run_reduce_job(
-                        spec, self.store, self.result_store, str(p), files,
-                        result_file_name(spec.result_ns, p),
-                        replication=self.replication)))
+                (lambda p=p, files=files: self._reduce_one(p, files))
                 for p, files in sorted(parts.items())])
             it_stats.reduce.fold(reduce_times)
 
@@ -408,11 +443,7 @@ class LocalExecutor:
                                        push=self.push,
                                        replication=self.replication)
             red_futs = [pool.submit(
-                lambda p=p, files=files: self._traced(
-                    "reduce", p, lambda: run_reduce_job(
-                        spec, self.store, self.result_store, str(p),
-                        files, result_file_name(spec.result_ns, p),
-                        self.replication)))
+                lambda p=p, files=files: self._reduce_one(p, files))
                 for p, files in sorted(parts.items())]
             reduce_times = [f.result() for f in red_futs]
         finally:

@@ -285,6 +285,7 @@ class Server:
             self.strict = strict
         t0 = time.time()
         skip_map = False
+        sticky_stages = None            # resumed doc's hybrid stage split
         iteration = 1
 
         tracer = active_tracer()
@@ -330,6 +331,12 @@ class Server:
                     resolve_engine as _resolve_engine
                 self.engine = _resolve_engine(
                     task.get("engine", self.engine))
+                # the hybrid stage split is sticky WITH the engine knob:
+                # the doc's negotiated per-stage verdicts win over a
+                # fresh recompute, so a resumed fleet keeps running
+                # exactly the compiled legs the crashed run's workers
+                # were running (DESIGN §28)
+                sticky_stages = task.get("hybrid_stages")
                 # batch_k / segment_format are perf knobs with no
                 # crash-consistency tie to on-disk state (readers sniff
                 # spill formats per file; unlike the shuffle mode), so
@@ -414,10 +421,22 @@ class Server:
         from lua_mapreduce_tpu.engine.ingraph import (IngraphRunner,
                                                       select_engine)
         decision = select_engine(self.spec, self.engine)
+        if decision.chosen == "hybrid" and isinstance(sticky_stages, dict):
+            decision.stages = {k: bool(v) for k, v in sticky_stages.items()}
         self._ingraph = IngraphRunner(self.spec, decision,
                                       log=self._ingraph_log)
         if decision.chosen == "ingraph":
             self._log(f"engine: in-graph ({decision.reason})")
+        elif decision.chosen == "hybrid":
+            self._log(f"engine: hybrid ({decision.reason})")
+        # stage negotiation (DESIGN §28): publish the per-stage verdicts
+        # on the task doc so every worker in the fleet runs the SAME
+        # compiled legs (and a resume finds them above); None on a
+        # non-hybrid load clears a stale split left by a knob change.
+        # The server itself still runs the ordinary store phases — the
+        # legs execute wherever the jobs do, i.e. on the workers.
+        self.store.update_task({"hybrid_stages": decision.stages
+                                if decision.chosen == "hybrid" else None})
 
         while True:
             self._spill_repairs.clear()

@@ -154,6 +154,15 @@ class Worker:
         self._fleet_ewma: Dict[str, float] = {}  # last task-doc aggregate
         self._ewma_pushed: Dict[str, float] = {}  # ns -> last value pushed
         self._speculation = 0.0          # task-doc factor (0 = off)
+        # hybrid compiled legs (DESIGN §28): the server negotiates the
+        # per-stage lowering split on the task doc; this worker mints
+        # the leg engines lazily per (spec, split) and stashes each
+        # leased map batch's compiled groupings for _map_body
+        self._task_engine = None                # last task doc's knob
+        self._task_hybrid_stages = None         # doc's negotiated split
+        self._hybrid_rt = None     # (cache key, map engine, reduce fold)
+        self._hybrid_stash: Dict[int, dict] = {}  # jid -> map grouping
+        self._own_stages: Dict[int, Optional[dict]] = {}  # standalone
         self._spec_cache: Dict[str, TaskSpec] = {}
         self._infra_released: Dict[tuple, int] = {}  # (ns, jid) -> count
         self._spec_by_id = None         # (desc object, spec) fast path
@@ -278,6 +287,8 @@ class Worker:
         self._task_replication = task.get("replication")
         self._task_coding = task.get("coding")
         self._task_push = task.get("push")
+        self._task_engine = task.get("engine")
+        self._task_hybrid_stages = task.get("hybrid_stages")
         self._speculation = float(task.get("speculation") or 0.0)
         # fleet duration aggregate (DESIGN §21): remember the doc's
         # values for the persist blend, and SEED this worker's own EWMA
@@ -539,6 +550,97 @@ class Worker:
                 resolve_push_budget(self.push_budget_mb))
         return self._push_pool_obj
 
+    # -- hybrid compiled legs (DESIGN §28) ----------------------------------
+
+    def _hybrid_stages(self, spec: TaskSpec):
+        """The per-stage lowering split this worker runs compiled: the
+        task document's server-negotiated verdicts win (every worker
+        in the fleet runs the SAME legs). A doc that carries an engine
+        knob but no split negotiated a non-hybrid plane — respected.
+        Only a standalone worker whose doc predates the engine knob
+        entirely falls back to its own oracle pass, and only when
+        LMR_ENGINE requests it (cached per spec — the oracle is pure)."""
+        stages = self._task_hybrid_stages
+        if isinstance(stages, dict):
+            return stages
+        if self._task_engine is not None:
+            return None
+        env = os.environ.get("LMR_ENGINE")
+        if env not in ("hybrid", "auto"):
+            return None
+        key = id(spec)
+        if key not in self._own_stages:
+            from lua_mapreduce_tpu.engine.ingraph import select_engine
+            d = select_engine(spec, env)
+            self._own_stages[key] = (d.stages if d.chosen == "hybrid"
+                                     else None)
+        return self._own_stages[key]
+
+    def _hybrid_runtime(self, spec: TaskSpec):
+        """(map engine, reduce fold) for the current task, minted
+        lazily and cached per (spec, split); either slot is None when
+        that leg is off — or permanently retired after a failure."""
+        stages = self._hybrid_stages(spec)
+        if not stages or not any(stages.values()):
+            return None, None
+        key = (id(spec), bool(stages.get("map")),
+               bool(stages.get("reduce")))
+        if self._hybrid_rt is None or self._hybrid_rt[0] != key:
+            from lua_mapreduce_tpu.engine.hybrid import (HybridMapEngine,
+                                                         HybridReduceFold)
+            self._hybrid_rt = (
+                key,
+                HybridMapEngine(spec) if stages.get("map") else None,
+                HybridReduceFold(spec) if stages.get("reduce") else None)
+        return self._hybrid_rt[1], self._hybrid_rt[2]
+
+    def _retire_hybrid_map(self, exc: Exception) -> None:
+        """A compiled-map failure retires the leg for this task — the
+        never-crash contract: counted, traced, logged, and every later
+        lease (plus this one) simply runs interpreted."""
+        from lua_mapreduce_tpu.engine.ingraph import record_hybrid_fallback
+        from lua_mapreduce_tpu.faults.retry import COUNTERS
+        reason = f"{type(exc).__name__}: {exc}"
+        COUNTERS.bump("hybrid_fallbacks")
+        record_hybrid_fallback("map", reason)
+        self._log(f"compiled map leg failed ({reason}); "
+                  "map jobs run interpreted")
+        if self._hybrid_rt is not None:
+            self._hybrid_rt = (self._hybrid_rt[0], None,
+                               self._hybrid_rt[2])
+
+    def _stash_hybrid_map(self, spec: TaskSpec, jobs: List[dict]) -> None:
+        """Pre-compute a leased map batch through the compiled map leg
+        (DESIGN §28): the whole lease traces/runs as ONE program up
+        front, and the per-job groupings are STASHED by job id for
+        _map_body to publish inside the ordinary lease loop — so
+        revocation probes, body spans, the commit CAS, and every
+        failure edge stay exactly the store-plane code. Any failure
+        leaves the stash empty and retires the leg: the lease replays
+        interpreted, byte-identically."""
+        self._hybrid_stash = {}
+        engine, _ = self._hybrid_runtime(spec)
+        if engine is None or not jobs:
+            return
+        from lua_mapreduce_tpu.faults.retry import COUNTERS
+        t0 = time.time()
+        try:
+            per_job = engine.run_batch([(j["key"], j["value"])
+                                        for j in jobs])
+        except Exception as exc:        # noqa: BLE001 — degrade policy
+            self._retire_hybrid_map(exc)
+            return
+        self._hybrid_stash = {j["_id"]: g
+                              for j, g in zip(jobs, per_job)}
+        COUNTERS.bump("hybrid_map_legs")
+        tracer = active_tracer()
+        if tracer is not None:
+            now = tracer.clock()
+            tracer.add("hybrid.run", now - (time.time() - t0), now,
+                       ns="hybrid", stage="map", job_id=jobs[0]["_id"],
+                       jobs=len(jobs), mode=engine.mode,
+                       traces=engine.traces)
+
     def _map_body(self, spec: TaskSpec, job: dict):
         store = get_storage_from(spec.storage)
         push_on = self._push_on()
@@ -548,6 +650,23 @@ class Worker:
             # until its commit wins (run_one promotes; DESIGN §24)
             from lua_mapreduce_tpu.engine.push import lineage_token
             lineage = lineage_token(self.name)
+        groups = self._hybrid_stash.pop(job["_id"], None)
+        if groups is not None:
+            # compiled map leg (DESIGN §28): mapfn+combiner already ran
+            # in the lease's batch program — only the shared publish
+            # tail remains, so the spill bytes match run_map_job's by
+            # construction
+            from lua_mapreduce_tpu.engine.job import (JobTimes,
+                                                      publish_map_groups)
+            times = JobTimes(started=time.time())
+            publish_map_groups(
+                spec, store, str(job["_id"]), groups,
+                segment_format=self._segment_format(),
+                replication=self._replication(), push=push_on,
+                push_pool=self._push_pool() if push_on else None,
+                spec_lineage=lineage)
+            times.finished = times.written = time.time()
+            return times
         return run_map_job(spec, store, str(job["_id"]), job["key"],
                            job["value"],
                            segment_format=self._segment_format(),
@@ -626,9 +745,14 @@ class Worker:
                 f"visible in storage (producers: "
                 f"{v.get('mappers') or 'unknown'}): {missing[:3]} — "
                 "cross-host pools need a backend every host can reach")
-        return run_reduce_job(spec, store, result_store,
-                              str(v["part"]), v["files"], v["result"],
-                              replication=replication)
+        _, fold = self._hybrid_runtime(spec)
+        times = run_reduce_job(spec, store, result_store,
+                               str(v["part"]), v["files"], v["result"],
+                               replication=replication, reduce_fold=fold)
+        if fold is not None and fold.take_used():
+            from lua_mapreduce_tpu.faults.retry import COUNTERS
+            COUNTERS.bump("hybrid_reduce_legs")
+        return times
 
     _BODIES = {MAP_NS: _map_body, PRE_NS: _premerge_body,
                RED_NS: _reduce_body}
@@ -676,6 +800,11 @@ class Worker:
         worker must not touch the new claimant's state."""
         body = self._BODIES[ns]
         label = {MAP_NS: "map", PRE_NS: "pre_merge", RED_NS: "reduce"}[ns]
+        if ns == MAP_NS:
+            # hybrid compiled map leg (DESIGN §28): run the whole lease
+            # through one program first; _map_body publishes each job's
+            # stashed grouping through the shared tail
+            self._stash_hybrid_map(spec, jobs)
         jids = [j["_id"] for j in jobs]
         done: List[tuple] = []          # (jid, times_dict), commit order
         revoked = threading.Event()

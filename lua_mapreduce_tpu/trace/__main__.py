@@ -82,6 +82,14 @@ def render_text(col, top: int) -> str:
                        f"(requested={d.get('requested')}, "
                        f"verdict={d.get('verdict')}) — "
                        f"{d.get('reason', '')}")
+        elif d["span"].startswith("lowering."):
+            # per-stage hybrid verdict (DESIGN §28)
+            out.append(f"lowering: stage {d.get('stage')} -> "
+                       f"{d.get('engine')} "
+                       f"(compiled={d.get('compiled')})")
+        elif d["span"] == "hybrid.fallback":
+            out.append(f"lowering: HYBRID FALLBACK it{d['it']} "
+                       f"stage={d.get('stage')} — {d.get('reason', '')}")
         else:
             out.append(f"lowering: RUNTIME FALLBACK it{d['it']} — "
                        f"{d.get('reason', '')}")

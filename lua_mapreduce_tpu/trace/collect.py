@@ -178,12 +178,16 @@ class TraceCollection:
     def lowering_decisions(self) -> List[dict]:
         """The ``lowering`` spans' payloads — the engine-selection
         decision (requested/chosen engine, oracle verdict, per-function
-        reasons) plus any runtime ``ingraph.fallback`` degrades, in
-        time order: the timeline proof that a store-plane fallback was
+        reasons), the hybrid plane's per-stage ``lowering.<stage>``
+        verdicts (DESIGN §28), and any runtime ``ingraph.fallback`` /
+        ``hybrid.fallback`` degrades, in time order: the timeline proof
+        that an interpreted stage (or a whole store-plane fallback) was
         a DECISION, not a silent drop."""
         out = []
         for s in sorted(self.spans, key=lambda s: (s["t0"], s["t1"])):
-            if s["name"] in ("lowering", "ingraph.fallback"):
+            if s["name"] in ("lowering", "ingraph.fallback",
+                             "hybrid.fallback") \
+                    or s["name"].startswith("lowering."):
                 entry = {"span": s["name"], "it": s.get("it", 0),
                          "t0": s["t0"]}
                 entry.update(s.get("attrs") or {})
@@ -199,6 +203,7 @@ class TraceCollection:
         came from), with the fallback visible in
         :meth:`lowering_decisions`."""
         out: Dict[int, str] = {}
+        hybrid_its = set()
         for s in self.spans:
             it = s.get("it", 0)
             if s["name"].endswith(_BODY_SUFFIX) \
@@ -206,7 +211,13 @@ class TraceCollection:
                 out[it] = "store"
             elif s["name"] == "ingraph.run":
                 out.setdefault(it, "ingraph")
-        return {it: out[it] for it in sorted(out)}
+            elif s["name"] == "hybrid.run":
+                # compiled legs ride the store phases (DESIGN §28):
+                # the iteration still reports where its results came
+                # from, qualified as hybrid rather than pure store
+                hybrid_its.add(it)
+        return {it: ("hybrid" if out[it] == "store" and it in hybrid_its
+                     else out[it]) for it in sorted(out)}
 
     def speculation_outcomes(self) -> List[dict]:
         """Per speculated (iteration, job): the winner/loser shape of
@@ -453,6 +464,30 @@ def utest() -> None:
     assert decs[1]["span"] == "ingraph.fallback" \
         and decs[1]["reason"] == "boom"
     assert col.lowering_decisions() == []      # untouched runs: empty
+
+    # hybrid stage granularity (DESIGN §28): per-stage lowering.<stage>
+    # verdicts and hybrid.fallback degrades join the decision chain, and
+    # an iteration whose store phases ran compiled legs reports "hybrid"
+    hspans = [
+        sp("lowering", -1.0, -0.9, ns="hybrid", job=None,
+           engine="hybrid", requested="auto", verdict="store-plane"),
+        sp("lowering.map", -0.9, -0.9, ns="hybrid", job=None,
+           stage="map", engine="hybrid", compiled="true"),
+        sp("lowering.reduce", -0.9, -0.9, ns="hybrid", job=None,
+           stage="reduce", engine="store", compiled="false"),
+        sp("hybrid.run", 0.0, 0.5, ns="hybrid", job=1, it=1, stage="map"),
+        sp("map.body", 0.0, 1.0, it=1),
+        sp("hybrid.fallback", 1.5, 1.5, ns="hybrid", job=None, it=2,
+           stage="map", reason="trace failed"),
+        sp("map.body", 2.0, 3.0, it=2),
+    ]
+    hcol = TraceCollection(hspans)
+    assert hcol.engines_by_iteration() == {1: "hybrid", 2: "store"}
+    hdecs = hcol.lowering_decisions()
+    assert [d["span"] for d in hdecs] == [
+        "lowering", "lowering.map", "lowering.reduce", "hybrid.fallback"]
+    assert hdecs[1]["stage"] == "map" and hdecs[1]["compiled"] == "true"
+    assert hdecs[3]["reason"] == "trace failed"
 
     doc = col.to_chrome()
     assert validate_chrome(doc) == []

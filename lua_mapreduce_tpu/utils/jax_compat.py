@@ -19,3 +19,36 @@ def shard_map(f, *args, **kwargs):
         if "check_vma" in kwargs:
             kwargs["check_rep"] = kwargs.pop("check_vma")
     return fn(f, *args, **kwargs)
+
+
+def vma_shard_map(f, *args, **kwargs):
+    """:func:`shard_map` for programs that close over ``pallas_call``.
+
+    Newer JAX's ``check_vma`` machinery carries replication rules for
+    ``pallas_call``, so kernels trace under the checker; the legacy
+    ``check_rep`` checker has no such rule and raises
+    ``NotImplementedError`` on any kernel-bearing body. On the legacy
+    API the check is therefore disabled (its documented workaround)
+    instead of crashing; on the public API full vma checking stays on.
+    """
+    import jax
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+        kwargs.setdefault("check_rep", False)
+    return fn(f, *args, **kwargs)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` — renamed from ``TPUCompilerParams``.
+
+    Newer pallas dropped the ``TPU`` prefix (the module path already
+    says it); older releases only export the prefixed class. Same
+    constructor kwargs either way, so every kernel call site routes
+    through here instead of hard-coding one generation's name.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -82,6 +82,9 @@ COUNTER_FOLD = {
     "push_evictions": ("push_evictions",),
     "ingraph_iterations": ("ingraph_iterations",),
     "ingraph_fallbacks": ("ingraph_fallbacks",),
+    "hybrid_map_legs": ("hybrid_map_legs",),
+    "hybrid_reduce_legs": ("hybrid_reduce_legs",),
+    "hybrid_fallbacks": ("hybrid_fallbacks",),
 }
 _FLOAT_COUNTERS = frozenset({"spec_wasted_s"})
 
@@ -162,6 +165,18 @@ class IterationStats:
     #                        raised at trace time — logged, traced as
     #                        an ``ingraph.fallback`` span, never a
     #                        crash under engine=auto)
+    # hybrid engine accounting (DESIGN §28), same fold:
+    #   hybrid_map_legs    — map-job batches executed as one compiled
+    #                        map+combine program whose partitions were
+    #                        published through the ordinary spill path
+    #                        (engine/hybrid.py)
+    #   hybrid_reduce_legs — reduce jobs whose per-group fold ran as
+    #                        the jitted compiled reducefn instead of
+    #                        the interpreted per-record call
+    #   hybrid_fallbacks   — compiled legs that degraded back to the
+    #                        interpreted store plane at trace/run time
+    #                        (logged, traced as ``hybrid.fallback``
+    #                        spans, never a crash)
     store_retries: int = 0
     store_faults: int = 0
     infra_releases: int = 0
@@ -180,6 +195,9 @@ class IterationStats:
     push_evictions: int = 0
     ingraph_iterations: int = 0
     ingraph_fallbacks: int = 0
+    hybrid_map_legs: int = 0
+    hybrid_reduce_legs: int = 0
+    hybrid_fallbacks: int = 0
 
     def fold_fault_counters(self, delta: Dict[str, float]
                             ) -> "IterationStats":
@@ -232,6 +250,9 @@ class IterationStats:
             "push_evictions": self.push_evictions,
             "ingraph_iterations": self.ingraph_iterations,
             "ingraph_fallbacks": self.ingraph_fallbacks,
+            "hybrid_map_legs": self.hybrid_map_legs,
+            "hybrid_reduce_legs": self.hybrid_reduce_legs,
+            "hybrid_fallbacks": self.hybrid_fallbacks,
             "cluster_time": self.cluster_time,
             "wall_time": self.wall_time,
         }

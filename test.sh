@@ -106,9 +106,20 @@ echo "lmr-analyze: lint+deep clean, no stale suppressions, protocol model-checke
 # (kmeans / ALS / digits SGD — state threaded through job values,
 # DESIGN §26) pin in-graph so engine=auto keeps compiling them
 python -m lua_mapreduce_tpu.analysis task examples.wordcount --expect store-plane
-python -m lua_mapreduce_tpu.analysis task examples.extsort.sorttask --expect store-plane --expect-ingraph-fn
+# extsort also pins the HYBRID stage split (DESIGN §28): the map leg
+# stays interpreted (mapfn's hashlib helper), the reduce leg compiles —
+# the exact split engine=auto hands the stage-granular plane
+python -m lua_mapreduce_tpu.analysis task examples.extsort.sorttask --expect store-plane --expect-ingraph-fn \
+    --expect-stage map=interpreted --expect-stage reduce=compiled \
+    --expect-stage mapfn=store-plane --expect-stage partitionfn=in-graph \
+    --expect-stage reducefn=in-graph
 python -m lua_mapreduce_tpu.analysis task benchmarks/coord_task.py --expect store-plane
 python -m lua_mapreduce_tpu.analysis task benchmarks/sched_task.py --expect in-graph
+# the hybrid bench task is the inverse extsort pin: compiled map+combine,
+# host partition — the split the hybrid_sort bench leg measures
+python -m lua_mapreduce_tpu.analysis task benchmarks/hybrid_task.py --expect store-plane \
+    --expect-stage map=compiled --expect-stage reduce=compiled \
+    --expect-stage mapfn=in-graph --expect-stage partitionfn=store-plane
 python -m lua_mapreduce_tpu.analysis task examples.kmeans.mr_kmeans --expect in-graph
 python -m lua_mapreduce_tpu.analysis task examples.als.mr_als --expect in-graph
 python -m lua_mapreduce_tpu.analysis task examples.digits.mr_sgd --expect in-graph
@@ -122,4 +133,14 @@ echo "task contracts: all shipped task modules classify to their pinned verdicts
 JAX_PLATFORMS=cpu python -m pytest tests/test_ingraph.py -q
 JAX_PLATFORMS=cpu python benchmarks/ingraph_bench.py --smoke
 echo "ingraph smoke: compiled plane byte/allclose-identical, fallback degrades"
+# hybrid smoke gate (DESIGN §28): the stage-granular suite — forced and
+# auto-negotiated splits byte-identical on both executors, doc
+# negotiation sticky on resume, per-stage spans, fold proof gating,
+# zero-leg evidence — plus the SIGKILL-mid-compiled-map-leg chaos leg
+# and one tiny paired bench round per hybrid split (compiled legs run,
+# fallback-free, byte/allclose vs the interpreted twin)
+JAX_PLATFORMS=cpu python -m pytest tests/test_hybrid.py -q
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py::test_hybrid_chaos_sigkill_mid_compiled_leg -q
+JAX_PLATFORMS=cpu python benchmarks/ingraph_bench.py --smoke-hybrid
+echo "hybrid smoke: stage legs compiled, split negotiated, chaos held"
 python -m pytest tests/ -q --full

@@ -1030,3 +1030,194 @@ def test_coded_chaos_corrupt_parity_block(tmp_path):
     assert it.decode_reads > 0, "the mutation never forced a decode"
     assert it.map_reruns == 0, \
         "corrupt parity + one lost data block must decode, not re-run"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17 hybrid chaos gate (DESIGN §28): an extsort-shaped task whose
+# oracle split is map=compiled / partition=host — the fleet negotiates
+# the hybrid stage split on the task doc, a subprocess worker is
+# SIGKILLed MID-COMPILED-MAP-LEG (a spill of its running job has
+# landed, its commit has not) under a seeded transient-fault storm,
+# and only a speculation clone's zero-charge coverage may finish the
+# job: byte-identical output, zero repetition bumps, compiled legs
+# still counted on the surviving fleet.
+# ---------------------------------------------------------------------------
+
+_HYBRID_SORT_SRC = """
+import hashlib
+import jax.numpy as jnp
+
+def taskfn(emit):
+    for j in range(8):
+        emit(j, {"vals": [(j * 16 + i) * 7 % 101 for i in range(16)]})
+
+def mapfn(key, value, emit):
+    v = jnp.asarray(value["vals"], jnp.int32)
+    for i in range(16):
+        # every key twice: multi-value groups are what the compiled
+        # reduce fold folds (singleton groups take the merge fast path)
+        emit(int(key) * 16 + i, v[i])
+        emit(int(key) * 16 + i, v[i])
+
+def partitionfn(key):
+    h = hashlib.blake2b(str(int(key)).encode(),
+                        digest_size=2).hexdigest()
+    return int(h, 16) % 4
+
+def reducefn(key, values):
+    acc = values[0]
+    for i in range(1, len(values)):
+        acc = acc + values[i]
+    return acc
+
+reducefn.associative_reducer = True
+reducefn.commutative_reducer = True
+"""
+
+
+def test_hybrid_chaos_sigkill_mid_compiled_leg(tmp_path):
+    import json as _json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from lua_mapreduce_tpu.coord.filestore import FileJobStore
+    from lua_mapreduce_tpu.engine.job import map_key_str
+
+    modname = "hybridkill_sort"
+    moddir = tmp_path / "mods"
+    moddir.mkdir()
+    (moddir / f"{modname}.py").write_text(_HYBRID_SORT_SRC)
+    coord = tmp_path / "hyb-coord"
+    spill = tmp_path / "hyb-spill"
+    sys.path.insert(0, str(moddir))
+    try:
+        spec = TaskSpec(taskfn=modname, mapfn=modname,
+                        partitionfn=modname, reducefn=modname,
+                        storage=f"shared:{spill}")
+        # the fault-free interpreted twin — the byte-compare golden
+        twin = TaskSpec(taskfn=modname, mapfn=modname,
+                        partitionfn=modname, reducefn=modname,
+                        storage="mem:hybkill-twin")
+        LocalExecutor(twin, engine="store").run()
+        clean = _result_bytes("mem:hybkill-twin", only_results=True)
+
+        # the acceptance storm (the smoke legs' absorbable mix) PLUS
+        # the deterministic straggler tax on the victim so it is
+        # verifiably mid-leg when killed — installed in the subprocess
+        # (env) AND in this process (the healthy threads + server)
+        plan = FaultPlan(311, transient=0.08, latency=0.05,
+                         latency_ms=1.0, max_per_key=2,
+                         slow_worker="victim-*", slow_ms=250.0,
+                         slow_s=3600.0)
+        install_fault_plan(plan)
+        env = dict(os.environ,
+                   PYTHONPATH=f"{moddir}:{os.environ.get('PYTHONPATH', '')}",
+                   LMR_FAULT_PLAN=plan.to_spec(),
+                   JAX_PLATFORMS="cpu")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+        def spawn(name):
+            code = (
+                "import sys\n"
+                f"sys.path.insert(0, {repo!r})\n"
+                f"sys.path.insert(0, {str(moddir)!r})\n"
+                "from lua_mapreduce_tpu import FileJobStore, Worker\n"
+                f"w = Worker(FileJobStore({str(coord)!r}), name={name!r})\n"
+                "w.configure(max_iter=100000, max_sleep=0.05,\n"
+                "            max_tasks=1, heartbeat_s=0.25)\n"
+                "w.execute()\n")
+            return subprocess.Popen([sys.executable, "-c", code], env=env)
+
+        victim = spawn("victim-0")
+        store = FileJobStore(str(coord))
+        server = Server(store, poll_interval=0.05, engine="auto",
+                        stale_timeout_s=None,   # ONLY speculation saves it
+                        speculation=2.0, batch_k=1).configure(spec)
+        final = {}
+        st = threading.Thread(
+            target=lambda: final.setdefault("stats", server.loop()),
+            daemon=True)
+        st.start()
+        # head start: the victim must hold a map lease before the
+        # healthy fleet exists
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                if any(d["status"] == Status.RUNNING
+                       and d.get("worker") == "victim-0"
+                       for d in store.jobs(MAP_NS)):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.05)
+        else:
+            raise AssertionError("victim never claimed a lease")
+        # the fleet negotiated the stage split on the doc before any
+        # job was inserted — every worker (victim included) is running
+        # the COMPILED map leg
+        task = store.get_task()
+        assert task["engine"] == "auto"
+        assert task["hybrid_stages"] == {"map": True, "reduce": True}
+
+        # kill the victim the moment it is verifiably MID-LEG: its
+        # compiled batch ran and the publish tail has landed at least
+        # one spill of a job it still holds (commit pending). The
+        # healthy fleet spawns AFTER the kill — a racing clone would
+        # cover the slowed victim's job before its mid-leg window
+        # opens (the coded pusher leg's exact sequencing)
+        deadline = time.time() + 90
+        killed = False
+        while time.time() < deadline and not killed:
+            spills = os.listdir(spill) if spill.exists() else []
+            try:
+                running = [d for d in store.jobs(MAP_NS)
+                           if d["status"] == Status.RUNNING
+                           and d.get("worker") == "victim-0"]
+            except Exception:
+                running = []
+            keys = {map_key_str(d["_id"]) for d in running}
+            if any(f".M{k}" in f for k in keys for f in spills):
+                victim.send_signal(signal.SIGKILL)
+                killed = True
+                break
+            time.sleep(0.05)
+        assert killed, "victim never got mid-compiled-leg before deadline"
+
+        # the healthy fleet runs IN-PROCESS so its compiled-leg
+        # counters fold into the server's IterationStats (the counter
+        # fold is process-global — a subprocess's bumps stay its own,
+        # like spec_wins in the pusher leg above)
+        healthy = [Worker(store, name=f"healthy-{i}").configure(
+            max_iter=100000, max_sleep=0.05, max_tasks=1,
+            heartbeat_s=0.25) for i in range(2)]
+        hthreads = [threading.Thread(target=w.execute, daemon=True)
+                    for w in healthy]
+        for t in hthreads:
+            t.start()
+
+        st.join(timeout=120)
+        assert not st.is_alive(), \
+            "server wedged after the compiled-leg worker was SIGKILLed"
+        for t in hthreads:
+            t.join(timeout=30)
+        victim.wait(timeout=10)
+        stats = final["stats"]
+    finally:
+        install_fault_plan(None)
+        sys.path.remove(str(moddir))
+
+    assert _result_bytes(spec.storage, only_results=True) == clean
+    # zero repetition charges: with the stale requeue off, only the
+    # clone's zero-charge coverage can have finished the victim's job
+    for d in store.jobs(MAP_NS):
+        assert d["repetitions"] == 0, \
+            f"SIGKILL mid-leg charged a repetition: map job {d['_id']}"
+    it = stats.iterations[-1]
+    assert it.spec_launched >= 1, "detector never opened a shadow lease"
+    # the surviving fleet kept running compiled legs, and the reduce
+    # fold folded — the kill degraded ONE worker, not the hybrid plane
+    assert it.hybrid_map_legs >= 1
+    assert it.hybrid_reduce_legs >= 1

@@ -1,0 +1,442 @@
+"""Hybrid stage-granular lowering tests (engine/hybrid.py, DESIGN §28).
+
+The third engine rung, golden-diffed against the pure store plane on
+both executors:
+
+- byte-identical output for integer workloads with BOTH legs compiled
+  (forced ``engine="hybrid"``) and under the ``engine="auto"`` ladder
+  (whole-task in-graph still wins; partially-numeric tasks take the
+  hybrid rung; fully host-bound tasks stay store),
+- the reduce fold's structural proof gating: a literal-seeded
+  ``sum(values)`` reducer is NOT folded (python ``sum`` starts from the
+  literal 0 — provably different jaxpr), the explicit accumulator loop
+  IS, and an unproven fold changes speed, never bytes,
+- the never-crash contract: forced hybrid with zero qualifying legs
+  runs pure store-plane with counted/logged/traced evidence; a
+  trace-time map failure retires the leg and replays interpreted,
+- the Server/Worker plane: the per-stage split is negotiated on the
+  task doc, sticky on resume (doc wins over a recompute), and the
+  workers run exactly the negotiated legs,
+- the decision chain: ``lowering`` + per-stage ``lowering.<stage>``
+  spans, ``hybrid.run`` / ``hybrid.fallback``, and the per-iteration
+  engine map reporting ``hybrid``.
+"""
+
+import threading
+
+import pytest
+
+from lua_mapreduce_tpu.coord.jobstore import MemJobStore
+from lua_mapreduce_tpu.engine.contract import TaskSpec
+from lua_mapreduce_tpu.engine.hybrid import HybridReduceFold
+from lua_mapreduce_tpu.engine.ingraph import select_engine
+from lua_mapreduce_tpu.engine.local import LocalExecutor
+from lua_mapreduce_tpu.engine.server import Server
+from lua_mapreduce_tpu.engine.worker import Worker
+from lua_mapreduce_tpu.store.router import get_storage_from
+
+from tests.test_ingraph import _result_bytes, igmod  # noqa: F401
+
+# ---------------------------------------------------------------------------
+# fixture task sources
+# ---------------------------------------------------------------------------
+
+# every data-plane function in-graph: integer values, uniform two-key
+# emission (the shard_map tier shape), explicit-accumulator sum reducer
+# (the provable fold shape) — forced hybrid must be byte-identical
+HY_FULL = """
+import jax.numpy as jnp
+
+def taskfn(emit):
+    for j in range(6):
+        emit(j, {"v": [(j * 7 + i) % 23 for i in range(8)]})
+
+def mapfn(key, value, emit):
+    v = jnp.asarray(value["v"], jnp.int32)
+    emit(0, jnp.sum(v))
+    emit(1, v[0] * 2)
+
+def partitionfn(key):
+    return int(key) % 2
+
+def reducefn(key, values):
+    acc = values[0]
+    for i in range(1, len(values)):
+        acc = acc + values[i]
+    return acc
+
+reducefn.associative_reducer = True
+reducefn.commutative_reducer = True
+
+combinerfn = reducefn
+"""
+
+# the hybrid-rung shape: numeric map+reduce, but partitionfn routes
+# through hashlib (an "indirect call" — store-plane verdict), so the
+# WHOLE task can never lower and engine=auto must take the stage rung
+HY_PARTIAL = """
+import hashlib
+import jax.numpy as jnp
+
+def taskfn(emit):
+    for j in range(6):
+        emit(j, {"v": [(j * 5 + i) % 17 for i in range(8)]})
+
+def mapfn(key, value, emit):
+    v = jnp.asarray(value["v"], jnp.int32)
+    emit(0, jnp.sum(v))
+    emit(1, v[0] + 10)
+
+def partitionfn(key):
+    h = hashlib.blake2b(str(key).encode(), digest_size=2).hexdigest()
+    return int(h, 16) % 2
+
+def reducefn(key, values):
+    acc = values[0]
+    for i in range(1, len(values)):
+        acc = acc + values[i]
+    return acc
+
+reducefn.associative_reducer = True
+reducefn.commutative_reducer = True
+"""
+
+# fully host-bound: every stage store-plane — forced hybrid has ZERO
+# qualifying legs and must still run (pure store) with evidence
+HY_HOSTBOUND = """
+def taskfn(emit):
+    for j in range(4):
+        emit(j, {"v": [j + i for i in range(4)]})
+
+def mapfn(key, value, emit):
+    emit(0, sorted(value["v"])[0])
+    emit(1, sorted(value["v"])[-1])
+
+def partitionfn(key):
+    return int(key) % 2
+
+def reducefn(key, values):
+    return sorted(values)[0]
+"""
+
+# oracle-accepted map leg whose lowering fails at trace time: the
+# emitted KEY is a traced value (the same refusal as the whole-task
+# plane) — forced hybrid must degrade the leg, never crash
+HY_TRACE_FAIL = """
+import jax.numpy as jnp
+
+def taskfn(emit):
+    for j in range(4):
+        emit(j, {"v": [float(j + 1), 2.0]})
+
+def mapfn(key, value, emit):
+    v = jnp.asarray(value["v"], jnp.float32)
+    emit(jnp.sum(v), v[0])
+
+def partitionfn(key):
+    return int(key) % 2
+
+def reducefn(key, values):
+    acc = values[0]
+    for i in range(1, len(values)):
+        acc = acc + values[i]
+    return acc
+"""
+
+
+def _local(mod, engine, tag, **kw):
+    spec = TaskSpec(taskfn=mod, mapfn=mod, partitionfn=mod, reducefn=mod,
+                    combinerfn=mod if kw.pop("combiner", False) else None,
+                    storage=f"mem:hy-{tag}")
+    ex = LocalExecutor(spec, engine=engine, **kw)
+    ex.run()
+    return ex
+
+
+# ---------------------------------------------------------------------------
+# golden diffs + ladder, LocalExecutor
+# ---------------------------------------------------------------------------
+
+def test_forced_hybrid_both_legs_byte_identical(igmod):  # noqa: F811
+    mod = igmod("hy_full", HY_FULL)
+    ex_s = _local(mod, "store", "full-s", combiner=True)
+    ex_h = _local(mod, "hybrid", "full-h", combiner=True)
+    assert ex_h.engine_decision.chosen == "hybrid"
+    assert ex_h.engine_decision.stages == {"map": True, "reduce": True}
+    out = _result_bytes(ex_h.result_store)
+    assert out and out == _result_bytes(ex_s.result_store)
+    it = ex_h.stats.iterations[-1]
+    assert it.hybrid_map_legs == 1
+    assert it.hybrid_reduce_legs >= 1
+    assert it.hybrid_fallbacks == 0
+    # uniform numeric-keyed jobs ride the batched shard_map tier, once
+    assert ex_h._hybrid.map_engine.mode == "shard_map"
+    assert ex_h._hybrid.map_engine.traces == 1
+    assert ex_h._hybrid.fold.folded_groups >= 1
+    # the store twin never touched the hybrid plane
+    it_s = ex_s.stats.iterations[-1]
+    assert it_s.hybrid_map_legs == 0 and it_s.hybrid_reduce_legs == 0
+
+
+def test_auto_ladder_ingraph_still_wins(igmod):  # noqa: F811
+    """A fully in-graph task under auto keeps the WHOLE-task plane —
+    the hybrid rung only catches tasks the top rung rejects."""
+    mod = igmod("hy_full_auto", HY_FULL)
+    ex = _local(mod, "auto", "full-auto", combiner=True)
+    assert ex.engine_decision.chosen == "ingraph"
+    assert ex.stats.iterations[-1].hybrid_map_legs == 0
+
+
+def test_auto_partial_task_takes_hybrid_rung(igmod):  # noqa: F811
+    mod = igmod("hy_partial", HY_PARTIAL)
+    ex_s = _local(mod, "store", "part-s")
+    ex_h = _local(mod, "auto", "part-h")
+    dec = ex_h.engine_decision
+    assert dec.verdict == "store-plane"
+    assert dec.chosen == "hybrid"
+    assert dec.stages == {"map": True, "reduce": True}
+    assert "partitionfn" in dec.reason
+    assert _result_bytes(ex_h.result_store) == _result_bytes(ex_s.result_store)
+    it = ex_h.stats.iterations[-1]
+    assert it.hybrid_map_legs == 1 and it.hybrid_fallbacks == 0
+
+
+def test_auto_hostbound_task_stays_store(igmod):  # noqa: F811
+    mod = igmod("hy_hostbound_auto", HY_HOSTBOUND)
+    ex = _local(mod, "auto", "host-auto")
+    assert ex.engine_decision.chosen == "store"
+    assert ex.engine_decision.stages is None
+    it = ex.stats.iterations[-1]
+    assert it.hybrid_map_legs == 0 and it.hybrid_fallbacks == 0
+    assert len(_result_bytes(ex.result_store)) > 0
+
+
+def test_forced_hybrid_zero_legs_never_crashes(igmod):  # noqa: F811
+    """engine=hybrid on a fully host-bound task: pure store-plane run,
+    normal output, and the once-per-task degrade evidence (counter)."""
+    mod = igmod("hy_hostbound_forced", HY_HOSTBOUND)
+    ex_s = _local(mod, "store", "host-s")
+    ex_h = _local(mod, "hybrid", "host-h")
+    assert ex_h.engine_decision.chosen == "hybrid"
+    assert ex_h.engine_decision.stages == {"map": False, "reduce": False}
+    assert _result_bytes(ex_h.result_store) == _result_bytes(ex_s.result_store)
+    it = ex_h.stats.iterations[-1]
+    assert it.hybrid_fallbacks == 1
+    assert it.hybrid_map_legs == 0 and it.hybrid_reduce_legs == 0
+
+
+def test_trace_failure_degrades_map_leg(igmod):  # noqa: F811
+    mod = igmod("hy_trace_fail", HY_TRACE_FAIL)
+    ex_s = _local(mod, "store", "tf-s")
+    ex_h = _local(mod, "hybrid", "tf-h")
+    assert ex_h.engine_decision.chosen == "hybrid"
+    # the map leg died at trace time: retired, counted, replayed
+    # interpreted — bytes still equal
+    assert ex_h._hybrid.map_engine is None
+    it = ex_h.stats.iterations[-1]
+    assert it.hybrid_fallbacks >= 1 and it.hybrid_map_legs == 0
+    assert _result_bytes(ex_h.result_store) == _result_bytes(ex_s.result_store)
+
+
+# ---------------------------------------------------------------------------
+# reduce fold proof gating (unit)
+# ---------------------------------------------------------------------------
+
+def _fold_spec(reducefn):
+    # the fold's first witness is the declared algebra; these unit
+    # tests exercise the second (the structural jaxpr proof)
+    reducefn.associative_reducer = True
+    reducefn.commutative_reducer = True
+    return TaskSpec(taskfn={"taskfn": lambda e: e(0, 1)},
+                    mapfn={"mapfn": lambda k, v, e: e(0, v)},
+                    partitionfn={"partitionfn": lambda k: 0},
+                    reducefn={"reducefn": reducefn},
+                    storage="mem:hy-foldunit")
+
+
+def test_fold_rejects_literal_seeded_sum():
+    """python sum(values) folds from the LITERAL 0 — a jaxpr the sum
+    proof must refuse (an add with a literal operand), so the group
+    interprets; the fold stays live for provable groups."""
+    fold = HybridReduceFold(_fold_spec(lambda k, vs: sum(vs)))
+    assert fold(0, [1, 2, 3]) is None
+    assert not fold.retired
+    assert fold.folded_groups == 0 and not fold.take_used()
+
+
+def test_fold_accepts_accumulator_loop_and_restores_bytes():
+    def reducefn(k, vs):
+        acc = vs[0]
+        for i in range(1, len(vs)):
+            acc = acc + vs[i]
+        return acc
+
+    fold = HybridReduceFold(_fold_spec(reducefn))
+    assert fold(0, [3, 4, 5]) == 12
+    assert fold.folded_groups == 1
+    assert fold.take_used() and not fold.take_used()
+    # singletons never fold (run_reduce_job's fast path owns them)
+    assert fold(0, [7]) is None
+    # non-numeric groups interpret without retiring the fold
+    assert fold(1, ["a", "b"]) is None and not fold.retired
+
+
+def test_fold_retires_on_proof_cache_blowup():
+    def reducefn(k, vs):
+        acc = vs[0]
+        for i in range(1, len(vs)):
+            acc = acc + vs[i]
+        return acc
+
+    fold = HybridReduceFold(_fold_spec(reducefn))
+    fold.MAX_PROBES = 4
+    for key in range(6):
+        fold(key, [key, key + 1])
+    assert fold.retired
+    assert "signatures" in fold.retire_reason
+    # retired: every later group interprets, silently and safely
+    assert fold(99, [1, 2]) is None
+
+
+# ---------------------------------------------------------------------------
+# Server/Worker plane: doc negotiation, sticky resume, golden diff
+# ---------------------------------------------------------------------------
+
+def _fleet(spec, engine=None, n_workers=2, store=None):
+    store = store or MemJobStore()
+    server = Server(store, poll_interval=0.02,
+                    engine=engine).configure(spec)
+    workers = [Worker(store).configure(max_iter=400, max_sleep=0.05)
+               for _ in range(n_workers)]
+    threads = [threading.Thread(target=w.execute, daemon=True)
+               for w in workers]
+    for t in threads:
+        t.start()
+    stats = server.loop()
+    for t in threads:
+        t.join(timeout=30)
+    return server, stats, store
+
+
+def _srv_spec(mod, tag):
+    return TaskSpec(taskfn=mod, mapfn=mod, partitionfn=mod, reducefn=mod,
+                    storage=f"mem:hysrv-{tag}")
+
+
+def test_server_hybrid_negotiates_doc_and_matches_store(igmod):  # noqa: F811
+    mod = igmod("hy_partial_srv", HY_PARTIAL)
+    _, stats_h, store_h = _fleet(_srv_spec(mod, "h"), engine="auto")
+    _, stats_s, _ = _fleet(_srv_spec(mod, "s"), engine="store")
+    assert _result_bytes(get_storage_from("mem:hysrv-h")) == \
+        _result_bytes(get_storage_from("mem:hysrv-s"))
+    task = store_h.get_task()
+    assert task["hybrid_stages"] == {"map": True, "reduce": True}
+    it = stats_h.iterations[-1]
+    assert it.hybrid_map_legs >= 1 and it.hybrid_reduce_legs >= 1
+    assert it.hybrid_fallbacks == 0
+    # the store fleet negotiated NO split
+    assert stats_s.iterations[-1].hybrid_map_legs == 0
+
+
+def test_server_resume_keeps_doc_stage_split(igmod):  # noqa: F811
+    """Resume stickiness: a doc whose negotiated split disables the map
+    leg wins over the oracle's fresh recompute — the fleet keeps
+    running exactly the legs the crashed run's workers were running."""
+    mod = igmod("hy_partial_resume", HY_PARTIAL)
+    spec = _srv_spec(mod, "resume")
+    # the oracle would say {"map": True, "reduce": True}...
+    assert select_engine(spec, "auto").stages == \
+        {"map": True, "reduce": True}
+    store = MemJobStore()
+    from lua_mapreduce_tpu.core.constants import TaskStatus
+    store.put_task({"_id": "unique", "status": TaskStatus.WAIT.value,
+                    "iteration": 1, "spec": spec.describe(),
+                    "engine": "auto",
+                    "hybrid_stages": {"map": False, "reduce": True}})
+    _, stats, store = _fleet(spec, engine="auto", store=store)
+    task = store.get_task()
+    assert task["hybrid_stages"] == {"map": False, "reduce": True}
+    it = stats.iterations[-1]
+    # workers honored the doc: no compiled map, the reduce fold ran
+    assert it.hybrid_map_legs == 0
+    assert it.hybrid_reduce_legs >= 1
+    ex_s = _local(mod, "store", "resume-twin")
+    assert _result_bytes(get_storage_from("mem:hysrv-resume")) == \
+        _result_bytes(ex_s.result_store)
+
+
+# ---------------------------------------------------------------------------
+# observability: decision chain, spans, counter schema, CLI knobs
+# ---------------------------------------------------------------------------
+
+def test_lowering_stage_spans_and_engine_report(igmod):  # noqa: F811
+    from lua_mapreduce_tpu.trace.collect import TraceCollection
+    from lua_mapreduce_tpu.trace.span import Tracer, install_tracer
+
+    mod = igmod("hy_partial_span", HY_PARTIAL)
+    install_tracer(Tracer())
+    try:
+        _local(mod, "auto", "span-h")
+    finally:
+        install_tracer(None)
+    col = TraceCollection.from_store(get_storage_from("mem:hy-span-h"))
+    decs = col.lowering_decisions()
+    chain = [d["span"] for d in decs]
+    assert chain[:3] == ["lowering", "lowering.map", "lowering.reduce"]
+    assert decs[0]["engine"] == "hybrid"
+    assert decs[0]["verdict"] == "store-plane"
+    stage_map = decs[chain.index("lowering.map")]
+    assert stage_map["stage"] == "map"
+    assert stage_map["engine"] == "hybrid"
+    assert stage_map["compiled"] == "true"
+    assert "fn.mapfn" in stage_map
+    assert any(s["name"] == "hybrid.run" for s in col.spans)
+    assert col.engines_by_iteration() == {1: "hybrid"}
+
+
+def test_fallback_span_carries_stage(igmod):  # noqa: F811
+    from lua_mapreduce_tpu.trace.collect import TraceCollection
+    from lua_mapreduce_tpu.trace.span import Tracer, install_tracer
+
+    mod = igmod("hy_trace_fail_span", HY_TRACE_FAIL)
+    install_tracer(Tracer())
+    try:
+        _local(mod, "hybrid", "span-fb")
+    finally:
+        install_tracer(None)
+    col = TraceCollection.from_store(get_storage_from("mem:hy-span-fb"))
+    decs = col.lowering_decisions()
+    fbs = [d for d in decs if d["span"] == "hybrid.fallback"]
+    assert fbs and fbs[0]["stage"] == "map"
+    assert col.engines_by_iteration() == {1: "store"}
+
+
+def test_counter_schema():
+    from lua_mapreduce_tpu.utils.stats import COUNTER_FOLD, IterationStats
+    for c in ("hybrid_map_legs", "hybrid_reduce_legs", "hybrid_fallbacks"):
+        assert c in COUNTER_FOLD
+    d = IterationStats(iteration=1).as_dict()
+    assert d["hybrid_map_legs"] == 0
+    assert d["hybrid_reduce_legs"] == 0
+    assert d["hybrid_fallbacks"] == 0
+
+
+def test_cli_hybrid_engine_choice():
+    from lua_mapreduce_tpu.cli.execute_server import \
+        build_parser as server_parser
+    from lua_mapreduce_tpu.cli.execute_worker import \
+        build_parser as worker_parser
+    args = server_parser().parse_args(
+        ["mem", "t", "m", "p", "r", "--engine", "hybrid"])
+    assert args.engine == "hybrid"
+    assert worker_parser().parse_args(
+        ["mem", "--engine", "hybrid"]).engine == "hybrid"
+
+
+def test_resolve_engine_accepts_hybrid(monkeypatch):
+    from lua_mapreduce_tpu.engine.ingraph import resolve_engine
+    assert resolve_engine("hybrid") == "hybrid"
+    monkeypatch.setenv("LMR_ENGINE", "hybrid")
+    assert resolve_engine(None) == "hybrid"
+    with pytest.raises(ValueError):
+        resolve_engine("stagewise")

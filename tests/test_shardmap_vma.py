@@ -38,12 +38,10 @@ from jax.sharding import AbstractMesh, Mesh, PartitionSpec as P
 
 from lua_mapreduce_tpu import ops
 
-# jax.shard_map went public in newer JAX; older installs carry it in
-# experimental with identical semantics
-try:
-    shard_map = jax.shard_map
-except AttributeError:
-    from jax.experimental.shard_map import shard_map
+# vma_shard_map: public-API shard_map with full vma checking where the
+# checker understands pallas_call; on legacy experimental shard_map the
+# rep check is disabled (no pallas_call rule there) instead of crashing
+from lua_mapreduce_tpu.utils.jax_compat import vma_shard_map as shard_map
 
 
 def _abstract_mesh():
