@@ -374,6 +374,44 @@ def _speculation_fields() -> dict:
     return out
 
 
+def _autotune_fields() -> dict:
+    """Detail fields for lmr-autotune (DESIGN §29): a small live paired
+    leg of benchmarks/autotune_bench (the many_tiny_jobs shape, hand-
+    tuned vs adaptive — the cheapest shape that exercises the batch_k
+    feedback loop end to end), then the committed artifact's headline
+    numbers: per-shape adaptive-vs-hand-tuned and adaptive-vs-untuned
+    cluster-time ratios and the acceptance verdict. Never sinks the
+    flagship metric."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = {}
+    try:
+        from benchmarks.autotune_bench import _leg
+        h = _leg("many_tiny_jobs", "hand_tuned", "bench-live-hand")
+        a = _leg("many_tiny_jobs", "adaptive", "bench-live-adaptive")
+        out = {
+            "autotune_vs_hand_tuned_live_1round": round(
+                h["cluster_s"] / max(a["cluster_s"], 1e-9), 3),
+            "autotune_decisions_live": a["decisions"],
+            "autotune_identical_output_live": h["result"] == a["result"],
+        }
+    except Exception as e:
+        out = {"autotune_bench_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        with open(os.path.join(here, "benchmarks", "results",
+                               "autotune.json")) as f:
+            art = json.load(f)
+        for shape, d in art["shapes"].items():
+            out[f"autotune_{shape}_vs_untuned"] = \
+                d["adaptive_speedup_vs_untuned"]
+            out[f"autotune_{shape}_vs_hand_tuned"] = \
+                d["adaptive_vs_hand_tuned"]
+        out["autotune_acceptance_pass"] = art["acceptance"]["pass"]
+    except Exception:
+        pass
+    return out
+
+
 def _trace_fields() -> dict:
     """Detail fields for lmr-trace (DESIGN §22): a small live paired
     run of benchmarks/trace_bench (1 round, tracing off vs on on the
@@ -645,6 +683,10 @@ def main() -> None:
         # lmr-trace: tracing-on overhead (≤1.05), tracing-off control
         # (≤1.02), spans per job (benchmarks/trace_bench.py; DESIGN §22)
         **_trace_fields(),
+        # lmr-autotune: adaptive-vs-hand-tuned / adaptive-vs-untuned
+        # cluster-time ratios per workload shape + the acceptance
+        # verdict (benchmarks/autotune_bench.py; DESIGN §29)
+        **_autotune_fields(),
         # in-graph engine: compiled-vs-interpreted loop-workload
         # speedup + one-time compile cost
         # (benchmarks/ingraph_bench.py; DESIGN §26)

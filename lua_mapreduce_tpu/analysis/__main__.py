@@ -83,6 +83,14 @@ def _protocol_suite(args):
     runs.append(("coded-recovery", dataclasses.replace(
         base, n_jobs=2, batch_k=min(args.batch_k, 2),
         data_loss_budget=2, coded=True)))
+    # the elastic join/leave edge (DESIGN §29): pool membership as
+    # state — absent-worker join, idle-worker graceful retire — with
+    # death, exhaustively on a 2-worker 2-job box (the membership
+    # modes add little space; retire purity and the no-lease-abandoned
+    # rule are the invariants that matter)
+    runs.append(("elastic-pool", dataclasses.replace(
+        base, n_workers=2, n_jobs=2, batch_k=min(args.batch_k, 2),
+        elastic=True)))
     if args.seed_bug:
         bugs = [args.seed_bug]
     else:
@@ -118,6 +126,11 @@ def _protocol_suite(args):
             # one lost-notification event to be reachable
             extra = dict(n_jobs=2, batch_k=min(args.batch_k, 2),
                          allow_notify=True)
+        elif bug in proto_mod.ELASTIC_BUGS:
+            # elastic-edge bugs need the pool-membership dimension and
+            # a second worker (the last one starts absent)
+            extra = dict(n_workers=2, n_jobs=2,
+                         batch_k=min(args.batch_k, 2), elastic=True)
         elif bug in proto_mod.CODED_BUGS:
             # coded-edge bugs need the stripe data plane and enough
             # budget to degrade a stripe (and, for the decode-blind

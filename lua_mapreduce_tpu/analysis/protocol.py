@@ -81,6 +81,21 @@ speculation exists to threaten — no-double-commit and
 reps-monotone — are checked on every interleaving of original vs
 clone commit, death at any step included.
 
+**Elastic join/leave (DESIGN §29).** With ``ModelConfig(elastic=True)``
+the pool itself becomes part of the state: the last worker starts
+ABSENT (not yet spawned — the controller's scale-up capacity) and may
+``join`` at any step, and any IDLE worker may ``retire`` (the
+controller's scale-down) into a terminal GONE mode. Both edges must be
+state-transparent on every job, and retire carries the
+no-lease-abandoned invariant: a worker may leave only while it owns no
+RUNNING/FINISHED lease — exactly the graceful-retire contract
+``FleetSupervisor`` implements by bounding a member's lifetime so it
+exits AFTER its current lease commits. The seeded bug
+(``elastic_retire_holds_lease``) lets a mid-lease worker retire — the
+scale-down that strands its leased jobs until the scavenger requeues
+them with an undeserved repetition charge — and the checker re-finds
+it as a direct invariant hit on the retire step.
+
 Seedable bugs (``ModelConfig(bug=...)``):
 
 - ``"commit_skips_owner_cas"`` — commit checks status but not
@@ -182,7 +197,8 @@ _ALLOWED_EDGES = {
 KNOWN_BUGS = ("commit_skips_owner_cas", "requeue_ignores_finished",
               "scavenge_skips_lost_data", "lost_requeue_skips_written_cas",
               "spec_commit_skips_winner_cas", "lost_wakeup_no_fallback",
-              "coded_decode_lost_stripe", "coded_requeue_skips_decode")
+              "coded_decode_lost_stripe", "coded_requeue_skips_decode",
+              "elastic_retire_holds_lease")
 
 # bugs living on the replica-recovery edge need loss events to surface
 LOSS_BUGS = ("scavenge_skips_lost_data", "lost_requeue_skips_written_cas")
@@ -197,6 +213,14 @@ SPEC_BUGS = ("spec_commit_skips_winner_cas",)
 # bugs living on the watch/notify edge need the wakeup layer enabled
 # (and a loss budget — a never-lost notification always wakes)
 NOTIFY_BUGS = ("lost_wakeup_no_fallback",)
+
+# bugs living on the elastic join/leave edge need the elastic pool
+ELASTIC_BUGS = ("elastic_retire_holds_lease",)
+
+# elastic join/leave must be state-transparent on every job: scaling
+# the pool may never change a status, an owner, or a retry budget —
+# the semantics-neutrality rule of DESIGN §29
+_ELASTIC_PURE_OPS = frozenset({"join", "retire"})
 
 # notify/wait edges must be state-transparent on every job: going to
 # sleep, waking (by notification or timeout), and losing a wakeup may
@@ -223,8 +247,10 @@ _D_UNDER = 1     # readable, but below full r-way redundancy
 _D_INTACT = 2    # full redundancy
 
 # environment events: enumerable, but never count as protocol progress
+# (join/retire are the controller's capacity choices — WHEN capacity
+# arrives or leaves is the environment's pick, like death)
 _ENV_OPS = frozenset({"die", "lose_replica", "lose_all", "lose_parity",
-                      "lose_notify"})
+                      "lose_notify", "join", "retire"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -241,6 +267,7 @@ class ModelConfig:
     allow_spec: bool = False
     allow_notify: bool = False
     notify_loss_budget: int = 1
+    elastic: bool = False
     bug: Optional[str] = None
 
     def __post_init__(self):
@@ -285,6 +312,15 @@ class ModelConfig:
             raise ValueError(f"bug {self.bug!r} lives on the watch/notify "
                              "edge: it needs allow_notify=True and "
                              "notify_loss_budget ≥ 1 to be reachable")
+        if self.elastic and self.n_workers < 2:
+            raise ValueError("elastic=True needs ≥ 2 workers: the last "
+                             "worker starts ABSENT (scale-up capacity), "
+                             "so a 1-worker pool would have nobody to "
+                             "run jobs before the join")
+        if self.bug in ELASTIC_BUGS and not self.elastic:
+            raise ValueError(f"bug {self.bug!r} lives on the elastic "
+                             "join/leave edge: it needs elastic=True "
+                             "to be reachable")
         if self.allow_spec and self.n_workers < 2:
             raise ValueError("allow_spec needs ≥ 2 workers: a shadow "
                              "lease is never taken by the job's own "
@@ -307,6 +343,9 @@ class ModelConfig:
 #   ("I",)                                       idle (polling)
 #   ("S",)                                       asleep awaiting wakeup
 #   ("D",)                                       dead
+#   ("A",)                                       absent (elastic: not yet
+#                                                joined — scale-up slot)
+#   ("G",)                                       gone (elastic: retired)
 #   ("R", leased, pos, done)                     executing job bodies
 #   ("C", leased, entries, i, phase, tail, brk)  committing entry i
 #   ("L", leased, tail, brk)                     releasing unstarted tail
@@ -317,6 +356,8 @@ class ModelConfig:
 
 _IDLE = ("I",)
 _DEAD = ("D",)
+_ABSENT = ("A",)
+_GONE = ("G",)
 
 
 @dataclasses.dataclass
@@ -351,6 +392,10 @@ class LeaseModel:
         jobs = tuple((_WAIT, 0, 0, 0, _D_INTACT, _SP_NONE)
                      for _ in range(self.cfg.n_jobs))
         workers = tuple(_IDLE for _ in range(self.cfg.n_workers))
+        if self.cfg.elastic:
+            # the last worker is the controller's scale-up capacity:
+            # absent until a budget-free "join" brings it into the pool
+            workers = workers[:-1] + (_ABSENT,)
         commits = (0,) * self.cfg.n_jobs
         return (jobs, workers, commits, self.cfg.data_loss_budget,
                 (0,) * self.cfg.n_workers,
@@ -389,10 +434,28 @@ class LeaseModel:
 
         for w, mode in enumerate(workers):
             kind = mode[0]
-            if kind == "D":
+            if kind in ("D", "G"):
+                continue
+            if kind == "A":
+                # elastic scale-up: the absent worker joins the pool —
+                # a pure capacity event, no job is touched
+                out.append((("join", w), repl_w(w, _IDLE)))
                 continue
             if cfg.allow_death:
                 out.append((("die", w), repl_w(w, _DEAD)))
+            if cfg.elastic and kind == "I":
+                # elastic scale-down: an IDLE worker retires — the
+                # graceful-exit contract (it owns no lease here by
+                # construction; the step invariant verifies exactly
+                # that, and the seeded bug below violates it)
+                out.append((("retire", w), repl_w(w, _GONE)))
+            if (cfg.bug == "elastic_retire_holds_lease"
+                    and kind in ("R", "C")):
+                # the seeded bug: the supervisor retires a member
+                # MID-LEASE (kills the thread instead of bounding its
+                # lifetime) — its leased jobs strand until the stale
+                # requeue charges them a repetition they never earned
+                out.append((("retire", w), repl_w(w, _GONE)))
             if kind == "S":
                 # asleep in Waiter.wait. A pending notification wakes
                 # it (consuming this worker's bit — the cursor);
@@ -763,6 +826,21 @@ class LeaseModel:
                        label: tuple) -> Optional[str]:
         ojobs, ocommits = old[0], old[2]
         njobs, ncommits = new[0], new[2]
+        if label[0] == "retire":
+            # the no-lease-abandoned rule (DESIGN §29): a retiring
+            # worker must own no live lease — FleetSupervisor's
+            # graceful exit bounds the member's lifetime so it leaves
+            # only AFTER its current lease settles
+            w = label[1]
+            held = [j for j, rec in enumerate(ojobs)
+                    if rec[0] in (_RUN, _FIN)
+                    and (rec[2] == w + 1 or rec[5] == _SP_TAKEN0 + w)]
+            if held:
+                return (f"retired worker {w} abandoned leases on jobs "
+                        f"{held} — an elastic scale-down must wait for "
+                        "the in-flight lease to settle (the stale "
+                        "requeue would charge those jobs a repetition "
+                        "they never earned; DESIGN §29)")
         for j, ((os_, or_, oo, _, od, osp), (ns_, nr, no, _, nd, nsp)) in \
                 enumerate(zip(ojobs, njobs)):
             if nr < or_:
@@ -784,6 +862,13 @@ class LeaseModel:
                 # one is a no-op by construction (DESIGN §23)
                 return (f"notify edge {label} touched job {j} state — "
                         "sleep/wake transitions must be pure")
+            if label[0] in _ELASTIC_PURE_OPS and (os_, or_, oo, osp) != \
+                    (ns_, nr, no, nsp):
+                # join/retire are pure capacity events: scaling the
+                # pool may never touch a job (DESIGN §29)
+                return (f"elastic edge {label} touched job {j} state — "
+                        "join/retire must be pure pool-membership "
+                        "transitions")
             if label[0] in _SPEC_PURE_OPS and (ns_ != os_ or nr != or_):
                 # the zero-charge rule of the speculation edges: marking,
                 # taking, or dissolving a shadow lease must be invisible
@@ -820,8 +905,12 @@ class LeaseModel:
 
     def quiescent_violation(self, state: tuple) -> Optional[str]:
         jobs, workers = state[0], state[1]
-        if all(m[0] == "D" for m in workers):
-            return None              # a fully dead pool may strand work
+        if all(m[0] in ("D", "G", "A") for m in workers):
+            # a fully dead pool may strand work; so may a pool whose
+            # every member retired or never joined (the elastic analog
+            # — the real supervisor's baseline floor prevents it, but
+            # the model enumerates the environment's worst case)
+            return None
         bad = {j: Status(s).name
                for j, (s, _, _, _, _, _) in enumerate(jobs)
                if s not in (_WRI, _FAI)}
@@ -965,7 +1054,8 @@ def replay_trace(store, trace: Sequence[tuple], config: ModelConfig,
         op = label[0]
         if op in ("exec", "exec_fail", "spec_exec", "die", "tick",
                   "lose_replica", "lose_all", "lose_parity", "repair",
-                  "sleep", "notify_wake", "timeout_wake", "lose_notify"):
+                  "sleep", "notify_wake", "timeout_wake", "lose_notify",
+                  "join", "retire"):
             # loss events / replica repair live on the data plane, and
             # sleep/wake edges live in the Waiter layer (sched/waiter.py)
             # — neither has a jobstore op to replay; the store-visible
@@ -1200,3 +1290,21 @@ def utest() -> None:
             assert not rep5["ok"], (type(st).__name__, rep5)
             assert rep5["label"][0] in ("rerun_requeue", "commit_a",
                                         "commit_b", "claim"), rep5
+
+    # elastic join/leave (DESIGN §29): the pool-membership edges hold
+    # every invariant exhaustively (join/retire purity, graceful exit),
+    # and retiring a mid-lease member is re-found as the abandoned-
+    # lease violation; the correct-model trace replays on the real
+    # store (join/retire have no store op — exactly the point: scaling
+    # is invisible to the lease protocol)
+    elastic = dataclasses.replace(small, n_workers=2, elastic=True)
+    res6 = check_protocol(elastic)
+    assert res6.ok and res6.states > res.states
+
+    abandon = check_protocol(dataclasses.replace(
+        elastic, bug="elastic_retire_holds_lease"))
+    assert not abandon.ok, "seeded mid-lease retire not found"
+    assert "abandoned leases" in abandon.violation.message
+    rep6 = replay_trace(MemJobStore(), abandon.violation.trace[:-1],
+                        abandon.config)
+    assert rep6["ok"], rep6   # every store op up to the bad retire lands

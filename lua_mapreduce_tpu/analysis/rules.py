@@ -884,3 +884,87 @@ class PushInboxPublishRule(Rule):
                         "faults.coded can expose a partial stripe. "
                         "Match them with faults.coded.manifest_pattern/"
                         "stripe_patterns, publish through the codec")
+
+
+# --- LMR018: controller-owned knobs must ride the task-doc negotiation ------
+
+# the attribute names of knobs the autotune controller owns when the
+# task doc carries the "autotune" marker (sched/controller.py
+# CONTROLLER_KNOBS, minus the ones with no per-worker attribute)
+_CONTROLLER_KNOB_ATTRS = ("batch_k", "speculation", "push_budget_mb")
+
+
+class AutotuneKnobBypassRule(Rule):
+    id = "LMR018"
+    severity = "error"
+    title = "task-scoped engine code must read controller-owned knobs " \
+            "through the task doc"
+    rationale = (
+        "The autotune controller (DESIGN §29) deploys its decisions by "
+        "writing knob values onto the task document; the fleet follows "
+        "the doc on its next poll. An engine/ hot path that handles a "
+        "``task`` doc but reads ``self.batch_k`` / ``self.speculation`` "
+        "/ ``self.push_budget_mb`` directly — without consulting "
+        "``task.get(\"<knob>\")`` — silently pins the process-local "
+        "value: the controller's change lands on the doc, every "
+        "compliant worker follows it, and the bypassing path diverges "
+        "from the fleet (a batch_k bypass splits lease sizing; a "
+        "speculation bypass desynchronizes the straggler threshold). "
+        "Heuristic scope (the documented analysis limits): function "
+        "scopes that bind a ``task`` name, one scope at a time. Reads "
+        "that ARE the negotiation — the same scope also reads "
+        "``task.get(\"<same knob>\")`` (the own-override-else-doc "
+        "pattern) — and knob values passed to ``put_task`` / "
+        "``update_task`` (the deploy writes themselves) are exempt.")
+    paths = ("engine/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for scope, body in _scopes(ctx.tree):
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            has_task = any(a.arg == "task" for a in
+                           scope.args.args + scope.args.kwonlyargs)
+            if not has_task:
+                has_task = any(
+                    isinstance(n, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "task"
+                            for t in n.targets)
+                    for n in _own_walk(body))
+            if not has_task:
+                continue
+            negotiated: Set[str] = set()
+            for call in _calls(body):
+                if (isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "get"
+                        and _chain(call.func.value) == ("task",)
+                        and call.args
+                        and isinstance(call.args[0], ast.Constant)):
+                    negotiated.add(call.args[0].value)
+            par = _parent_map(body)
+            for n in _own_walk(body):
+                if not (isinstance(n, ast.Attribute)
+                        and isinstance(n.ctx, ast.Load)
+                        and n.attr in _CONTROLLER_KNOB_ATTRS
+                        and _chain(n.value) == ("self",)):
+                    continue
+                if n.attr in negotiated:
+                    continue
+                cur, exempt = n, False
+                while cur in par:
+                    cur = par[cur]
+                    if isinstance(cur, ast.Call):
+                        c = _chain(cur.func)
+                        if c and c[-1] in ("put_task", "update_task"):
+                            exempt = True      # the deploy write itself
+                            break
+                if exempt:
+                    continue
+                yield self.finding(
+                    ctx, n,
+                    f"direct read of controller-owned knob "
+                    f"self.{n.attr} in a task-scoped path — the "
+                    f"autotune deploy lands on the task doc, so read "
+                    f"the negotiated value (own override else "
+                    f"task.get(\"{n.attr}\")) or the fleet diverges "
+                    "when the controller retunes it")

@@ -167,6 +167,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "evidence); 'store' opts out. Written to the "
                         "task doc (with the per-stage split) and "
                         "sticky on resume")
+    p.add_argument("--autotune", action="store_true", default=None,
+                   help="self-tuning controller (docs/DESIGN.md §29; "
+                        "default off, or LMR_AUTOTUNE=1): the server's "
+                        "housekeeping tick reads the live stats/trace "
+                        "stream and adapts batch_k, the push buffer "
+                        "budget, the speculation factor, the retry "
+                        "backoff base, and (with --inline-workers) the "
+                        "worker-pool size — every change deployed "
+                        "through the task doc with an autotune.<knob> "
+                        "evidence span, hysteresis-banded and "
+                        "cooldown/flip-lockout gated so knobs never "
+                        "oscillate")
+    p.add_argument("--autotune-max-workers", type=int, default=None,
+                   help="elastic ceiling for the --inline-workers pool "
+                        "under --autotune (default: the controller's "
+                        "fleet cap, clamped by tenant admission quotas "
+                        "when a fair-scheduling config is active)")
     p.add_argument("--trace", action="store_true",
                    help="lmr-trace (docs/DESIGN.md §22): record "
                         "claim/body/publish/commit spans and per-op "
@@ -237,15 +254,35 @@ def main(argv=None) -> int:
                     speculation=args.speculation_factor,
                     speculation_cap=args.speculation_cap,
                     push=args.push,
-                    engine=args.engine).configure(spec)
+                    engine=args.engine,
+                    autotune=args.autotune).configure(spec)
 
-    for _ in range(args.inline_workers):
+    def spawn_worker(_seq: int):
         w = Worker(store).configure(max_iter=10_000)
         if args.idle_poll_ms is not None:
             w.configure(idle_poll_ms=args.idle_poll_ms)
         if args.push_budget_mb is not None:
             w.configure(push_budget_mb=args.push_budget_mb)
         threading.Thread(target=w.execute, daemon=True).start()
+        return w
+
+    if args.inline_workers:
+        if server.autotune:
+            # elastic inline pool (DESIGN §29): the controller's fleet
+            # knob resizes through a FleetSupervisor — retire clamps
+            # max_jobs to 0, so the member leaves AFTER its current
+            # poll settles (no lease is ever abandoned)
+            from lua_mapreduce_tpu.sched.controller import FleetSupervisor
+            cap = args.autotune_max_workers or max(args.inline_workers, 8)
+            sup = FleetSupervisor(
+                spawn_worker, retire=lambda w: w.configure(max_jobs=0),
+                baseline=args.inline_workers, cap=cap)
+            sup.ensure_baseline()
+            server.set_fleet(sup.resize, size=args.inline_workers,
+                             max_workers=cap)
+        else:
+            for i in range(args.inline_workers):
+                spawn_worker(i)
 
     def report(phase: str, frac: float) -> None:
         if not args.quiet:

@@ -87,6 +87,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--retry-base-ms", type=float, default=None,
                    help="decorrelated-jitter backoff base in ms "
                         "(default 25, or LMR_RETRY_BASE_MS)")
+    p.add_argument("--autotune-fleet", type=int, default=None, metavar="N",
+                   help="elastic pool mode (docs/DESIGN.md §29): run N "
+                        "baseline worker threads in this process and "
+                        "follow the task document's controller-written "
+                        "fleet_target — the pool grows toward the target "
+                        "and retires surplus members gracefully (a "
+                        "retiring member stops claiming and exits after "
+                        "its current lease commits, so no lease is ever "
+                        "lost to a scale-down)")
+    p.add_argument("--autotune-max-workers", type=int, default=8,
+                   help="elastic ceiling for --autotune-fleet (raised to "
+                        "the baseline if smaller)")
     p.add_argument("--trace", action="store_true",
                    help="lmr-trace (docs/DESIGN.md §22): record this "
                         "worker's claim/body/publish/commit spans, "
@@ -127,23 +139,27 @@ def main(argv=None) -> int:
         if ph not in ("map", "reduce"):
             raise SystemExit(f"--phases: unknown phase {ph!r}")
     store = FileJobStore(args.coord)
-    worker = Worker(store, name=args.name, verbose=args.verbose).configure(
-        max_iter=args.max_iter, max_sleep=args.max_sleep,
-        max_tasks=args.max_tasks, phases=phases, max_jobs=args.max_jobs)
-    if args.batch_k is not None:
-        worker.configure(batch_k=args.batch_k)
-    if args.idle_poll_ms is not None:
-        worker.configure(idle_poll_ms=args.idle_poll_ms)
-    if args.segment_format is not None:
-        worker.configure(segment_format=args.segment_format)
-    if args.replication is not None:
-        worker.configure(replication=args.replication)
-    if args.coding is not None:
-        worker.configure(coding=args.coding)
-    if args.push is not None:
-        worker.configure(push=args.push)
-    if args.push_budget_mb is not None:
-        worker.configure(push_budget_mb=args.push_budget_mb)
+
+    def mint(name):
+        w = Worker(store, name=name, verbose=args.verbose).configure(
+            max_iter=args.max_iter, max_sleep=args.max_sleep,
+            max_tasks=args.max_tasks, phases=phases, max_jobs=args.max_jobs)
+        if args.batch_k is not None:
+            w.configure(batch_k=args.batch_k)
+        if args.idle_poll_ms is not None:
+            w.configure(idle_poll_ms=args.idle_poll_ms)
+        if args.segment_format is not None:
+            w.configure(segment_format=args.segment_format)
+        if args.replication is not None:
+            w.configure(replication=args.replication)
+        if args.coding is not None:
+            w.configure(coding=args.coding)
+        if args.push is not None:
+            w.configure(push=args.push)
+        if args.push_budget_mb is not None:
+            w.configure(push_budget_mb=args.push_budget_mb)
+        return w
+
     import contextlib
     profile_ctx = contextlib.nullcontext()
     if args.profile:
@@ -152,8 +168,39 @@ def main(argv=None) -> int:
         # force_cpu_if_unavailable probe above (utils/profiling.py)
         from lua_mapreduce_tpu.utils.profiling import device_trace
         profile_ctx = device_trace(args.profile)
-    with profile_ctx:
-        worker.execute()
+    if args.autotune_fleet:
+        # elastic pool mode (DESIGN §29): thread members share this
+        # process's store handle; the supervisor loop follows the task
+        # doc's fleet_target (written by the server's controller) and
+        # runs until every member's own lifetime bounds retire it
+        import threading
+        import time
+        from lua_mapreduce_tpu.sched.controller import FleetSupervisor
+
+        threads = {}
+
+        def spawn(seq):
+            w = mint(f"{args.name or 'elastic'}-{seq}")
+            t = threading.Thread(target=w.execute, daemon=True)
+            threads[id(w)] = t
+            t.start()
+            return w
+
+        cap = max(args.autotune_fleet, args.autotune_max_workers)
+        sup = FleetSupervisor(
+            spawn, retire=lambda w: w.configure(max_jobs=0),
+            baseline=args.autotune_fleet, cap=cap)
+        with profile_ctx:
+            sup.ensure_baseline()
+            while any(t.is_alive() for t in threads.values()):
+                task = store.get_task() or {}
+                if task.get("autotune") and task.get("fleet_target"):
+                    sup.resize(int(task["fleet_target"]))
+                time.sleep(0.2)
+    else:
+        worker = mint(args.name)
+        with profile_ctx:
+            worker.execute()
     return 0
 
 

@@ -126,7 +126,8 @@ class LocalExecutor:
                  coding: Optional[str] = None,
                  push: Optional[bool] = None,
                  push_budget_mb: Optional[float] = None,
-                 engine: Optional[str] = None):
+                 engine: Optional[str] = None,
+                 autotune: Optional[bool] = None):
         self.spec = spec
         self.map_parallelism = max(1, map_parallelism)
         self.max_iterations = max_iterations
@@ -194,6 +195,18 @@ class LocalExecutor:
         self._hybrid = HybridRunner(
             spec, self.engine_decision,
             log=lambda m: print(f"[local] {m}", file=sys.stderr))
+        # self-tuning controller (lmr-autotune, DESIGN §29): the
+        # in-process mirror of Server housekeeping's feedback loop.
+        # None = LMR_AUTOTUNE env, default off. With no control plane
+        # there is no claim-RPC signal, so the batch_k knob stays
+        # inert; the controller owns the push buffer budget, the
+        # transient-retry backoff base, and the thread-pool width
+        # (the in-process "fleet"), re-deciding once per iteration
+        # from that iteration's IterationStats.
+        from lua_mapreduce_tpu.sched.controller import resolve_autotune
+        self.autotune = resolve_autotune(autotune)
+        self._controller = None
+        self._pool_floor = self.map_parallelism
         self.stats = TaskStats()
         self.finished_value: Any = None
 
@@ -347,8 +360,68 @@ class LocalExecutor:
             COUNTERS.delta(faults0, COUNTERS.snapshot()))
         it_stats.wall_time = time.time() - t0
         self.stats.iterations.append(it_stats)
+        if self.autotune:
+            try:
+                self._autotune_tick(it_stats)
+            except Exception as exc:
+                print(f"[local] autotune tick failed ({type(exc).__name__}:"
+                      f" {exc}); knobs hold", file=sys.stderr)
         self._trace_flush()
         return verdict
+
+    # -- self-tuning controller (lmr-autotune, DESIGN §29) ------------------
+
+    def _autotune_tick(self, it_stats: IterationStats) -> None:
+        from lua_mapreduce_tpu.sched.controller import (AutotuneConfig,
+                                                        AutotuneController,
+                                                        Observation)
+        if self._controller is None:
+            import os
+            from lua_mapreduce_tpu.engine.push import resolve_push_budget
+            from lua_mapreduce_tpu.faults.retry import retry_settings
+            cap = max(self.map_parallelism,
+                      min(AutotuneConfig().fleet_max, os.cpu_count() or 4))
+            self._controller = AutotuneController(
+                push_budget_mb=(self._push_pool.budget / (1024 * 1024)
+                                if self._push_pool is not None else None),
+                retry_base_ms=float(retry_settings()["base_ms"]),
+                fleet=self.map_parallelism, fleet_max=cap)
+        body = (it_stats.map.sum_real_time / it_stats.map.count
+                if it_stats.map.count else None)
+        obs = Observation(
+            t=time.time(), body_ewma_s=body,
+            jobs_done=it_stats.map.count + it_stats.reduce.count,
+            push_frames=it_stats.push_frames,
+            push_evictions=it_stats.push_evictions,
+            spec_launched=it_stats.spec_launched,
+            spec_wins=it_stats.spec_wins,
+            spec_wasted_s=it_stats.spec_wasted_s,
+            store_retries=it_stats.store_retries,
+            # the loop protocol replays the same job census next
+            # iteration, so this iteration's map fan-out IS the backlog
+            # the pool will face again — the queue-depth analog
+            waiting=it_stats.map.count, running=0,
+            fleet=self.map_parallelism)
+        for d in self._controller.tick(obs):
+            self._apply_decision(d)
+
+    def _apply_decision(self, d) -> None:
+        print(f"[local] autotune: {d.knob} {d.old} -> {d.new} "
+              f"({d.metric}={d.observed:.4g}, threshold {d.threshold:.4g})",
+              file=sys.stderr)
+        if d.knob == "push_budget_mb" and self._push_pool is not None:
+            self._push_pool.budget = int(float(d.new) * 1024 * 1024)
+        elif d.knob == "retry_base_ms":
+            from lua_mapreduce_tpu.faults.retry import (configure_retry,
+                                                        retry_settings)
+            configure_retry(retries=int(retry_settings()["retries"]),
+                            base_ms=float(d.new))
+        elif d.knob == "fleet":
+            # pools are minted per _run_jobs call, so a width change
+            # takes effect at the next iteration's first job wave; the
+            # floor is the user's configured parallelism — the
+            # controller only ADDS capacity and later returns to it
+            self.map_parallelism = max(self._pool_floor, int(d.new))
 
     def _run_pipelined(self, jobs) -> Tuple[List[JobTimes], List[JobTimes],
                                             int, List[JobTimes]]:

@@ -127,6 +127,18 @@ class Server:
     to a zero-repetition no-op), so one degraded machine stops setting
     the barrier's wall clock. Safe because spill publishes are
     idempotent; byte-identical output is the chaos suite's gate.
+
+    ``autotune`` (DESIGN §29; None = ``LMR_AUTOTUNE`` env, else off)
+    turns on the self-tuning feedback controller: every housekeeping
+    pass it reads the live stats stream (counter deltas, round-count
+    deltas, the fleet duration EWMA, queue depth) and adapts the perf
+    knobs it owns — batch_k, push budget, speculation factor, retry
+    backoff base, and (with :meth:`set_fleet`) the fleet size —
+    through the same task-doc negotiation, with hysteresis bands,
+    per-knob cooldowns, and a flip lockout for stability under chaos.
+    Every change is an ``autotune.<knob>`` trace span carrying its
+    evidence. Off is byte- and behavior-identical to pre-controller
+    builds.
     """
 
     def __init__(self, store: JobStore, poll_interval: float = DEFAULT_SLEEP,
@@ -140,7 +152,9 @@ class Server:
                  speculation: Optional[float] = None,
                  speculation_cap: int = 2,
                  push: Optional[bool] = None,
-                 engine: Optional[str] = None):
+                 engine: Optional[str] = None,
+                 autotune: Optional[bool] = None,
+                 autotune_config=None):
         # coord RPCs ride the transient-fault retry layer (DESIGN §19);
         # the scavenge/requeue/drain housekeeping must not abort an
         # iteration over one store blip
@@ -199,6 +213,31 @@ class Server:
         # STICKY on resume so a crashed run keeps its plane.
         from lua_mapreduce_tpu.engine.ingraph import resolve_engine
         self.engine = resolve_engine(engine)
+        # self-tuning feedback controller (DESIGN §29; None =
+        # LMR_AUTOTUNE env, else off): a controller riding the
+        # housekeeping cadence adapts the perf knobs it owns (batch_k,
+        # push budget, speculation factor, retry base, fleet target)
+        # from the live stats stream and deploys every change through
+        # the SAME task-doc negotiation the knobs above use. Workers
+        # gate their following of controller-owned keys on the doc's
+        # "autotune" marker, so an autotune-off fleet is byte- and
+        # behavior-identical to pre-controller builds.
+        from lua_mapreduce_tpu.sched.controller import resolve_autotune
+        self.autotune = resolve_autotune(autotune)
+        self._controller = None        # AutotuneController, lazy
+        # an AutotuneConfig override (bands/cooldowns/bounds): tests and
+        # benches compress the control clock to their scale; None = the
+        # deliberately conservative production defaults
+        self._autotune_config = autotune_config
+        # the elastic hook: an owner-installed callable(target)->size
+        # that grows/retires the pool (see set_fleet); fleet decisions
+        # also land on the task doc as "fleet_target" for the worker
+        # CLI's subprocess autoscaler
+        self._fleet_hook = None
+        self._fleet_size: Optional[int] = None
+        self._fleet_max: Optional[int] = None
+        self._autotune_counters = None  # last COUNTERS snapshot
+        self._autotune_rounds = None    # last round_counts snapshot
         self._ingraph = None           # IngraphRunner, built in loop()
         self.spec: Optional[TaskSpec] = None
         self.stats = TaskStats()
@@ -348,7 +387,8 @@ class Server:
                     "batch_k": self.batch_k,
                     "segment_format": self.segment_format,
                     "speculation": self.speculation,
-                    "engine": self.engine},
+                    "engine": self.engine,
+                    "autotune": self.autotune},
                     # JSON-safe redundancy pair: int factor + coding spec
                     **doc_fields(self.replication)))
                 self._notify_jobs()
@@ -387,6 +427,11 @@ class Server:
                 # the execution engine knob (DESIGN §26), sticky on
                 # resume like the shuffle mode
                 "engine": self.engine,
+                # workers gate their following of controller-owned
+                # keys (retry_base_ms, push_budget_mb, fleet_target)
+                # on this marker — autotune-off fleets never apply a
+                # stale controller value (DESIGN §29)
+                "autotune": self.autotune,
                 "started": time.time(),
             })
             self._notify_jobs()      # task appeared: wake waiting workers
@@ -683,9 +728,131 @@ class Server:
                 self._recover_lost(sorted(set(lost)))
             if self._spill_repairs:
                 self._settle_spill_repairs()
+        # the feedback controller's tick rides the same throttled
+        # cadence (DESIGN §29): every knob decision is one housekeeping
+        # pass downstream of the evidence it acted on
+        if self.autotune:
+            try:
+                self._autotune_tick(namespaces)
+            except Exception as exc:
+                # the controller is advisory — a store blip mid-tick
+                # must never abort an iteration
+                self._log(f"autotune tick failed ({type(exc).__name__}: "
+                          f"{exc}); knobs hold")
         # trace drain rides housekeeping (the errors-stream cadence):
         # soft flush — nothing happens below the tracer's threshold
         self._trace_flush()
+
+    # -- self-tuning controller (lmr-autotune, DESIGN §29) ------------------
+
+    def set_fleet(self, hook: Callable[[int], int], size: int,
+                  max_workers: Optional[int] = None) -> None:
+        """Install the elastic-scaling hook: ``hook(target) -> new
+        size`` grows or gracefully retires pool members (see
+        sched.controller.FleetSupervisor). ``size`` is the current
+        fleet; without a hook the controller still writes the
+        ``fleet_target`` doc key for the worker CLI's subprocess
+        autoscaler, but only a hooked server knows its true size."""
+        self._fleet_hook = hook
+        self._fleet_size = int(size)
+        self._fleet_max = max_workers
+        if self._controller is not None:
+            # the hook arrived after the controller was lazily minted
+            # (a supervisor attached mid-run): re-mint on the next tick
+            # so the fleet knob arms with the true size
+            self._controller = None
+
+    def _build_controller(self):
+        from lua_mapreduce_tpu.engine.push import resolve_push_budget
+        from lua_mapreduce_tpu.faults.retry import (COUNTERS,
+                                                    retry_settings)
+        from lua_mapreduce_tpu.sched.controller import AutotuneController
+        self._controller = AutotuneController(
+            batch_k=self.batch_k,
+            push_budget_mb=float(resolve_push_budget(None))
+            if self.push else None,
+            speculation=self.speculation or None,
+            retry_base_ms=float(retry_settings()["base_ms"]),
+            fleet=self._fleet_size,
+            fleet_max=self._fleet_max,
+            config=self._autotune_config)
+        self._autotune_counters = COUNTERS.snapshot()
+        self._autotune_rounds = self.store.round_counts()
+        return self._controller
+
+    def _autotune_tick(self, namespaces) -> None:
+        """Gather one window's evidence and apply the controller's
+        decisions through the task-doc negotiation. The observation
+        RPCs are timed and fed to the controller's rolling p99 — the
+        claim-overhead proxy (same store, same round trip)."""
+        from lua_mapreduce_tpu.faults.retry import COUNTERS
+        from lua_mapreduce_tpu.sched.controller import Observation
+        c = self._controller or self._build_controller()
+        waiting = running = 0
+        t0 = time.perf_counter()
+        for ns in namespaces:
+            counts = self.store.counts(ns)
+            waiting += counts[Status.WAITING] + counts[Status.BROKEN]
+            running += counts[Status.RUNNING]
+        if namespaces:
+            c.note_rpc((time.perf_counter() - t0) / len(namespaces))
+        task = self.store.get_task() or {}
+        ewmas = [float(v) for k, v in task.items()
+                 if k.startswith("dur_ewma:") and v and float(v) > 0]
+        snap = COUNTERS.snapshot()
+        delta = COUNTERS.delta(self._autotune_counters, snap)
+        self._autotune_counters = snap
+        rounds = self.store.round_counts()
+        claim_d = rounds["claim"] - self._autotune_rounds["claim"]
+        # commit round trips are the closest store-visible throughput
+        # proxy (one per retired lease; exact when batch_k amortization
+        # is off, conservative when it is on)
+        commit_d = rounds["commit"] - self._autotune_rounds["commit"]
+        self._autotune_rounds = rounds
+        obs = Observation(
+            t=time.time(),
+            body_ewma_s=max(ewmas) if ewmas else None,
+            rpc_p99_s=c.rpc_p99(),
+            jobs_done=commit_d,
+            claim_rounds=claim_d,
+            push_frames=int(delta.get("push_frames", 0)),
+            push_evictions=int(delta.get("push_evictions", 0)),
+            spec_launched=int(delta.get("spec_launched", 0)),
+            spec_wins=int(delta.get("spec_wins", 0)),
+            spec_wasted_s=float(delta.get("spec_wasted_s", 0.0)),
+            store_retries=int(delta.get("store_retries", 0)),
+            waiting=waiting, running=running,
+            fleet=self._fleet_size)
+        for d in c.tick(obs):
+            self._apply_decision(d)
+
+    def _apply_decision(self, d) -> None:
+        """One knob change, deployed the way an operator would deploy
+        it: the task doc for fleet-followed knobs, configure_retry for
+        the process-local backoff, the hook for the fleet."""
+        self._log(f"autotune: {d.knob} {d.old} -> {d.new} "
+                  f"({d.metric}={d.observed:.4g}, "
+                  f"threshold {d.threshold:.4g})")
+        if d.knob == "batch_k":
+            self.batch_k = int(d.new)
+            self.store.update_task({"batch_k": self.batch_k})
+        elif d.knob == "push_budget_mb":
+            self.store.update_task({"push_budget_mb": float(d.new)})
+        elif d.knob == "speculation":
+            self.speculation = float(d.new)
+            self.store.update_task({"speculation": self.speculation})
+        elif d.knob == "retry_base_ms":
+            from lua_mapreduce_tpu.faults.retry import (configure_retry,
+                                                        retry_settings)
+            configure_retry(retries=int(retry_settings()["retries"]),
+                            base_ms=float(d.new))
+            self.store.update_task({"retry_base_ms": float(d.new)})
+        elif d.knob == "fleet":
+            target = int(d.new)
+            self.store.update_task({"fleet_target": target})
+            if self._fleet_hook is not None:
+                self._fleet_size = int(self._fleet_hook(target))
+            self._notify_jobs()   # new members must find work promptly
 
     # -- tracing hooks (lmr-trace, DESIGN §22) ------------------------------
 
@@ -745,7 +912,11 @@ class Server:
         ewma = task.get(f"dur_ewma:{ns}")
         if not ewma or ewma <= 0:
             return
-        threshold = self.speculation * ewma
+        # the negotiated factor: the doc's deployed value wins (the
+        # autotune controller retunes it there, DESIGN §29), own
+        # attribute as the pre-deploy fallback (LMR018)
+        factor = float(task.get("speculation") or self.speculation)
+        threshold = factor * ewma
         now = time.time()
         last = self._spec_scan_at.get(ns)
         if last is not None and now - last < threshold / 4:
@@ -788,7 +959,7 @@ class Server:
                 self._log(
                     f"straggler: {ns} job {d['_id']} RUNNING "
                     f"{now - d['started_time']:.2f}s > "
-                    f"{self.speculation:g}x EWMA {ewma:.3f}s — "
+                    f"{factor:g}x EWMA {ewma:.3f}s — "
                     "speculative duplicate lease opened")
 
     # -- replica-aware recovery (DESIGN §20) --------------------------------

@@ -150,9 +150,28 @@ class Worker:
         self.push_budget_mb = None
         self._task_push = None                  # last task doc's value
         self._push_pool_obj = None              # lazy per-worker pool
+        # controller-owned knobs (lmr-autotune, DESIGN §29): followed
+        # from the task doc ONLY when the doc carries the server's
+        # "autotune" marker — a controller-off fleet never reads them,
+        # so legacy runs stay byte-identical. _autotune_retry_ms
+        # remembers the last applied value (configure_retry is
+        # process-global; re-applying every poll would thrash the
+        # router's config generation).
+        self._task_push_budget = None           # doc MB when autotuned
+        self._autotune_retry_ms = None          # last doc value applied
         self._dur_ewma: Dict[str, float] = {}   # ns -> smoothed real secs
         self._fleet_ewma: Dict[str, float] = {}  # last task-doc aggregate
         self._ewma_pushed: Dict[str, float] = {}  # ns -> last value pushed
+        # satellite: doc-seeded EWMA warmup (DESIGN §29) — namespaces
+        # whose _dur_ewma came from the fleet aggregate, and how many
+        # of this worker's OWN jobs have folded in since. A fresh
+        # worker's first job body carries compile/warmup cost; folding
+        # it at full _DUR_ALPHA would poison the fleet aggregate every
+        # elastic spawn, so the first own observation above the seed
+        # folds at a discounted weight and _persist_ewma holds until
+        # the worker has at least two own observations in that ns.
+        self._ewma_seeded: set = set()          # ns keys seeded from doc
+        self._ewma_own_n: Dict[str, int] = {}   # ns -> own folds so far
         self._speculation = 0.0          # task-doc factor (0 = off)
         # hybrid compiled legs (DESIGN §28): the server negotiates the
         # per-stage lowering split on the task doc; this worker mints
@@ -302,6 +321,11 @@ class Worker:
         for ns_key, val in self._fleet_ewma.items():
             if ns_key not in self._dur_ewma and val and val > 0:
                 self._dur_ewma[ns_key] = float(val)
+                self._ewma_seeded.add(ns_key)
+        # controller-owned knobs ride the doc only under the server's
+        # autotune marker (DESIGN §29) — see _follow_autotune
+        if task.get("autotune"):
+            self._follow_autotune(task)
 
         if task["status"] == TaskStatus.MAP.value:
             # eager pre-merge rides INSIDE the map phase (pipelined
@@ -438,8 +462,24 @@ class Worker:
 
     def _note_duration(self, ns: str, real_s: float) -> None:
         prev = self._dur_ewma.get(ns)
-        self._dur_ewma[ns] = (real_s if prev is None else
-                              _DUR_ALPHA * real_s + (1 - _DUR_ALPHA) * prev)
+        if prev is None:
+            self._dur_ewma[ns] = real_s
+        else:
+            alpha = _DUR_ALPHA
+            # cold-start bias guard (DESIGN §29): a doc-seeded worker's
+            # FIRST own job in a namespace carries compile/warmup cost
+            # the steady state never pays again. Folding that outlier at
+            # full weight (and then persisting it) would inflate the
+            # fleet aggregate on every elastic spawn — so when the prior
+            # came from the doc and this first observation OVERSHOOTS
+            # it, fold at a quarter weight. Undershoots fold normally:
+            # genuinely-faster hardware should pull the estimate down.
+            if (ns in self._ewma_seeded
+                    and self._ewma_own_n.get(ns, 0) == 0
+                    and real_s > prev):
+                alpha = _DUR_ALPHA / 4.0
+            self._dur_ewma[ns] = alpha * real_s + (1 - alpha) * prev
+        self._ewma_own_n[ns] = self._ewma_own_n.get(ns, 0) + 1
 
     # -- job execution ------------------------------------------------------
 
@@ -542,13 +582,42 @@ class Worker:
     def _push_pool(self):
         """This worker's memory-budgeted push buffer pool, minted
         lazily (one pool per worker — the budget bounds what THIS
-        loop's map bodies may hold in unpublished frames)."""
+        loop's map bodies may hold in unpublished frames). An explicit
+        ``push_budget_mb`` wins; otherwise an autotuned task doc's
+        controller-owned budget applies (DESIGN §29), else the
+        env/default resolution."""
         if self._push_pool_obj is None:
             from lua_mapreduce_tpu.engine.push import (BufferPool,
                                                        resolve_push_budget)
-            self._push_pool_obj = BufferPool(
-                resolve_push_budget(self.push_budget_mb))
+            budget = (self.push_budget_mb if self.push_budget_mb is not None
+                      else self._task_push_budget)
+            self._push_pool_obj = BufferPool(resolve_push_budget(budget))
         return self._push_pool_obj
+
+    def _follow_autotune(self, task: dict) -> None:
+        """Apply the task doc's controller-owned knobs (lmr-autotune,
+        DESIGN §29). Called only when the doc carries the server's
+        ``autotune`` marker, so a controller-off fleet never enters
+        here. batch_k and speculation already follow the doc through
+        the legacy negotiation path; this covers the two knobs that
+        live in process state: the transient-retry backoff base and
+        the push buffer pool's budget (re-budgeted IN PLACE — frames
+        already charged keep their accounting; only the eviction
+        threshold moves)."""
+        v = task.get("retry_base_ms")
+        if v is not None and v != self._autotune_retry_ms:
+            from lua_mapreduce_tpu.faults.retry import (configure_retry,
+                                                        retry_settings)
+            configure_retry(retries=int(retry_settings()["retries"]),
+                            base_ms=float(v))
+            self._autotune_retry_ms = v
+        b = task.get("push_budget_mb")
+        if b is not None:
+            self._task_push_budget = float(b)
+            if self.push_budget_mb is None and self._push_pool_obj is not None:
+                new_budget = int(float(b) * 1024 * 1024)
+                if new_budget != self._push_pool_obj.budget:
+                    self._push_pool_obj.budget = new_budget
 
     # -- hybrid compiled legs (DESIGN §28) ----------------------------------
 
@@ -993,6 +1062,14 @@ class Worker:
         meaningful shift, not per lease."""
         mine = self._dur_ewma.get(ns)
         if mine is None or mine <= 0:
+            return
+        # doc-seeded warmup hold (DESIGN §29): until this worker has
+        # folded at least two OWN observations in a seeded namespace,
+        # its estimate is mostly the doc's own value plus one possibly
+        # compile-inflated sample — pushing it back would echo the
+        # aggregate into itself and amplify the cold-start outlier
+        # fleet-wide
+        if ns in self._ewma_seeded and self._ewma_own_n.get(ns, 0) < 2:
             return
         last = self._ewma_pushed.get(ns)
         if last is not None and abs(mine - last) < 0.1 * last:

@@ -44,7 +44,8 @@ from lua_mapreduce_tpu.parallel.ring_attention import (
     _NEG_INF, _ring_shard, _ring_shard_zigzag, _ulysses_shard,
     _zigzag_check, _zigzag_perm, attention_reference)
 from lua_mapreduce_tpu.train.accum import accum_value_and_grad
-from lua_mapreduce_tpu.utils.jax_compat import shard_map
+from lua_mapreduce_tpu.utils.jax_compat import (shard_map, spec_axes,
+                                                stamp_replicated)
 
 Params = Dict[str, jnp.ndarray]
 
@@ -970,10 +971,32 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer, *,
             return lax.pmean(lax.pmean(local, sp_axis), dp_axis)
 
         if grad_accum == 1:
-            return jax.value_and_grad(global_loss)(params, tokens,
-                                                   targets)
-        return accum_value_and_grad(global_loss, params,
-                                    (tokens, targets), grad_accum)
+            loss, grads = jax.value_and_grad(global_loss)(
+                params, tokens, targets)
+        else:
+            # MoE composes with accum is rejected above, so every leaf
+            # here is replicated over both data axes and the uniform
+            # scan-carry stamp is an identity
+            loss, grads = accum_value_and_grad(
+                global_loss, params, (tokens, targets), grad_accum,
+                stamp=lambda l, g: (
+                    stamp_replicated(l, (dp_axis, sp_axis)),
+                    stamp_replicated(g, (dp_axis, sp_axis))))
+        # per-leaf replication stamp (utils/jax_compat.py): each grad
+        # is replicated over the data axes its out_spec omits (the
+        # transpose machinery psums replicated-param cotangents; MoE
+        # expert grads keep their dp-local slice and stamp over sp
+        # only) — the pmean identity makes that statically inferable
+        # so the rep/vma check stays ON
+
+        def _stamp(k, g):
+            have = spec_axes(_spec_for(k, suffix)) if cfg.moe_experts \
+                else set()
+            return stamp_replicated(
+                g, tuple(a for a in (dp_axis, sp_axis)
+                         if a not in have))
+
+        return loss, {k: _stamp(k, g) for k, g in grads.items()}
 
     def shard_step_zero1(params, opt_state, tokens, targets):
         """The ZeRO-1 body: loss/grad per rank, dp-mean via

@@ -49,7 +49,10 @@ def report(col) -> dict:
             # visible in the default report, not only in raw spans
             "engines": {str(it): eng for it, eng
                         in col.engines_by_iteration().items()},
-            "lowering": col.lowering_decisions()}
+            "lowering": col.lowering_decisions(),
+            # controller decision chain (lmr-autotune, DESIGN §29):
+            # every applied knob change with its evidence payload
+            "autotune": col.autotune_decisions()}
 
 
 def _bar(frac: float, width: int = 32) -> str:
@@ -117,6 +120,11 @@ def render_text(col, top: int) -> str:
                    f"{o['winner']} (losers: "
                    f"{', '.join(o['losers']) or 'none'}; "
                    f"cancelled={o['cancelled']})")
+    for d in rep["autotune"]:
+        out.append(f"autotune: it{d['it']} {d['knob']} "
+                   f"{d.get('old')} -> {d.get('new')} "
+                   f"({d.get('metric')}={d.get('observed')}, "
+                   f"threshold {d.get('threshold')})")
     return "\n".join(out)
 
 

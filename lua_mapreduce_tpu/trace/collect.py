@@ -194,6 +194,25 @@ class TraceCollection:
                 out.append(entry)
         return out
 
+    def autotune_decisions(self) -> List[dict]:
+        """The controller's ``autotune.<knob>`` spans in time order
+        (lmr-autotune, DESIGN §29) — every applied knob change with its
+        evidence payload (observed metric, the threshold that tripped,
+        old→new, direction). This is the explainability contract: a
+        perf knob that moved without an entry here moved OUTSIDE the
+        controller (operator action or a bug), and the stability
+        acceptance (no knob reverses direction more than once per
+        chaos window) is checkable straight off this list."""
+        out = []
+        for s in sorted(self.spans, key=lambda s: (s["t0"], s["t1"])):
+            if s["name"].startswith("autotune."):
+                entry = {"span": s["name"],
+                         "knob": s["name"].split(".", 1)[1],
+                         "it": s.get("it", 0), "t0": s["t0"]}
+                entry.update(s.get("attrs") or {})
+                out.append(entry)
+        return out
+
     def engines_by_iteration(self) -> Dict[int, str]:
         """Which engine actually executed each iteration's data plane:
         ``ingraph`` when the compiled program ran (an ``ingraph.run``

@@ -33,7 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from lua_mapreduce_tpu.parallel import zero1 as _z1
 from lua_mapreduce_tpu.train import checkpoint as ckpt
 from lua_mapreduce_tpu.train.accum import accum_value_and_grad
-from lua_mapreduce_tpu.utils.jax_compat import shard_map
+from lua_mapreduce_tpu.utils.jax_compat import shard_map, stamp_replicated
 
 
 @dataclasses.dataclass
@@ -111,6 +111,7 @@ class DataParallelTrainer:
             return self._build_step_zero1()
         axis, loss_fn, optimizer = self.axis, self.loss_fn, self.optimizer
         accum = self.config.grad_accum
+        mesh_axes = tuple(self.mesh.axis_names)
 
         def step(params, opt_state, x, y):
             def shard_step(params, x, y):
@@ -123,18 +124,29 @@ class DataParallelTrainer:
                     return lax.pmean(loss_fn(p, xm, ym), axis)
 
                 if accum == 1:
-                    return jax.value_and_grad(global_loss)(params, x, y)
-                # microbatch fold: one scan keeps a single microbatch's
-                # activations live at a time (shared implementation,
-                # train/accum.py)
-                return accum_value_and_grad(global_loss, params, (x, y),
-                                            accum)
+                    loss, grads = jax.value_and_grad(global_loss)(
+                        params, x, y)
+                else:
+                    # microbatch fold: one scan keeps a single
+                    # microbatch's activations live at a time (shared
+                    # implementation, train/accum.py); params here are
+                    # replicated over every mesh axis, so the all-axes
+                    # stamp unifying the scan-carry replication types
+                    # is an identity on loss and grads alike
+                    loss, grads = accum_value_and_grad(
+                        global_loss, params, (x, y), accum,
+                        stamp=lambda l, g: (
+                            stamp_replicated(l, mesh_axes),
+                            stamp_replicated(g, mesh_axes)))
+                # the grads ARE dp-replicated (the transpose machinery
+                # psums replicated-param cotangents), but newer JAX's
+                # static checker can't infer it through value_and_grad
+                # — the pmean stamp is a numerical identity that makes
+                # out_specs=P() checkable with the check left ON
+                # (check_vma=False would also disable the auto-psum on
+                # older JAX: silently un-summed grads)
+                return loss, stamp_replicated(grads, (axis,))
 
-            # NB: no check_vma/check_rep override here — on older JAX,
-            # check_rep=False also disables the auto-psum of
-            # replicated-input cotangents this step's grads rely on
-            # (silently un-summed grads); the old checker's rejection of
-            # these out_specs is the loud failure mode we prefer
             loss, grads = shard_map(
                 shard_step, mesh=self.mesh,
                 in_specs=(P(), P(axis), P(axis)), out_specs=(P(), P()),

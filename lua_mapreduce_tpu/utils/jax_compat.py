@@ -39,6 +39,50 @@ def vma_shard_map(f, *args, **kwargs):
     return fn(f, *args, **kwargs)
 
 
+def spec_axes(spec) -> set:
+    """Mesh-axis names a ``PartitionSpec`` shards over (flattening
+    tuple entries); empty for ``P()`` — the replicated spec."""
+    out = set()
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            out.update(entry)
+        else:
+            out.add(entry)
+    return out
+
+
+def stamp_replicated(tree, axes):
+    """Make mathematically-replicated shard_map outputs *statically*
+    replicated for the rep/vma checker (the ``shard_step`` out_specs
+    drift).
+
+    Newer JAX rejects ``out_specs=P()`` for gradients of replicated
+    params at trace time: the transpose machinery still auto-psums the
+    replicated-input cotangents (the values ARE identical across
+    ``axes``), but the static checker cannot infer that through
+    ``value_and_grad``. ``lax.pmean`` over each axis is a numerical
+    identity on an already-replicated value and carries the replication
+    fact the checker needs — so the check stays ON (the loud failure
+    mode the call sites prefer) on every API generation, instead of
+    being disabled with ``check_vma=False`` (which on older JAX also
+    disables the auto-psum itself: silently un-summed grads).
+    """
+    import jax
+    from jax import lax
+    axes = tuple(a for a in axes if a)
+    if not axes:
+        return tree
+
+    def stamp(x):
+        for a in axes:
+            x = lax.pmean(x, a)
+        return x
+
+    return jax.tree.map(stamp, tree)
+
+
 def tpu_compiler_params(**kwargs):
     """``pltpu.CompilerParams`` — renamed from ``TPUCompilerParams``.
 

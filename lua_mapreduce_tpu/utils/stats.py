@@ -85,6 +85,9 @@ COUNTER_FOLD = {
     "hybrid_map_legs": ("hybrid_map_legs",),
     "hybrid_reduce_legs": ("hybrid_reduce_legs",),
     "hybrid_fallbacks": ("hybrid_fallbacks",),
+    "autotune_decisions": ("autotune_decisions",),
+    "autotune_vetoes": ("autotune_vetoes",),
+    "autotune_scale_events": ("autotune_scale_events",),
 }
 _FLOAT_COUNTERS = frozenset({"spec_wasted_s"})
 
@@ -177,6 +180,15 @@ class IterationStats:
     #                        interpreted store plane at trace/run time
     #                        (logged, traced as ``hybrid.fallback``
     #                        spans, never a crash)
+    # autotune controller accounting (DESIGN §29), same fold:
+    #   autotune_decisions    — knob changes the feedback controller
+    #                           applied (each one also an
+    #                           ``autotune.<knob>`` evidence span)
+    #   autotune_vetoes       — changes the evidence warranted but the
+    #                           stability gates (per-knob cooldown /
+    #                           flip lockout) suppressed
+    #   autotune_scale_events — the elastic subset of decisions: fleet
+    #                           grow/retire targets issued
     store_retries: int = 0
     store_faults: int = 0
     infra_releases: int = 0
@@ -198,6 +210,9 @@ class IterationStats:
     hybrid_map_legs: int = 0
     hybrid_reduce_legs: int = 0
     hybrid_fallbacks: int = 0
+    autotune_decisions: int = 0
+    autotune_vetoes: int = 0
+    autotune_scale_events: int = 0
 
     def fold_fault_counters(self, delta: Dict[str, float]
                             ) -> "IterationStats":
@@ -253,6 +268,9 @@ class IterationStats:
             "hybrid_map_legs": self.hybrid_map_legs,
             "hybrid_reduce_legs": self.hybrid_reduce_legs,
             "hybrid_fallbacks": self.hybrid_fallbacks,
+            "autotune_decisions": self.autotune_decisions,
+            "autotune_vetoes": self.autotune_vetoes,
+            "autotune_scale_events": self.autotune_scale_events,
             "cluster_time": self.cluster_time,
             "wall_time": self.wall_time,
         }

@@ -143,4 +143,12 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_hybrid.py -q
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py::test_hybrid_chaos_sigkill_mid_compiled_leg -q
 JAX_PLATFORMS=cpu python benchmarks/ingraph_bench.py --smoke-hybrid
 echo "hybrid smoke: stage legs compiled, split negotiated, chaos held"
+# autotune smoke gate (DESIGN §29): the feedback-controller suite —
+# hysteresis/cooldown/flip-lockout stability under adversarial signal,
+# every decision carrying its autotune.* evidence span, chaos legs
+# byte-identical with the controller on vs off, and the elastic
+# FleetSupervisor growing under flood then retiring to baseline
+# without losing a lease
+JAX_PLATFORMS=cpu python -m pytest tests/test_autotune.py -q
+echo "autotune smoke: knobs stable, decisions evidenced, fleet elastic"
 python -m pytest tests/ -q --full

@@ -644,6 +644,52 @@ def test_lmr007_pure_and_host_side_pass(tmp_path):
     assert got == []
 
 
+# --- LMR018 controller-owned knob bypass (DESIGN §29) -----------------------
+
+def test_lmr018_direct_knob_read_flagged(tmp_path):
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        def lease_width(self, store):
+            task = store.get_task() or {}
+            cap = self.batch_k
+            return max(1, cap)
+
+        def detector(self, task):
+            return self.speculation * task.get("dur_ewma:map", 1.0)
+        """)
+    assert [f.rule for f in got] == ["LMR018", "LMR018"]
+    assert [f.line for f in got] == [3, 7]
+    assert "self.batch_k" in got[0].message
+
+
+def test_lmr018_negotiated_deploy_and_unscoped_pass(tmp_path):
+    got = _lint_snippet(tmp_path, "engine/fx.py", """\
+        def negotiated(self, task):
+            return float(task.get("speculation") or self.speculation)
+
+        def deploy(self, store):
+            task = store.get_task() or {}
+            store.update_task({"batch_k": self.batch_k})
+            return task
+
+        def no_task_in_scope(self):
+            return self.batch_k * 2
+
+        def other_attr(self, task):
+            return self.poll_interval
+        """)
+    assert got == []
+
+
+def test_lmr018_scoped_to_engine(tmp_path):
+    src = """\
+        def lease_width(self, task):
+            return self.batch_k
+        """
+    assert [f.rule for f in _lint_snippet(tmp_path, "engine/fx.py", src)] \
+        == ["LMR018"]
+    assert _lint_snippet(tmp_path, "benchmarks/fx.py", src) == []
+
+
 # --- engine plumbing -------------------------------------------------------
 
 def test_inline_suppression_and_baseline(tmp_path):
@@ -681,7 +727,7 @@ def test_rule_catalog_complete():
     rules = lint_mod.all_rules()
     assert [r.id for r in rules] == \
         [f"LMR00{i}" for i in range(1, 10)] + ["LMR010", "LMR011",
-                                              "LMR012"]
+                                              "LMR012", "LMR018"]
     for r in rules:
         assert r.title and r.rationale and r.severity in ("error", "warning")
 

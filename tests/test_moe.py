@@ -10,7 +10,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from lua_mapreduce_tpu.parallel import moe
 from lua_mapreduce_tpu.parallel.mesh import make_mesh
-from lua_mapreduce_tpu.utils.jax_compat import shard_map
+from lua_mapreduce_tpu.utils.jax_compat import (shard_map, spec_axes,
+                                                stamp_replicated)
 
 D, FF, E, CAP = 16, 32, 8, 4
 
@@ -105,10 +106,17 @@ def test_moe_trains_and_uses_multiple_experts(mesh):
         mse = jnp.mean((out - y) ** 2)
         return jax.lax.pmean(mse, "ep") + 0.01 * aux
 
+    def vag(p, x, y):
+        l, g = jax.value_and_grad(lambda p: body(p, x, y))(p)
+        # replicated-leaf grads (router etc.) ARE psum'd across ep by
+        # the transpose machinery; the pmean stamp makes that
+        # statically checkable (utils/jax_compat.py)
+        return l, {k: stamp_replicated(
+            v, tuple(a for a in ("ep",) if a not in spec_axes(specs[k])))
+            for k, v in g.items()}
+
     grad_fn = jax.jit(shard_map(
-        lambda p, x, y: jax.value_and_grad(
-            lambda p: body(p, x, y))(p),
-        mesh=mesh, in_specs=(specs, P("ep"), P("ep")),
+        vag, mesh=mesh, in_specs=(specs, P("ep"), P("ep")),
         out_specs=(P(), specs)))
 
     opt = optax.adam(1e-2)

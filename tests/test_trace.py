@@ -397,6 +397,13 @@ def test_counter_fold_shared_and_key_sets_identical(tmp_path, monkeypatch):
     fields = {f.name for f in dataclasses.fields(IterationStats)}
     assert set(COUNTER_FOLD) <= fields
     assert set(COUNTER_FOLD) <= local_keys
+    # the lmr-autotune counters ride the same fold (DESIGN §29): drift
+    # between COUNTER_FOLD, the dataclass, and as_dict would silently
+    # drop the controller's restraint/action evidence from the stats
+    for key in ("autotune_decisions", "autotune_vetoes",
+                "autotune_scale_events"):
+        assert key in COUNTER_FOLD
+        assert key in local_keys and key in dist_keys
 
 
 # --- CLI ---------------------------------------------------------------------

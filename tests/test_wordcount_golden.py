@@ -91,6 +91,24 @@ def test_wordcount_matches_naive(tmp_path, config, storage_idx):
     assert stats.wall_time > 0
 
 
+def test_wordcount_autotune_on_and_off_match_naive(tmp_path):
+    """lmr-autotune (DESIGN §29) is semantics-neutral: the adaptive run
+    golden-diffs exactly like the hand-set run, and a controller-off
+    run stays on the legacy path (no controller is ever built)."""
+    golden = naive_wordcount(CORPUS)
+    for autotune in (False, True):
+        spec = TaskSpec(init_args={"files": CORPUS},
+                        storage=f"mem:wc-autotune-{int(autotune)}",
+                        **CONFIGS["combiner"])
+        ex = LocalExecutor(spec, map_parallelism=4, autotune=autotune)
+        ex.run()
+        assert ex.autotune is autotune
+        if not autotune:
+            assert ex._controller is None
+        got = dict(_counts_module("combiner").counts)
+        assert got == golden
+
+
 def test_single_module_init_called_once(tmp_path):
     import examples.wordcount.single as single
     before = single._init_calls
