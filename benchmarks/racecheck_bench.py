@@ -1,0 +1,157 @@
+"""lmr-racecheck bench: the static pass's wall cost and the runtime
+lock-order sanitizer's overhead (DESIGN §30).
+
+Two headline numbers, both contracts the gate depends on:
+
+- ``analyze_conc_wall_s`` — the full-repo concurrency pass (call graph
+  + thread-spawn graph + lockset propagation + order-graph SCCs) must
+  fit the same < 30 s budget as the deep pass, or nobody runs it.
+- ``lockcheck_overhead`` — an LMR_LOCKCHECK=1 wordcount leg against
+  its uninstrumented twin, the paired-rounds median protocol
+  (bench_common): the site-keyed proxy on every package lock must cost
+  <= 1.02x wall with byte-identical outputs, or the cross-validation
+  leg would be too expensive to leave in test.sh.
+
+Artifact: benchmarks/results/racecheck.json.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+RESULTS = os.path.join(REPO, "benchmarks", "results", "racecheck.json")
+
+from benchmarks.bench_common import (leg_order, median,          # noqa: E402
+                                     paired_ratios, result_bytes)
+
+CONFIG = dict(
+    taskfn="examples.wordcount.taskfn",
+    mapfn="examples.wordcount.mapfn",
+    partitionfn="examples.wordcount.partitionfn",
+    reducefn="examples.wordcount.reducefn",
+    combinerfn="examples.wordcount.reducefn",
+    finalfn="examples.wordcount.finalfn",
+)
+
+
+def _leg(files, instrumented: bool) -> dict:
+    """One in-process wordcount run; the instrumented leg wraps every
+    lock the engine creates during the run in the recording proxy.
+    The PIPELINED shuffle path is what makes the comparison honest:
+    its spill-tracker lock (engine/local.py's per-run Lock) is created
+    inside the install window and taken by every map worker and the
+    premerge pool on every spill — the hottest lock the engine has."""
+    from lua_mapreduce_tpu.engine.contract import TaskSpec
+    from lua_mapreduce_tpu.engine.local import LocalExecutor
+    from lua_mapreduce_tpu.utils import lockcheck
+
+    spill = tempfile.mkdtemp(prefix="rcb-spill")
+    spec = TaskSpec(init_args={"files": files},
+                    storage=f"shared:{spill}", **CONFIG)
+    if instrumented:
+        lockcheck.install()
+    t0 = time.perf_counter()
+    try:
+        LocalExecutor(spec, map_parallelism=4, pipeline=True).run()
+    finally:
+        wall = time.perf_counter() - t0
+        if instrumented:
+            lockcheck.uninstall()
+    return {"wall_s": round(wall, 4), "_spill_dir": spill}
+
+
+def run(rounds: int = 5, n_files: int = 0) -> dict:
+    from lua_mapreduce_tpu.analysis import lockset
+    from lua_mapreduce_tpu.utils import lockcheck
+
+    files = sorted(glob.glob(os.path.join(REPO, "lua_mapreduce_tpu",
+                                          "**", "*.py"), recursive=True))
+    if n_files:
+        files = files[:n_files]
+
+    # --- static pass: full-repo wall + surface counts -----------------
+    res = lockset.analyze_conc()
+    tg = res.tgraph
+
+    # --- runtime sanitizer: paired rounds, order alternated -----------
+    lockcheck.reset()
+    legs = {False: [], True: []}
+    identical = True
+    try:
+        for i in range(max(1, rounds)):
+            pair = {}
+            for instrumented in leg_order((False, True), i):
+                pair[instrumented] = _leg(files, instrumented)
+            identical = identical and (
+                result_bytes(pair[False].pop("_spill_dir"))
+                == result_bytes(pair[True].pop("_spill_dir")))
+            legs[False].append(pair[False])
+            legs[True].append(pair[True])
+    finally:
+        for rows in legs.values():
+            for row in rows:
+                shutil.rmtree(row.pop("_spill_dir", ""),
+                              ignore_errors=True)
+    # instrumented-over-baseline wall ratio; paired_ratios returns
+    # base/treat for lower-is-better keys, so invert per round
+    ratios = [1.0 / r for r in paired_ratios(legs[False], legs[True],
+                                             "wall_s")]
+    rep = lockcheck.report()
+    violations = lockcheck.verify(lockset.static_lock_model(res))
+    lockcheck.reset()
+
+    out = {
+        "analyze_conc_wall_s": round(res.wall_s, 3),
+        "analyze_conc_threads": {
+            "spawn_sites": len(tg.spawns),
+            "entries": len(tg.entries),
+            "multi_entries": len(tg.multi_entries)},
+        "analyze_conc_findings": len(res.findings),
+        "analyze_conc_locks": len(res.locks),
+        "lockcheck_overhead": round(median(ratios), 4),
+        "lockcheck_overhead_rounds": [round(r, 4) for r in ratios],
+        "lockcheck_acquisitions": rep["acquisitions"],
+        "lockcheck_sites": len(rep["sites"]),
+        "lockcheck_violations": violations,
+        "identical_output": identical,
+        "baseline_wall_s": [r["wall_s"] for r in legs[False]],
+        "instrumented_wall_s": [r["wall_s"] for r in legs[True]],
+        "corpus_files": len(files),
+        "rounds": rounds,
+    }
+    return out
+
+
+def main(argv) -> int:
+    smoke = "--smoke" in argv
+    result = run(rounds=3 if smoke else 5,
+                 n_files=40 if smoke else 0)
+    print(json.dumps(result, indent=1))
+    ok = (result["identical_output"]
+          and result["analyze_conc_findings"] == 0
+          and result["analyze_conc_wall_s"] < 30.0
+          and not result["lockcheck_violations"]
+          and result["lockcheck_acquisitions"] > 0
+          and result["lockcheck_overhead"] <= 1.02)
+    if not smoke:
+        os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+        with open(RESULTS, "w") as f:
+            json.dump(result, f, indent=1)
+    if not ok:
+        print("racecheck bench FAILED its contracts", file=sys.stderr)
+        return 1
+    print("racecheck bench: conc clean in budget, sanitizer overhead "
+          f"{result['lockcheck_overhead']}x, outputs byte-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

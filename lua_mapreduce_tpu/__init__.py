@@ -104,7 +104,7 @@ def utest():
     from lua_mapreduce_tpu.engine import (contract, ingraph, placement,
                                           premerge, push, server, worker)
     from lua_mapreduce_tpu.store import memfs, router
-    from lua_mapreduce_tpu.utils import stats
+    from lua_mapreduce_tpu.utils import lockcheck, stats
 
     # host-path modules ONLY: the sweep runs in the ambient env (test.sh)
     # where any jax compute would initialize — and hang on — a wedged
@@ -116,6 +116,6 @@ def utest():
     for mod in (tuples, heap, serialize, segment, merge, jobstore, memfs,
                 contract, router, persistent_table, stats, placement,
                 premerge, push, worker, server, ingraph, analysis, faults,
-                trace, sched):
+                trace, sched, lockcheck):
         if hasattr(mod, "utest"):
             mod.utest()

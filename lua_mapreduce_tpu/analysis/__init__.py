@@ -12,6 +12,16 @@ scheduler cooperates.  This subsystem adds *checked invariants*:
   id, a severity, and fixture tests; suppressions are explicit (inline
   ``# lmr: disable=LMR00x`` or the checked-in baseline file).
 
+- :mod:`threads` + :mod:`lockset` — whole-package concurrency analysis
+  on the same call graph (DESIGN §30): the thread-spawn graph says
+  which functions run off the main thread, the interprocedural lockset
+  pass propagates may/must-held locks through every call edge, and the
+  lock-order graph's SCCs surface static deadlocks (LMR026-030).  The
+  runtime lock-order sanitizer (:mod:`..utils.lockcheck`,
+  ``LMR_LOCKCHECK=1``) cross-validates: every acquisition order
+  observed while the chaos suite runs must already be an edge of
+  :func:`lockset.static_lock_model`.
+
 - :mod:`protocol` — a small-scope model checker for the JobStore lease
   lifecycle (claim_batch → heartbeat → commit/release, scavenger
   requeue, worker death at any step): a deterministic virtual-clock
@@ -30,13 +40,18 @@ from lua_mapreduce_tpu.analysis.dataflow import run_deep
 from lua_mapreduce_tpu.analysis.lint import (AuditReport, Finding, all_rules,
                                              format_text, run_audit,
                                              run_lint)
+from lua_mapreduce_tpu.analysis.lockset import (ConcResult, analyze_conc,
+                                                run_conc, static_lock_model)
 from lua_mapreduce_tpu.analysis.protocol import (LeaseModel, ModelConfig,
                                                  check_protocol, replay_trace)
+from lua_mapreduce_tpu.analysis.threads import ThreadGraph, build_thread_graph
 
 __all__ = [
     "Finding", "run_lint", "run_audit", "AuditReport", "all_rules",
     "format_text",
     "CallGraph", "build_callgraph", "run_deep",
+    "ThreadGraph", "build_thread_graph",
+    "ConcResult", "analyze_conc", "run_conc", "static_lock_model",
     "TaskReport", "check_task",
     "ModelConfig", "LeaseModel", "check_protocol", "replay_trace",
     "utest",
@@ -49,18 +64,22 @@ def utest() -> None:
     edge kind; each interprocedural rule re-finds its seeded
     helper-indirection race and the package is deep-clean with no stale
     suppressions; the contract checker classifies its fixtures; the
-    protocol model passes a tiny exhaustive run and re-finds a seeded
-    race."""
+    thread-spawn graph and lockset pass re-find their seeded races and
+    the package is conc-clean; the protocol model passes a tiny
+    exhaustive run and re-finds a seeded race."""
     import os
 
     from lua_mapreduce_tpu.analysis import (callgraph, contracts, dataflow,
-                                            lint, protocol, sarif)
+                                            lint, lockset, protocol, sarif,
+                                            threads)
 
     lint.utest()
     callgraph.utest()
     dataflow.utest()
     contracts.utest()
     sarif.utest()
+    threads.utest()
+    lockset.utest()
     protocol.utest()
 
     pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

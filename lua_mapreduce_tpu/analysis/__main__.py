@@ -1,15 +1,18 @@
 """``lmr-analyze``: the analysis CLI.
 
     python -m lua_mapreduce_tpu.analysis \\
-        [lint|deep|protocol|task|rules|callgraph|all] [options]
+        [lint|deep|conc|protocol|task|rules|callgraph|all] [options]
 
 ``lint`` runs the per-function rule registry over the package (or
 explicit paths); ``deep`` runs the interprocedural pass (call graph +
-context propagation, LMR013+); ``task <module>...`` statically
-validates user task modules (contract + determinism + lowerability
-verdict); ``protocol`` exhaustively model-checks the lease lifecycle;
-``callgraph`` prints the graph's size; ``all`` (the default) runs
-lint + deep + the stale-suppression audit + protocol.
+context propagation, LMR013+); ``conc`` runs the concurrency pass
+(thread-spawn graph + interprocedural locksets + lock-order cycles,
+LMR026-030) and re-finds the seeded races; ``task <module>...``
+statically validates user task modules (contract + determinism +
+lowerability verdict); ``protocol`` exhaustively model-checks the
+lease lifecycle; ``callgraph`` prints the graph's size; ``all`` (the
+default) runs lint + deep + conc + the stale-suppression audit +
+protocol.
 
 Exit code 0 = clean; with ``--fail-on-findings`` any surviving finding
 exits 1 (the CI gate); ``--fail-on-stale`` exits 1 when a suppression
@@ -18,7 +21,8 @@ of the shipped model, an unresolvable/invalid task module, or a task
 verdict differing from ``--expect`` always exits 1.
 
 ``--format json`` emits one machine-readable payload; ``--format
-sarif`` (lint/deep/task) emits SARIF 2.1.0 for CI/editor annotation.
+sarif`` (lint/deep/conc/task) emits SARIF 2.1.0 for CI/editor
+annotation.
 """
 
 from __future__ import annotations
@@ -153,6 +157,28 @@ def _protocol_suite(args):
     return {"protocol": out}, failed
 
 
+def _cmd_conc(args) -> tuple:
+    """The concurrency pass plus the seeded-race pins: every race in
+    KNOWN_RACES must be re-found on its fixture (the protocol checker's
+    discipline — a pass that stops seeing a planted race has quietly
+    lost its teeth, and the gate must say so, not stay green)."""
+    from lua_mapreduce_tpu.analysis import lockset as lockset_mod
+    res = lockset_mod.analyze_conc(args.paths or None,
+                                   baseline=args.baseline)
+    fail = bool(res.findings) and args.fail_on_findings
+    seeded = []
+    for name, (_rel, rule, _src) in sorted(lockset_mod.KNOWN_RACES.items()):
+        hits = lockset_mod.find_seeded(name)
+        entry = {"run": f"seeded:{name}", "rule": rule,
+                 "found": bool(hits)}
+        if not hits:
+            entry["error"] = ("seeded race NOT re-found — the conc "
+                              "pass lost its teeth")
+            fail = True
+        seeded.append(entry)
+    return res, seeded, fail
+
+
 def _cmd_task(args) -> tuple:
     """Check every task-module spec; the payload carries one report per
     spec. Fails on findings (always — an invalid task module is never a
@@ -196,8 +222,8 @@ def main(argv=None) -> int:
         description="framework-aware lint, interprocedural deep pass, "
                     "task-contract checker + lease-protocol model checker")
     ap.add_argument("command", nargs="?", default="all",
-                    choices=("all", "lint", "deep", "protocol", "rules",
-                             "task", "callgraph"))
+                    choices=("all", "lint", "deep", "conc", "protocol",
+                             "rules", "task", "callgraph"))
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/dirs to lint, or task-module specs for "
                          "the task command (default: the package)")
@@ -236,8 +262,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.format == "sarif" and args.command not in ("lint", "deep",
-                                                       "task"):
-        ap.error("--format sarif applies to lint/deep/task only")
+                                                       "conc", "task"):
+        ap.error("--format sarif applies to lint/deep/conc/task only")
     if args.fail_on_stale and args.command != "all":
         # only `all` runs the suppression audit — a scoped lint/deep
         # pass cannot tell live pragmas from stale ones, so honoring
@@ -317,6 +343,19 @@ def main(argv=None) -> int:
                                 "reached": res.reached,
                                 "wall_s": round(res.wall_s, 3)}
         rc = max(rc, 1 if findings and args.fail_on_findings else 0)
+    if args.command == "conc":
+        res, seeded, fail = _cmd_conc(args)
+        findings = res.findings
+        payload.update(lint_mod.report_dict(findings))
+        payload["conc"] = {
+            "locks": len(res.locks),
+            "spawn_sites": len(res.tgraph.spawns),
+            "thread_entries": len(res.tgraph.entries),
+            "order_edges": len(res.order_edges),
+            "cycles": [sorted(c) for c in res.cycles],
+            "wall_s": round(res.wall_s, 3),
+            "seeded": seeded}
+        rc = max(rc, 1 if fail else 0)
     if args.command == "all":
         # one combined pass: per-function + deep findings with shared
         # suppression, plus the stale audit over both
@@ -352,9 +391,18 @@ def main(argv=None) -> int:
     if findings is not None:
         if findings:
             print(lint_mod.format_text(findings))
-        label = {"lint": "lint", "deep": "deep"}.get(args.command,
-                                                     "lint+deep")
+        label = {"lint": "lint", "deep": "deep",
+                 "conc": "conc"}.get(args.command, "lint+deep+conc")
         print(f"{label}: {len(findings)} finding(s)")
+    if "conc" in payload:
+        c = payload["conc"]
+        print(f"conc: {c['locks']} locks, {c['spawn_sites']} spawn "
+              f"sites, {c['thread_entries']} thread entries, "
+              f"{c['order_edges']} order edges, {len(c['cycles'])} "
+              f"cycles, {c['wall_s']}s")
+        for e in c["seeded"]:
+            status = f"re-found {e['rule']}" if e["found"] else "MISSED"
+            print(f"conc {e['run']}: {status}")
     if "callgraph" in payload:
         cg = payload["callgraph"]
         print(f"callgraph: {cg['nodes']} nodes, {cg['edges']} edges, "

@@ -287,10 +287,11 @@ def run_audit(paths: Optional[Sequence[str]] = None,
     if deep:
         # lazy imports: dataflow imports this module. The deep pass
         # reuses the sources just read — one file walk, one parse set
-        from lua_mapreduce_tpu.analysis import dataflow
+        from lua_mapreduce_tpu.analysis import dataflow, lockset
         from lua_mapreduce_tpu.analysis.callgraph import CallGraph
         graph = CallGraph.from_sources(sources)
         raw = raw + dataflow.analyze(baseline=baseline, graph=graph).raw
+        raw = raw + lockset.analyze_conc(baseline=baseline, graph=graph).raw
     used_pragmas = set()
     used_baseline = set()
     out: List[Finding] = []
@@ -330,14 +331,15 @@ def format_json(findings: Sequence[Finding]) -> str:
 
 def rule_catalog() -> List[Dict[str, str]]:
     """Every rule id the analyzer can emit: the per-function registry,
-    the interprocedural (deep) rules, and the task-contract rules — one
-    catalog, id order (DESIGN §25)."""
-    from lua_mapreduce_tpu.analysis import contracts, dataflow  # lazy
+    the interprocedural (deep) rules, the task-contract rules, and the
+    concurrency (conc) band — one catalog, id order (DESIGN §25)."""
+    from lua_mapreduce_tpu.analysis import contracts, dataflow, lockset
     out = [{"id": r.id, "severity": r.severity, "title": r.title,
             "rationale": r.rationale,
             "paths": list(r.paths) or ["<all>"]} for r in all_rules()]
     out.extend(dataflow.deep_rule_catalog())
     out.extend(contracts.contract_rule_catalog())
+    out.extend(lockset.conc_rule_catalog())
     out.sort(key=lambda r: r["id"])
     return out
 

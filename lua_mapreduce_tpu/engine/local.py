@@ -460,11 +460,15 @@ class LocalExecutor:
                         segment_format=self.segment_format,
                         replication=self.replication))
             except Exception as e:
+                # probe the store BEFORE taking the tracker lock: the
+                # exists() round-trip is storage IO, and holding the
+                # shared pipeline lock across it would convoy every
+                # committing map thread behind one slow backend
+                spill_exists = self._view.exists(sp.name)
                 with lock:
                     pre_failed[0] += 1
-                    tracker.spill_failed(
-                        sp.part, sp.seq,
-                        spill_exists=self._view.exists(sp.name))
+                    tracker.spill_failed(sp.part, sp.seq,
+                                         spill_exists=spill_exists)
                 print(f"[local] pre_merge {sp.name} failed; reduce falls "
                       f"back to raw runs: {type(e).__name__}: {e}",
                       file=sys.stderr)

@@ -189,9 +189,22 @@ class BufferPool:
     coordination."""
 
     def __init__(self, budget_bytes: int):
-        self.budget = int(budget_bytes)
-        self._held = 0
         self._lock = threading.Lock()
+        self._budget = int(budget_bytes)
+        self._held = 0
+
+    @property
+    def budget(self) -> int:
+        with self._lock:
+            return self._budget
+
+    @budget.setter
+    def budget(self, budget_bytes: int) -> None:
+        # the autotune plane retargets a live pool from the worker /
+        # executor thread while map threads consult over(): the ledger
+        # lock serializes the handoff
+        with self._lock:
+            self._budget = int(budget_bytes)
 
     def charge(self, n: int) -> None:
         with self._lock:
@@ -208,7 +221,7 @@ class BufferPool:
 
     def over(self) -> bool:
         with self._lock:
-            return self._held > self.budget
+            return self._held > self._budget
 
 
 class _PartState:

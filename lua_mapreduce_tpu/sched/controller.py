@@ -479,14 +479,28 @@ class FleetSupervisor:
     def resize(self, target: int) -> int:
         """Grow/shrink toward ``target`` (clamped to [baseline, cap]);
         returns the new size. Shrinks retire the NEWEST members first —
-        the baseline crew keeps its warm caches and its affinity map."""
+        the baseline crew keeps its warm caches and its affinity map.
+
+        ``spawn``/``retire`` are caller-injected and may block (mint a
+        thread, fork a process, RPC a scheduler) or re-enter this
+        supervisor — so both run OUTSIDE the lock: each growth step
+        reserves its seq under the lock, spawns unlocked, then appends
+        under the lock. Concurrent resizers interleave safely: the
+        re-check per iteration keeps the fleet at the LAST target, and
+        a member is either in ``members`` or still owned by its
+        spawning frame — never both, never neither."""
         target = max(self.baseline, min(self.cap, int(target)))
-        with self._lock:
-            while len(self.members) < target:
-                m = self.spawn(self._seq)
+        while True:
+            with self._lock:
+                if len(self.members) >= target:
+                    break
+                seq = self._seq
                 self._seq += 1
+            m = self.spawn(seq)
+            with self._lock:
                 self.members.append(m)
-            surplus = []
+        surplus = []
+        with self._lock:
             while len(self.members) > target:
                 surplus.append(self.members.pop())
         for m in surplus:

@@ -96,6 +96,18 @@ echo "coded smoke: degraded stripes decode inline, zero re-runs"
 # (or --format sarif on lint/deep/task for CI annotation).
 python -m lua_mapreduce_tpu.analysis --fail-on-findings --fail-on-stale
 echo "lmr-analyze: lint+deep clean, no stale suppressions, protocol model-checked"
+# lmr-racecheck gate (DESIGN §30): the concurrency band — thread-spawn
+# graph + interprocedural locksets + the lock-order cycle scan
+# (LMR026-030) — must be clean over the full repo inside its 30 s wall
+# budget with both seeded races (dropped-lock write, ABBA deadlock)
+# re-found; then the runtime cross-validation leg: the chaos smoke
+# re-runs under LMR_LOCKCHECK=1 with every package Lock/RLock wrapped
+# in the site-keyed order recorder — an acquisition order the static
+# model lacks fails the session, and the chaos suite's own golden
+# diffs prove the instrumented run stays byte-identical
+python -m lua_mapreduce_tpu.analysis conc --fail-on-findings
+LMR_LOCKCHECK=1 python -m pytest tests/test_chaos.py -q -k "smoke"
+echo "lmr-racecheck: conc band clean, seeded races re-found, runtime lock orders all modeled"
 # task-contract gate (DESIGN §25): every shipped task module must
 # statically validate — plugin signatures, emit arity, determinism
 # hazards — and classify to its pinned lowerability verdict: the

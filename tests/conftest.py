@@ -24,6 +24,58 @@ else:
     jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    """LMR_LOCKCHECK=1: install the runtime lock-order sanitizer before
+    test modules import the package, so module-level locks (tracer,
+    native-build cache, ...) are created through the recording
+    factories.  The session fails in pytest_sessionfinish if any
+    observed acquisition order is absent from the static lock model."""
+    if os.environ.get("LMR_LOCKCHECK") == "1":
+        from lua_mapreduce_tpu.utils import lockcheck
+        lockcheck.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if os.environ.get("LMR_LOCKCHECK") != "1":
+        return
+    from lua_mapreduce_tpu.utils import lockcheck
+    from lua_mapreduce_tpu.analysis.lockset import static_lock_model
+    lockcheck.uninstall()   # stop recording before the analyzer runs
+    rep = lockcheck.report()
+    violations = lockcheck.verify(static_lock_model())
+    print(f"\n[lockcheck] {rep['acquisitions']} acquisitions across "
+          f"{len(rep['sites'])} lock sites, "
+          f"{len(rep['edges'])} distinct order edges")
+    if violations:
+        for v in violations:
+            print(f"[lockcheck] VIOLATION: {v}")
+        session.exitstatus = 1
+
+
+@pytest.fixture
+def no_thread_leak():
+    """Asserts no non-daemon thread outlives the test body — the
+    dynamic half of the thread-shutdown audit (the static half is
+    analysis.threads.shutdown_report).  A short grace window lets
+    executor/pool teardown stragglers finish their last poll."""
+    import threading
+    import time
+
+    before = set(threading.enumerate())
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t not in before and t.is_alive() and not t.daemon]
+
+    yield
+    deadline = time.monotonic() + 5.0
+    while leaked() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not leaked(), (
+        f"non-daemon threads leaked past teardown: "
+        f"{[t.name for t in leaked()]}")
+
+
 def pytest_addoption(parser):
     parser.addoption(
         "--full", action="store_true", default=False,

@@ -1,0 +1,583 @@
+"""lmr-racecheck tests (DESIGN §30): the thread-spawn graph, the
+interprocedural lockset/lock-order pass (LMR026-030) with fixture
+pairs, the seeded-race pins, the runtime lock-order sanitizer, the
+thread-shutdown audit, the conc CLI/SARIF surface, the whole-repo
+cleanliness + wall-budget gates, and regressions for the three at-head
+races this band found and fixed (BufferPool.budget, FleetSupervisor.
+resize, the pipelined premerge exists-under-lock)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from lua_mapreduce_tpu.analysis import lockset, sarif
+from lua_mapreduce_tpu.analysis import threads as threads_mod
+from lua_mapreduce_tpu.analysis.callgraph import CallGraph, build_callgraph
+from lua_mapreduce_tpu.utils import lockcheck
+
+PKG = os.path.dirname(os.path.abspath(lockset.__file__))
+REPO = os.path.dirname(os.path.dirname(PKG))
+
+
+def _conc(*files):
+    g = CallGraph.from_sources(
+        [(rel, textwrap.dedent(src)) for rel, src in files])
+    return lockset.analyze_conc(graph=g, baseline="/nonexistent")
+
+
+def _rules(res):
+    return [f.rule for f in res.findings]
+
+
+# --- thread-spawn graph -----------------------------------------------------
+
+SPAWNY = ("engine/fx.py", """\
+    import threading
+
+    class Worker:
+        def configure(self):
+            return self
+
+        def execute(self):
+            self.state = 1
+
+    def mint():
+        w = Worker()
+        return w
+
+    def spawn_fluent():
+        w = Worker().configure()
+        threading.Thread(target=w.execute, daemon=True).start()
+
+    def spawn_factory():
+        w = mint()
+        threading.Thread(target=w.execute, daemon=True).start()
+    """)
+
+
+def test_thread_graph_resolves_fluent_builder_and_factory_targets():
+    """The two spawn shapes the real CLIs use: a fluent-builder chain
+    (``Worker(store).configure(...)``) and a local mint() factory.
+    Losing either makes Worker.execute look main-thread-only and
+    silences every contested-ness-gated rule downstream."""
+    g = CallGraph.from_sources([(SPAWNY[0], textwrap.dedent(SPAWNY[1]))])
+    tg = threads_mod.build_thread_graph(g)
+    entries = {s.entry for s in tg.spawns}
+    assert entries == {"engine/fx.py::Worker.execute"}
+    # two distinct spawn sites -> the entry races itself
+    assert "engine/fx.py::Worker.execute" in tg.multi_entries
+    assert tg.contested(["engine/fx.py::Worker.execute"])
+
+
+def test_thread_graph_roots_separate_thread_code_from_main():
+    g = CallGraph.from_sources([("engine/fx.py", textwrap.dedent("""\
+        import threading
+
+        class W:
+            def go(self):
+                threading.Thread(target=self.loop, daemon=True).start()
+                self.prep()
+
+            def loop(self):
+                self.tick()
+
+            def tick(self):
+                pass
+
+            def prep(self):
+                pass
+        """))])
+    tg = threads_mod.build_thread_graph(g)
+    assert tg.roots_of("engine/fx.py::W.tick") == {"engine/fx.py::W.loop"}
+    assert "main" in tg.roots_of("engine/fx.py::W.prep")
+
+
+# --- LMR026: dropped-lock write ---------------------------------------------
+
+def test_lmr026_unguarded_write_to_guarded_field_fires():
+    res = _conc(("engine/fx.py", """\
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def start(self):
+                threading.Thread(target=self.add, daemon=True).start()
+
+            def add(self):
+                with self._lock:
+                    self.total += 1
+
+            def drain(self):
+                out = self.total
+                self.total = 0
+                return out
+        """))
+    assert "LMR026" in _rules(res), res.findings
+    assert any(f.line == 17 for f in res.findings)   # the naked write
+
+
+def test_lmr026_quiet_when_every_access_is_guarded():
+    res = _conc(("engine/fx.py", """\
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def start(self):
+                threading.Thread(target=self.add, daemon=True).start()
+
+            def add(self):
+                with self._lock:
+                    self.total += 1
+
+            def drain(self):
+                with self._lock:
+                    out = self.total
+                    self.total = 0
+                return out
+        """))
+    assert _rules(res) == [], res.findings
+
+
+def test_lmr026_quiet_without_thread_contestation():
+    """Same dropped guard, no second thread root: single-threaded code
+    gets to be sloppy — the band only polices actually-shared state."""
+    res = _conc(("engine/fx.py", """\
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def add(self):
+                with self._lock:
+                    self.total += 1
+
+            def drain(self):
+                self.total = 0
+        """))
+    assert _rules(res) == [], res.findings
+
+
+# --- LMR027: inconsistent locksets ------------------------------------------
+
+SPLIT_GUARD = """\
+    import threading
+
+    class Split:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+            self.v = 0
+
+        def start(self):
+            threading.Thread(target=self.inc, daemon=True).start()
+
+        def inc(self):
+            with self._a_lock:
+                self.v += 1
+
+        def dec(self):
+            with self._b_lock:
+                self.v -= 1
+    """
+
+
+def test_lmr027_disjoint_guards_exclude_nothing():
+    res = _conc(("engine/fx.py", SPLIT_GUARD))
+    assert "LMR027" in _rules(res), res.findings
+
+
+def test_lmr027_quiet_with_one_consistent_guard():
+    res = _conc(("engine/fx.py", SPLIT_GUARD.replace("self._b_lock:",
+                                                     "self._a_lock:")))
+    assert "LMR027" not in _rules(res), res.findings
+
+
+# --- LMR028: lock-order cycles + re-acquisition -----------------------------
+
+ABBA = """\
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def start(self):
+            threading.Thread(target=self.ab, daemon=True).start()
+
+        def ab(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def ba(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """
+
+
+def test_lmr028_abba_cycle_fires_and_consistent_order_is_quiet():
+    res = _conc(("engine/fx.py", ABBA))
+    assert "LMR028" in _rules(res), res.findings
+    assert res.cycles, "the SCC must be reported, not just the finding"
+    fixed = ABBA.replace(
+        "with self._b_lock:\n                with self._a_lock:",
+        "with self._a_lock:\n                with self._b_lock:")
+    res = _conc(("engine/fx.py", fixed))
+    assert _rules(res) == [] and not res.cycles, res.findings
+
+
+def test_lmr028_interprocedural_reacquire_of_module_lock():
+    """outer() holds the module Lock and calls inner() which takes it
+    again — self-deadlock on a non-reentrant lock that no single
+    function shows. An RLock makes the same shape legal."""
+    src = """\
+        import threading
+        _lock = threading.Lock()
+
+        def outer():
+            with _lock:
+                inner()
+
+        def inner():
+            with _lock:
+                pass
+        """
+    res = _conc(("engine/fx.py", src))
+    assert _rules(res) == ["LMR028"], res.findings
+    res = _conc(("engine/fx.py",
+                 src.replace("threading.Lock()", "threading.RLock()")))
+    assert _rules(res) == [], res.findings
+
+
+# --- LMR029: blocking while holding a lock ----------------------------------
+
+def test_lmr029_sleep_under_lock_fires_and_outside_is_quiet():
+    src = """\
+        import threading
+        import time
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def tick(self):
+                with self._lock:
+                    time.sleep(1)
+        """
+    res = _conc(("engine/fx.py", src))
+    assert _rules(res) == ["LMR029"], res.findings
+    res = _conc(("engine/fx.py", src.replace(
+        "with self._lock:\n                    time.sleep(1)",
+        "with self._lock:\n                    pass\n"
+        "                time.sleep(1)")))
+    assert _rules(res) == [], res.findings
+
+
+def test_lmr029_blocking_call_three_frames_below_the_lock():
+    """The reason this band is interprocedural: the lock and the sleep
+    are in different functions, so the per-function pass is blind —
+    only may-held propagation connects them."""
+    res = _conc(("engine/fx.py", """\
+        import threading
+        import time
+
+        class Deep:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def top(self):
+                with self._lock:
+                    self.mid()
+
+            def mid(self):
+                self.low()
+
+            def low(self):
+                time.sleep(1)
+        """))
+    assert [(f.rule, f.line) for f in res.findings] == [("LMR029", 16)], \
+        res.findings
+    assert "via engine/fx.py::Deep.mid" in res.findings[0].message, \
+        res.findings[0].message      # the held-by-caller witness chain
+
+
+# --- LMR030: cross-thread publish without hand-off --------------------------
+
+PUBLISH = """\
+    import threading
+
+    def collect():
+        out = []
+
+        def work():
+            out.append(1)
+
+        t = threading.Thread(target=work)
+        t.start()
+        return len(out)
+    """
+
+
+def test_lmr030_read_after_spawn_without_join_fires():
+    res = _conc(("engine/fx.py", PUBLISH))
+    assert "LMR030" in _rules(res), res.findings
+
+
+def test_lmr030_join_before_read_is_a_proper_handoff():
+    res = _conc(("engine/fx.py", PUBLISH.replace(
+        "t.start()", "t.start()\n    t.join()")))
+    assert _rules(res) == [], res.findings
+
+
+# --- suppression + catalog + seeded pins ------------------------------------
+
+def test_conc_findings_honor_inline_pragmas():
+    rel, rule, src = lockset.KNOWN_RACES["dropped-lock-write"]
+    lines = src.splitlines()
+    # the seeded fixture's naked write gets an explicit excuse
+    lines[12] += "  # lmr: disable=LMR026"
+    g = CallGraph.from_sources([(rel, "\n".join(lines) + "\n")])
+    res = lockset.analyze_conc(graph=g, baseline="/nonexistent")
+    assert "LMR026" not in _rules(res), res.findings
+    assert any(f.rule == "LMR026" for f in res.raw)   # raw keeps it
+
+
+def test_rule_catalog_includes_the_conc_band():
+    from lua_mapreduce_tpu.analysis.lint import rule_catalog
+    ids = {r["id"] for r in rule_catalog()}
+    assert {"LMR026", "LMR027", "LMR028", "LMR029", "LMR030"} <= ids
+
+
+@pytest.mark.parametrize("name", sorted(lockset.KNOWN_RACES))
+def test_seeded_race_is_refound(name):
+    """The protocol checker's discipline on the lock plane: every race
+    seeded into KNOWN_RACES must keep being found, forever — a pass
+    that stops seeing a planted race has quietly lost its teeth."""
+    hits = lockset.find_seeded(name)
+    expected = lockset.KNOWN_RACES[name][1]
+    assert hits and all(f.rule == expected for f in hits), (name, hits)
+
+
+# --- whole-repo gates -------------------------------------------------------
+
+def test_repo_is_conc_clean_within_the_wall_budget():
+    res = lockset.analyze_conc()
+    assert res.findings == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in res.findings)
+    assert not res.cycles
+    assert res.wall_s < 30.0, res.wall_s
+
+
+def test_repo_thread_shutdown_audit():
+    """Every Thread the package ever spawns is either daemon (dies with
+    the process) or joined by its owning module — no thread can outlive
+    its executor un-stopped. The dynamic twin is the no_thread_leak
+    fixture on the golden matrix."""
+    tg = threads_mod.build_thread_graph(build_callgraph(None))
+    report = threads_mod.shutdown_report(tg)
+    assert report, "the package does spawn threads; an empty report " \
+                   "means the spawn scan broke"
+    bad = [e for e in report if not (e["daemon"] or e["module_joins"])]
+    assert bad == [], bad
+
+
+def test_static_lock_model_matches_source_sites():
+    """Every modeled creation site must point at an actual
+    threading.Lock()/RLock() call in the file it names — the runtime
+    sanitizer keys on exactly these (rel, line) pairs, so a drifted
+    line number would fail the LMR_LOCKCHECK gate spuriously."""
+    model = lockset.static_lock_model()
+    assert model["locks"] and not model["cyclic"]
+    for site in model["locks"]:
+        rel, _, line = site.rpartition(":")
+        src_line = open(os.path.join(PKG, "..", rel)).read() \
+            .splitlines()[int(line) - 1]
+        assert "Lock(" in src_line, (site, src_line)
+
+
+# --- runtime lock-order sanitizer -------------------------------------------
+
+def test_lockcheck_utest():
+    lockcheck.utest()
+
+
+def test_lockcheck_records_and_verifies_nested_order():
+    now = [0.0]
+    lockcheck.install(clock=lambda: now[0])
+    try:
+        lockcheck.reset()
+        # created from test code (outside the package): raw, invisible
+        raw = threading.Lock()
+        assert type(raw) is type(threading.RLock()) or \
+            not isinstance(raw, lockcheck._LockProxy)
+        assert lockcheck.report()["sites"] == []
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+
+
+def test_lockcheck_overhead_is_negligible_when_uninstalled():
+    """LMR_LOCKCHECK unset = the factories are the raw builtins; the
+    watchdog must cost exactly nothing when off."""
+    assert threading.Lock is lockcheck._real_lock
+    assert threading.RLock is lockcheck._real_rlock
+
+
+# --- conc CLI surface -------------------------------------------------------
+
+def _cli(*argv):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "lua_mapreduce_tpu.analysis", *argv],
+        capture_output=True, text=True, env=env, cwd=REPO)
+
+
+def test_cli_conc_gate_is_green_and_pins_the_seeded_races():
+    r = _cli("conc", "--fail-on-findings", "--format", "json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["count"] == 0
+    conc = payload["conc"]
+    assert conc["locks"] >= 20 and conc["spawn_sites"] >= 8
+    assert conc["cycles"] == []
+    assert conc["wall_s"] < 30.0
+    seeded = {e["run"]: e["found"] for e in conc["seeded"]}
+    assert seeded == {"seeded:dropped-lock-write": True,
+                      "seeded:abba-deadlock": True}
+
+
+def test_cli_conc_fails_on_a_raced_fixture_and_exports_sarif(tmp_path):
+    p = tmp_path / "engine" / "fx.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent(ABBA))
+    r = _cli("conc", str(tmp_path), "--fail-on-findings",
+             "--baseline", "/nonexistent")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "LMR028" in r.stdout
+    r = _cli("conc", str(tmp_path), "--format", "sarif",
+             "--baseline", "/nonexistent")
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    sarif.validate_sarif(doc)
+    assert any(res["ruleId"] == "LMR028"
+               for res in doc["runs"][0]["results"])
+
+
+# --- regressions for the three at-head fixes --------------------------------
+
+def test_bufferpool_budget_is_a_locked_property():
+    """At-head LMR026: worker.py's autotune apply and local.py's spill
+    sizing both assign ``pool.budget`` from other threads while
+    charge() reads it under the pool lock. The fix routes the public
+    attribute through a locked property; hammer it to prove the
+    property holds under contention."""
+    from lua_mapreduce_tpu.engine.push import BufferPool
+    assert isinstance(BufferPool.budget, property)
+    pool = BufferPool(1 << 20)
+    stop = threading.Event()
+
+    def churn():
+        while not stop.is_set():
+            pool.budget = pool.budget + 1
+
+    threads = [threading.Thread(target=churn) for _ in range(3)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 0.5
+    while time.monotonic() < deadline:
+        assert pool.budget >= (1 << 20)
+        pool.charge(64)
+        pool.uncharge(64)
+    stop.set()
+    for t in threads:
+        t.join(5.0)
+    assert not any(t.is_alive() for t in threads)
+    assert pool.held == 0
+
+
+def test_fleet_resize_runs_spawn_and_retire_outside_the_lock():
+    """At-head LMR029: resize used to call the injected spawn/retire
+    callbacks while holding the supervisor lock — a callback touching
+    the supervisor (here: reading .size, as a real minting hook
+    logging fleet state would) deadlocked. Now it must complete."""
+    from lua_mapreduce_tpu.sched.controller import FleetSupervisor
+    sizes = []
+    sup = FleetSupervisor(
+        spawn=lambda seq: sizes.append(sup.size) or f"w{seq}",
+        retire=lambda m: sizes.append(sup.size),
+        baseline=1, cap=8)
+    done = []
+
+    def run():
+        sup.resize(5)
+        sup.resize(2)
+        done.append(True)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(10.0)
+    assert done, "resize deadlocked on a re-entrant spawn/retire hook"
+    assert sup.size == 2
+    assert len(sizes) == 5 + 3   # 5 spawns up, 3 retires down
+
+
+def test_fleet_concurrent_resize_converges():
+    from lua_mapreduce_tpu.sched.controller import FleetSupervisor
+    sup = FleetSupervisor(spawn=lambda seq: f"w{seq}",
+                          retire=lambda m: None, baseline=1, cap=16)
+    ts = [threading.Thread(target=sup.resize, args=(n,))
+          for n in (4, 9, 16, 2, 7)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10.0)
+    assert 1 <= sup.size <= 16
+    assert len(sup.members) == len(set(sup.members))   # no double-adds
+
+
+def test_premerge_failure_probes_store_outside_the_tracker_lock():
+    """At-head LMR029: the pipelined premerge failure path used to call
+    ``self._view.exists()`` (store IO) while holding the spill-tracker
+    lock, convoying every map worker behind one slow store probe. Pin
+    the fixed shape statically: the fixture twin of the OLD shape still
+    fires, and the real engine/local.py is clean (covered by the
+    whole-repo gate above)."""
+    res = _conc(("engine/fx.py", """\
+        import threading
+
+        class View:
+            def exists(self, name):
+                return True
+
+        class Pipeline:
+            def __init__(self):
+                self._view = View()
+                self._lock = threading.Lock()
+                self.failed = 0
+
+            def start(self):
+                threading.Thread(target=self.premerge,
+                                 daemon=True).start()
+
+            def premerge(self):
+                with self._lock:
+                    self.failed += 1
+                    self._view.exists("sp")
+        """))
+    assert "LMR029" in _rules(res), res.findings

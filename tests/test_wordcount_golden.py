@@ -73,7 +73,8 @@ def _counts_module(config):
 @pytest.mark.parametrize("config", list(CONFIGS))
 @pytest.mark.parametrize("storage_idx", [0, 1, 2],
                          ids=["mem", "shared", "object"])
-def test_wordcount_matches_naive(tmp_path, config, storage_idx):
+def test_wordcount_matches_naive(tmp_path, config, storage_idx,
+                                 no_thread_leak):
     golden = naive_wordcount(CORPUS)
     storage = _storages(tmp_path, f"wc-{config}-{storage_idx}")[storage_idx]
     spec = TaskSpec(init_args={"files": CORPUS}, storage=storage,
@@ -91,7 +92,8 @@ def test_wordcount_matches_naive(tmp_path, config, storage_idx):
     assert stats.wall_time > 0
 
 
-def test_wordcount_autotune_on_and_off_match_naive(tmp_path):
+def test_wordcount_autotune_on_and_off_match_naive(tmp_path,
+                                                   no_thread_leak):
     """lmr-autotune (DESIGN §29) is semantics-neutral: the adaptive run
     golden-diffs exactly like the hand-set run, and a controller-off
     run stays on the legacy path (no controller is ever built)."""
