@@ -565,6 +565,40 @@ def _ingraph_fields() -> dict:
     return out
 
 
+def _ha_fields() -> dict:
+    """Detail fields for the HA coordinator plane (DESIGN §31): a live
+    one-round fencing pair + one crash-to-takeover clocking from
+    benchmarks/ha_bench (leader lease election, epoch-fenced mutations,
+    hot-standby takeover on the threaded-state loop task), then the
+    committed artifact's medians — fencing overhead (≤1.02 bar) and
+    takeover latency against its 2×TTL budget. Falls back to the
+    committed artifact — labeled as such — if the live run cannot
+    complete; never sinks the flagship metric."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = {}
+    try:
+        from benchmarks.ha_bench import run as ha_run
+        r = ha_run(rounds=1, n_iters=6, takeover_rounds=1)
+        out = {
+            "ha_fencing_overhead_live_1round": r["ha_fencing_overhead"],
+            "ha_takeover_ms_live_1round": r["ha_takeover_ms"],
+            "ha_identical_output": r["ha_identical_output"],
+        }
+    except Exception as e:
+        out = {"ha_bench_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        with open(os.path.join(here, "benchmarks", "results",
+                               "ha.json")) as f:
+            art = json.load(f)
+        out["ha_fencing_overhead"] = art["ha_fencing_overhead"]
+        out["ha_takeover_ms"] = art["ha_takeover_ms"]
+        out["ha_takeover_budget_ms"] = art["ha_takeover_budget_ms"]
+    except Exception:
+        pass
+    return out
+
+
 def _committed_tpu_tail() -> dict:
     """VERDICT r4 item 8: when the live run falls back to CPU (wedged
     tunnel), the driver-captured JSON must still TRANSPORT the newest
@@ -691,6 +725,10 @@ def main() -> None:
         # speedup + one-time compile cost
         # (benchmarks/ingraph_bench.py; DESIGN §26)
         **_ingraph_fields(),
+        # lmr-ha: leader-lease fencing overhead (≤1.02 bar) + hot-
+        # standby crash-to-takeover latency vs its 2×TTL budget
+        # (benchmarks/ha_bench.py; DESIGN §31)
+        **_ha_fields(),
     }
     if on_tpu and "lm_train_mfu" in lm:
         # VERDICT r4 weak-1: the first number a reader (or the driver

@@ -1,0 +1,109 @@
+"""An iterative "loop"-protocol task with THREADED MODULE STATE, the
+save_state/restore_state checkpoint demo (docs/DESIGN.md §31).
+
+The reference's iterative examples (SURVEY.md §3.5) thread state between
+MapReduce iterations OUTSIDE the store: finalfn folds the iteration's
+reduce results into module globals, and the next taskfn reads them back.
+That state lives only in the coordinator process — a crash (or an HA
+leader takeover) between iterations would silently reset it.  A module
+that defines the hook pair
+
+    save_state() -> obj          # JSON-serializable snapshot
+    restore_state(obj)           # re-seed the module from a snapshot
+
+opts into the server's ``_state.<iteration>`` checkpoint: the leader
+publishes ``save_state()`` before every loop flip, and a resuming or
+taking-over server calls ``restore_state`` so iteration N+1 runs against
+exactly the state N produced.
+
+The arithmetic is a deliberately order-sensitive rolling fold — ACC
+feeds every job value of the NEXT iteration, so restoring the wrong
+(or a reset) state changes every downstream emission, and a golden-twin
+diff catches it.  :func:`expected` computes the fault-free trajectory in
+pure Python, which is what the chaos suites compare takeover runs
+against.
+
+Single-module packaging: pass ``examples.loopsum`` for every slot.
+"""
+
+associative_reducer = True
+commutative_reducer = True
+idempotent_reducer = True
+
+N_SHARDS = 3
+NUM_REDUCERS = 2
+_MOD = 1000003          # fold modulus: keeps ACC bounded + JSON-exact
+
+ACC = 0                 # threaded state: rolling fold of iteration sums
+ITER = 0                # completed iterations
+N_ITERS = 10
+CRASH_AT = None         # test hook: finalfn raises ONCE when ITER == this
+
+
+def init(args):
+    global ACC, ITER, N_ITERS
+    ACC, ITER = 0, 0
+    N_ITERS = int(args.get("n_iters", 10))
+
+
+def save_state():
+    return {"acc": ACC, "iter": ITER}
+
+
+def restore_state(state):
+    global ACC, ITER
+    ACC = int(state["acc"])
+    ITER = int(state["iter"])
+
+
+def taskfn(emit):
+    # jobs CARRY the threaded state (the kmeans centroids-in-job-values
+    # idiom, examples/kmeans): a wrong restore poisons every mapper
+    for s in range(N_SHARDS):
+        emit(s, [ITER, ACC, s])
+
+
+def mapfn(key, value, emit):
+    it, acc, s = value
+    for j in range(4):
+        emit(f"k{(s + j) % 4}", (acc + it + 1) * (s + 1) * (j + 1) % _MOD)
+
+
+def partitionfn(key):
+    return int(str(key)[1:]) % NUM_REDUCERS
+
+
+def reducefn(key, values):
+    return sum(values) % _MOD
+
+
+combinerfn = reducefn
+
+
+def finalfn(pairs):
+    global ACC, ITER, CRASH_AT
+    if CRASH_AT is not None and ITER == CRASH_AT:
+        CRASH_AT = None     # self-disarm: the takeover re-runs this call
+        raise RuntimeError("loopsum: injected coordinator crash")
+    total = sum(values[0] for _, values in pairs) % _MOD
+    ACC = (ACC * 31 + total) % _MOD
+    ITER += 1
+    return "loop" if ITER < N_ITERS else None
+
+
+def expected(n_iters):
+    """The fault-free trajectory, computed without any engine: returns
+    ``(final_acc, result_dict)`` where result_dict is the LAST
+    iteration's reduce output — what a takeover run must match."""
+    acc = 0
+    result = {}
+    for it in range(n_iters):
+        groups = {}
+        for s in range(N_SHARDS):
+            for j in range(4):
+                k = f"k{(s + j) % 4}"
+                groups[k] = (groups.get(k, 0)
+                             + (acc + it + 1) * (s + 1) * (j + 1)) % _MOD
+        result = dict(groups)
+        acc = (acc * 31 + sum(groups.values()) % _MOD) % _MOD
+    return acc, result

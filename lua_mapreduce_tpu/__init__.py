@@ -54,6 +54,11 @@ _LAZY = {
     "AdmissionError": ("lua_mapreduce_tpu.sched.tenancy",
                        "AdmissionError"),
     "Waiter": ("lua_mapreduce_tpu.sched.waiter", "Waiter"),
+    # lmr-ha (DESIGN §31)
+    "LeaderLease": ("lua_mapreduce_tpu.sched.lease", "LeaderLease"),
+    "FencedJobStore": ("lua_mapreduce_tpu.sched.lease", "FencedJobStore"),
+    "StaleLeaderError": ("lua_mapreduce_tpu.faults.errors",
+                         "StaleLeaderError"),
 }
 
 
@@ -91,6 +96,9 @@ __all__ = [
     "FairScheduler",
     "AdmissionError",
     "Waiter",
+    "LeaderLease",
+    "FencedJobStore",
+    "StaleLeaderError",
     "tuples",
     "utest",
 ]

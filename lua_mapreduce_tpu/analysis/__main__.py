@@ -95,6 +95,13 @@ def _protocol_suite(args):
     runs.append(("elastic-pool", dataclasses.replace(
         base, n_workers=2, n_jobs=2, batch_k=min(args.batch_k, 2),
         elastic=True)))
+    # the leader-lease/fencing edge (DESIGN §31): two contending
+    # coordinators over one CAS lease — election, renewal, expiry
+    # takeover, fenced zombie writes — exhaustively on a 2-job box
+    # (the coordinator plane is job-transparent, so its invariants are
+    # the overlap/zombie ones, not the job lifecycle)
+    runs.append(("leader-lease", dataclasses.replace(
+        base, n_jobs=2, batch_k=min(args.batch_k, 2), ha=True)))
     if args.seed_bug:
         bugs = [args.seed_bug]
     else:
@@ -135,6 +142,11 @@ def _protocol_suite(args):
             # a second worker (the last one starts absent)
             extra = dict(n_workers=2, n_jobs=2,
                          batch_k=min(args.batch_k, 2), elastic=True)
+        elif bug in proto_mod.HA_BUGS:
+            # HA-edge bugs need the coordinator plane: the lease clock,
+            # two contenders, and the fencing guard on lead_write
+            extra = dict(n_jobs=2, batch_k=min(args.batch_k, 2),
+                         ha=True)
         elif bug in proto_mod.CODED_BUGS:
             # coded-edge bugs need the stripe data plane and enough
             # budget to degrade a stripe (and, for the decode-blind

@@ -133,7 +133,16 @@ Seedable bugs (``ModelConfig(bug=...)``):
   stale classification, firing the producer requeue without the
   expect=(WRITTEN,) status CAS: it yanks jobs mid-commit exactly like
   the replica-plane CAS bug, but now on stripes that were perfectly
-  decodable (requires ``coded=True`` and ``data_loss_budget ≥ 1``).
+  decodable (requires ``coded=True`` and ``data_loss_budget ≥ 1``);
+- ``"double_leader"`` — a standby's acquire overwrites a LIVE lease
+  without the version CAS, reusing the stored epoch: two coordinators
+  hold overlapping validity windows and both pass the fence — the
+  split-brain shape the CAS + epoch bump exist to prevent (requires
+  ``ha=True``);
+- ``"zombie_leader_write"`` — a deposed leader's mutation skips the
+  fencing guard: the stale write lands after a takeover bumped the
+  epoch — the corruption ``FencedJobStore`` turns into a permanent
+  ``StaleLeaderError`` (requires ``ha=True``).
 
 **Watch/notify wakeups (DESIGN §23).** With
 ``ModelConfig(allow_notify=True)`` each worker may go to SLEEP when its
@@ -168,6 +177,32 @@ silently serving garbage. Two seeded bugs live on exactly these edges
 (``coded_decode_lost_stripe``, ``coded_requeue_skips_decode``); the
 second one's shortest trace replays against BOTH real stores and
 diverges at the WRITTEN expectation of the requeue CAS.
+
+**Leader lease / fencing (DESIGN §31).** With ``ModelConfig(ha=True)``
+the coordinator itself joins the state: two contending coordinators
+over one CAS lease document ``(epoch, holder, age)`` plus each
+coordinator's BELIEVED epoch (0 = standby). The lease has its own
+virtual clock (``lease_tick`` — a leader may die while every job still
+WAITS, so lease expiry cannot ride the job tick), and the edges are
+op-for-op the shipped ``sched/lease.py``: a standby's ``acquire``
+(version CAS, legal only on a free/released or EXPIRED lease; the
+epoch bumps on every transfer so validity windows never overlap), the
+leader's ``renew`` (CAS resets the clock; failure = fenced back to
+standby, permanently — ``StaleLeaderError`` is never retried),
+``lead_release`` (clean handback), and ``lead_write`` — a guarded
+server-side mutation that lands iff the believed epoch IS the lease
+epoch (past the local deadline the landing rides the inline renewal
+CAS, exactly ``FencedJobStore._check`` → ``validate`` → ``renew``).
+All HA edges are environment (coordinator churn is never job
+progress) and state-transparent on every job — who leads is invisible
+to the claim protocol, because workers are leader-agnostic. Two
+invariants pin the design down: at most one coordinator may ever
+believe it holds the CURRENT epoch (no double leader), and no write
+may land from a coordinator whose believed epoch is stale (no zombie
+write). The seeded bugs (``double_leader``, ``zombie_leader_write``)
+break exactly those, and their shortest traces replay against the
+real ``LeaderLease`` + ``FencedJobStore`` over a real store, diverging
+at the acquire CAS / the fencing guard respectively.
 """
 
 from __future__ import annotations
@@ -198,7 +233,8 @@ KNOWN_BUGS = ("commit_skips_owner_cas", "requeue_ignores_finished",
               "scavenge_skips_lost_data", "lost_requeue_skips_written_cas",
               "spec_commit_skips_winner_cas", "lost_wakeup_no_fallback",
               "coded_decode_lost_stripe", "coded_requeue_skips_decode",
-              "elastic_retire_holds_lease")
+              "elastic_retire_holds_lease", "zombie_leader_write",
+              "double_leader")
 
 # bugs living on the replica-recovery edge need loss events to surface
 LOSS_BUGS = ("scavenge_skips_lost_data", "lost_requeue_skips_written_cas")
@@ -216,6 +252,9 @@ NOTIFY_BUGS = ("lost_wakeup_no_fallback",)
 
 # bugs living on the elastic join/leave edge need the elastic pool
 ELASTIC_BUGS = ("elastic_retire_holds_lease",)
+
+# bugs living on the leader-lease/fencing edge need the HA layer
+HA_BUGS = ("zombie_leader_write", "double_leader")
 
 # elastic join/leave must be state-transparent on every job: scaling
 # the pool may never change a status, an owner, or a retry budget —
@@ -248,9 +287,20 @@ _D_INTACT = 2    # full redundancy
 
 # environment events: enumerable, but never count as protocol progress
 # (join/retire are the controller's capacity choices — WHEN capacity
-# arrives or leaves is the environment's pick, like death)
+# arrives or leaves is the environment's pick, like death). The HA
+# coordinator-plane edges are environment too: who leads (and when a
+# zombie probes a write) never constitutes JOB progress, so a state
+# whose only options are coordinator churn is still quiescent for the
+# lost-job invariants.
 _ENV_OPS = frozenset({"die", "lose_replica", "lose_all", "lose_parity",
-                      "lose_notify", "join", "retire"})
+                      "lose_notify", "join", "retire", "lease_tick",
+                      "acquire", "renew", "lead_release", "lead_write"})
+
+# HA small-scope bounds: two contending coordinators, epochs saturate
+# (three acquisitions are enough to exhibit election, succession, AND
+# expiry takeover; an unbounded epoch would make the space infinite)
+_N_COORDS = 2
+_EPOCH_CAP = 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -268,6 +318,7 @@ class ModelConfig:
     allow_notify: bool = False
     notify_loss_budget: int = 1
     elastic: bool = False
+    ha: bool = False
     bug: Optional[str] = None
 
     def __post_init__(self):
@@ -321,6 +372,10 @@ class ModelConfig:
             raise ValueError(f"bug {self.bug!r} lives on the elastic "
                              "join/leave edge: it needs elastic=True "
                              "to be reachable")
+        if self.bug in HA_BUGS and not self.ha:
+            raise ValueError(f"bug {self.bug!r} lives on the leader-"
+                             "lease/fencing edge: it needs ha=True to "
+                             "be reachable")
         if self.allow_spec and self.n_workers < 2:
             raise ValueError("allow_spec needs ≥ 2 workers: a shadow "
                              "lease is never taken by the job's own "
@@ -397,9 +452,16 @@ class LeaseModel:
             # absent until a budget-free "join" brings it into the pool
             workers = workers[:-1] + (_ABSENT,)
         commits = (0,) * self.cfg.n_jobs
+        # the leader-lease plane (DESIGN §31): (epoch, holder, age,
+        # believed_0, believed_1) — holder is 0 (free/released) or
+        # coordinator-index+1; age counts lease_ticks since the last
+        # renewal; believed_c is the epoch coordinator c thinks it
+        # holds (0 = standby). A constant zero tuple when ha is off.
+        lease = (0, 0, 0) + (0,) * _N_COORDS
         return (jobs, workers, commits, self.cfg.data_loss_budget,
                 (0,) * self.cfg.n_workers,
-                self.cfg.notify_loss_budget if self.cfg.allow_notify else 0)
+                self.cfg.notify_loss_budget if self.cfg.allow_notify else 0,
+                lease)
 
     # -- per-transition effects (each is ONE atomic store op or one
     # worker-local step, which is exactly the interleaving granularity
@@ -410,7 +472,7 @@ class LeaseModel:
 
     def transitions(self, state: tuple) -> List[Tuple[tuple, tuple]]:
         """[(label, next_state), ...] — every enabled step."""
-        jobs, workers, commits, budget, wakes, nbudget = state
+        jobs, workers, commits, budget, wakes, nbudget, ha_st = state
         out: List[Tuple[tuple, tuple]] = []
         cfg = self.cfg
 
@@ -800,6 +862,85 @@ class LeaseModel:
                     out.append((("lose_notify", w),
                                 (jobs, workers, commits, budget,
                                  nw, nbudget - 1)))
+
+        # every job/worker/data-plane edge above leaves the lease plane
+        # untouched: thread it through verbatim
+        out = [(lbl, st + (ha_st,) if len(st) == 6 else st)
+               for lbl, st in out]
+
+        # -- leader-lease plane (DESIGN §31) ------------------------------
+        # Two contending coordinators over one CAS lease document. All
+        # edges are PURE on jobs/workers by construction — who leads is
+        # invisible to the claim protocol (workers are leader-agnostic).
+        if cfg.ha:
+            ep, hold, age = ha_st[0], ha_st[1], ha_st[2]
+            coords = ha_st[3:]
+
+            def ha_next(nep=ep, nhold=hold, nage=age, coord=None):
+                nc = list(coords)
+                if coord is not None:
+                    nc[coord[0]] = coord[1]
+                return (jobs, workers, commits, budget, wakes, nbudget,
+                        (nep, nhold, nage) + tuple(nc))
+
+            # the lease's own virtual clock, separate from the job
+            # clock: a leader may die while every job still WAITS, and
+            # its lease must still be able to expire
+            if hold != 0 and age < cfg.stale_age:
+                out.append((("lease_tick",), ha_next(nage=age + 1)))
+            for c in range(_N_COORDS):
+                bel = coords[c]
+                if bel == 0:
+                    # standby election probe: the CAS acquire — legal on
+                    # a free/released lease or an EXPIRED one (takeover,
+                    # epoch bump past the dead leader). The seeded
+                    # double_leader bug overwrites a LIVE lease without
+                    # the version CAS, reusing the stored epoch — the
+                    # two-live-holders shape the invariant catches.
+                    expired = hold != 0 and age >= cfg.stale_age
+                    can = hold == 0 or expired
+                    buggy_live = (cfg.bug == "double_leader"
+                                  and hold != 0 and not expired)
+                    if (can and ep < _EPOCH_CAP) or buggy_live:
+                        nep = ep if buggy_live else ep + 1
+                        out.append((("acquire", c, expired),
+                                    ha_next(nep=nep, nhold=c + 1, nage=0,
+                                            coord=(c, nep))))
+                else:
+                    if bel == ep and hold == c + 1:
+                        # the live leader: renewal resets the clock;
+                        # release hands the lease back cleanly
+                        if age > 0:
+                            out.append((("renew", c, True),
+                                        ha_next(nage=0)))
+                        out.append((("lead_release", c),
+                                    ha_next(nhold=0, nage=0,
+                                            coord=(c, 0))))
+                    else:
+                        # the lease moved under this coordinator: its
+                        # renewal CAS fails and it is fenced back to
+                        # standby (never retried — StaleLeaderError is
+                        # permanent by classification)
+                        out.append((("renew", c, False),
+                                    ha_next(coord=(c, 0))))
+                    # a server-side mutation through the fencing guard.
+                    # Correct model: lands iff the believed epoch IS the
+                    # lease epoch; past the local deadline the landing
+                    # rides the inline renewal CAS, which resets the
+                    # clock (FencedJobStore._check → validate → renew).
+                    # The seeded zombie_leader_write bug skips the guard
+                    # — the stale write lands, which is the step
+                    # violation.
+                    landed = (bel == ep
+                              or cfg.bug == "zombie_leader_write")
+                    if landed:
+                        nage = 0 if (bel == ep and age >= cfg.stale_age) \
+                            else age
+                        out.append((("lead_write", c, True),
+                                    ha_next(nage=nage)))
+                    else:
+                        out.append((("lead_write", c, False),
+                                    ha_next(coord=(c, 0))))
         return out
 
     @staticmethod
@@ -826,6 +967,31 @@ class LeaseModel:
                        label: tuple) -> Optional[str]:
         ojobs, ocommits = old[0], old[2]
         njobs, ncommits = new[0], new[2]
+        if label[0] == "acquire":
+            # the fencing invariant (DESIGN §31): validity windows of
+            # successive epochs never overlap, so at most ONE
+            # coordinator may ever believe it holds the lease's CURRENT
+            # epoch — the version CAS + epoch bump guarantee it
+            nha = new[6]
+            live = [c for c, b in enumerate(nha[3:])
+                    if b > 0 and b == nha[0]]
+            if len(live) >= 2:
+                return (f"double leader: coordinators {live} both hold "
+                        f"live epoch {nha[0]} after {label} — the "
+                        "acquire skipped the version CAS / expiry "
+                        "check, so two validity windows overlap and "
+                        "both leaders' writes pass the fence "
+                        "(DESIGN §31)")
+        if label[0] == "lead_write" and label[2]:
+            oha = old[6]
+            c = label[1]
+            if oha[3 + c] != oha[0]:
+                return (f"stale-epoch write landed: coordinator {c} "
+                        f"wrote with epoch {oha[3 + c]} while the lease "
+                        f"is at epoch {oha[0]} — a zombie leader "
+                        "mutated job state after losing a takeover "
+                        "(the fencing guard must reject it with "
+                        "StaleLeaderError; DESIGN §31)")
         if label[0] == "retire":
             # the no-lease-abandoned rule (DESIGN §29): a retiring
             # worker must own no live lease — FleetSupervisor's
@@ -1050,8 +1216,71 @@ def replay_trace(store, trace: Sequence[tuple], config: ModelConfig,
     def diverged(i, label, reason):
         return {"ok": False, "step": i, "label": label, "reason": reason}
 
+    # the leader-lease plane replays against the REAL pt_cas lease +
+    # FencedJobStore (DESIGN §31). The virtual lease clock advances
+    # 1.25 per lease_tick against ttl = stale_age, so "age ≥ stale_age"
+    # in the model is strictly past the real deadline (the real expiry
+    # compare is strict) while "age < stale_age" stays strictly inside.
+    ha_now = [0.0]
+    ha_leases: Dict[int, object] = {}
+
+    def ha_lease(c: int):
+        if c not in ha_leases:
+            from lua_mapreduce_tpu.sched.lease import LeaderLease
+            ha_leases[c] = LeaderLease(store, holder=f"mc{c}",
+                                       ttl_s=float(config.stale_age),
+                                       clock=lambda: ha_now[0])
+        return ha_leases[c]
+
     for i, label in enumerate(trace):
         op = label[0]
+        if op == "lease_tick":
+            ha_now[0] += 1.25
+            continue
+        if op == "acquire":
+            _, c, took = label
+            if not ha_lease(c).try_acquire():
+                return diverged(
+                    i, label,
+                    f"acquire CAS refused coordinator {c} — the real "
+                    "lease's version CAS + expiry check block the "
+                    "takeover the buggy model allowed")
+            if ha_lease(c).took_over != took:
+                return diverged(i, label,
+                                f"took_over={ha_lease(c).took_over}, "
+                                f"model said {took}")
+            continue
+        if op == "renew":
+            _, c, ok = label
+            got = ha_lease(c).renew()
+            if got != ok:
+                return diverged(i, label,
+                                f"renew CAS returned {got}, model "
+                                f"said {ok}")
+            continue
+        if op == "lead_release":
+            ha_lease(label[1]).release()
+            continue
+        if op == "lead_write":
+            _, c, landed = label
+            from lua_mapreduce_tpu.faults.errors import StaleLeaderError
+            from lua_mapreduce_tpu.sched.lease import FencedJobStore
+            fenced = FencedJobStore(store, ha_lease(c))
+            try:
+                # a harmless guarded mutation: the fencing check is
+                # what's under test, not the op's payload
+                fenced.requeue_stale(ns, 1e9)
+                got = True
+            except StaleLeaderError:
+                got = False
+            if got != landed:
+                return diverged(
+                    i, label,
+                    f"fenced write: real guard "
+                    + ("rejected the write the buggy model landed — "
+                       "StaleLeaderError fences the zombie" if landed
+                       else f"landed a write the model fenced"))
+            continue
         if op in ("exec", "exec_fail", "spec_exec", "die", "tick",
                   "lose_replica", "lose_all", "lose_parity", "repair",
                   "sleep", "notify_wake", "timeout_wake", "lose_notify",
@@ -1308,3 +1537,26 @@ def utest() -> None:
     rep6 = replay_trace(MemJobStore(), abandon.violation.trace[:-1],
                         abandon.config)
     assert rep6["ok"], rep6   # every store op up to the bad retire lands
+
+    # leader lease / fencing (DESIGN §31): coordinator churn holds the
+    # whole invariant set exhaustively (HA edges are job-transparent —
+    # who leads is invisible to the claim protocol); the split-brain
+    # and zombie-write bugs are re-found as direct invariant hits, and
+    # their traces replayed against the REAL LeaderLease/FencedJobStore
+    # over a real store diverge at exactly the guarding CAS / fence
+    ha_cfg = dataclasses.replace(small, ha=True)
+    res7 = check_protocol(ha_cfg)
+    assert res7.ok and res7.states > res.states
+
+    dbl = check_protocol(dataclasses.replace(ha_cfg, bug="double_leader"))
+    assert not dbl.ok, "seeded double-leader bug not found"
+    assert "double leader" in dbl.violation.message
+    rep7 = replay_trace(MemJobStore(), dbl.violation.trace, dbl.config)
+    assert not rep7["ok"] and rep7["label"][0] == "acquire", rep7
+
+    zomb = check_protocol(dataclasses.replace(
+        ha_cfg, bug="zombie_leader_write"))
+    assert not zomb.ok, "seeded zombie-write bug not found"
+    assert "stale-epoch write landed" in zomb.violation.message
+    rep8 = replay_trace(MemJobStore(), zomb.violation.trace, zomb.config)
+    assert not rep8["ok"] and rep8["label"][0] == "lead_write", rep8

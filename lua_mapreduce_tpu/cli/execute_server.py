@@ -184,6 +184,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "under --autotune (default: the controller's "
                         "fleet cap, clamped by tenant admission quotas "
                         "when a fair-scheduling config is active)")
+    p.add_argument("--ha", action="store_true", default=None,
+                   help="highly-available coordination (docs/DESIGN.md "
+                        "§31; default off, or LMR_HA=1): contend for "
+                        "the epoch-fenced leader lease on the job "
+                        "store's persistent table before orchestrating. "
+                        "Losers hot-standby on the 'leader' wakeup "
+                        "topic and take over MID-PHASE through the "
+                        "resume matrix when the lease expires; every "
+                        "server-side mutation is epoch-fenced, so a "
+                        "paused-and-resumed zombie leader gets "
+                        "StaleLeaderError instead of corrupting state. "
+                        "Workers need no flag — they are "
+                        "leader-agnostic")
+    p.add_argument("--lease-ttl-s", type=float, default=None,
+                   help="leader lease TTL in seconds (default 10, or "
+                        "LMR_LEASE_TTL_S): renewed every TTL/3; a "
+                        "standby takes over after the last renewal "
+                        "ages past TTL. Lower = faster failover, more "
+                        "control-plane CAS traffic")
     p.add_argument("--trace", action="store_true",
                    help="lmr-trace (docs/DESIGN.md §22): record "
                         "claim/body/publish/commit spans and per-op "
@@ -255,7 +274,9 @@ def main(argv=None) -> int:
                     speculation_cap=args.speculation_cap,
                     push=args.push,
                     engine=args.engine,
-                    autotune=args.autotune).configure(spec)
+                    autotune=args.autotune,
+                    ha=args.ha,
+                    lease_ttl_s=args.lease_ttl_s).configure(spec)
 
     def spawn_worker(_seq: int):
         w = Worker(store).configure(max_iter=10_000)

@@ -4,6 +4,11 @@ Analog of reference execute_worker.lua:1-11:
 
     python -m lua_mapreduce_tpu.cli.execute_worker COORD_DIR \\
         [--max-iter N] [--max-sleep S] [--max-tasks N] [--verbose]
+
+Workers are leader-agnostic: they talk to the job store, never to a
+coordinator process, so an HA leader takeover (execute_server --ha,
+docs/DESIGN.md §31) is invisible here — no flag, no reconnect, no
+restart. In-flight claims survive the takeover and commit normally.
 """
 
 from __future__ import annotations

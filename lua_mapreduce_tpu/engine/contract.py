@@ -195,6 +195,32 @@ class TaskSpec:
         no-combiner+flagged-reducer as a distinct config, test.sh:8-73)."""
         return self.combinerfn
 
+    @property
+    def state_hooks(self):
+        """The optional loop-state hooks ``(save_state, restore_state)``
+        of the user program, or ``(None, None)``.
+
+        A module running the ``"loop"`` protocol may carry state that
+        threads BETWEEN iterations outside the store (the reference's
+        kmeans keeps centroids in module globals fed by finalfn). A
+        module that defines module-level ``save_state() -> obj`` (any
+        JSON-serializable value) and ``restore_state(obj)`` opts into
+        the server's ``_state.<iteration>`` checkpoint (DESIGN §31):
+        the leader publishes ``save_state()`` before every loop flip,
+        and a resuming/taking-over server calls ``restore_state`` so
+        iteration N+1 sees exactly the state N produced. Both hooks
+        must exist on ONE module (finalfn's module checked first — it
+        is the function that produces the threaded state)."""
+        for name in ("finalfn", "taskfn") + FN_NAMES:
+            loaded = self._loaded.get(name)
+            if loaded is None:
+                continue
+            save = getattr(loaded.module, "save_state", None)
+            restore = getattr(loaded.module, "restore_state", None)
+            if callable(save) and callable(restore):
+                return save, restore
+        return None, None
+
     def _run_inits(self) -> None:
         seen = set()
         for name in FN_NAMES:

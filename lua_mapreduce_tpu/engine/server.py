@@ -39,6 +39,18 @@ from lua_mapreduce_tpu.utils.stats import (IterationStats, TaskStats,
                                            overlap_fraction)
 
 
+def resolve_ha(arg) -> bool:
+    """The HA knob's resolution order: explicit argument, else the
+    ``LMR_HA`` env ("1"/"true"/"yes"/"on", case-insensitive), else off.
+    On, :meth:`Server.loop` runs the leader-lease election (DESIGN §31)
+    instead of assuming it is the only coordinator."""
+    if arg is None:
+        import os
+        raw = os.environ.get("LMR_HA", "")
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    return bool(arg)
+
+
 def resolve_speculation(arg) -> float:
     """The speculation knob's shared resolution order: explicit
     argument, else ``LMR_SPECULATION`` env, else 0 (off). The value is
@@ -139,6 +151,20 @@ class Server:
     Every change is an ``autotune.<knob>`` trace span carrying its
     evidence. Off is byte- and behavior-identical to pre-controller
     builds.
+
+    ``ha`` (DESIGN §31; None = ``LMR_HA`` env, else off) removes the
+    coordinator as the last single point of failure: ``loop()`` first
+    runs a CAS election for an epoch-fenced leader lease on the job
+    store's persistent table (TTL ``lease_ttl_s``; None =
+    ``LMR_LEASE_TTL_S`` env, else 10 s). The winner leads with every
+    server-side mutation stamped by its epoch (a zombie ex-leader's
+    writes are rejected with :class:`StaleLeaderError` — counted,
+    traced, and landed on the errors stream); losers stand by on the
+    "leader" notify topic and take over mid-phase through the SAME
+    resume matrix a restart uses, within ~``ttl + ttl/3`` of the
+    leader's death. Workers are leader-agnostic — claims ride the
+    job-level CAS protocol, so a takeover is invisible to them. Off is
+    byte-identical to the single-coordinator path.
     """
 
     def __init__(self, store: JobStore, poll_interval: float = DEFAULT_SLEEP,
@@ -154,7 +180,9 @@ class Server:
                  push: Optional[bool] = None,
                  engine: Optional[str] = None,
                  autotune: Optional[bool] = None,
-                 autotune_config=None):
+                 autotune_config=None,
+                 ha: Optional[bool] = None,
+                 lease_ttl_s: Optional[float] = None):
         # coord RPCs ride the transient-fault retry layer (DESIGN §19);
         # the scavenge/requeue/drain housekeeping must not abort an
         # iteration over one store blip
@@ -250,6 +278,17 @@ class Server:
         self._spec_scan_at: Dict[str, float] = {}     # ns -> last scan
         self._waiter_obj = None        # barrier wakeup cursor (DESIGN §23)
         self._housekeep_at: Optional[float] = None    # throttle stamp
+        # high availability (DESIGN §31; None = LMR_HA env, else off):
+        # loop() runs the epoch-fenced leader election — losers stand
+        # by on the "leader" notify topic and take over mid-phase via
+        # the resume matrix when the leader's lease expires; every
+        # server-side mutation is fenced by the lease epoch, so a
+        # zombie ex-leader can never corrupt state. Off is
+        # byte-identical to the single-coordinator path.
+        self.ha = resolve_ha(ha)
+        self.lease_ttl_s = lease_ttl_s    # None = LMR_LEASE_TTL_S/10s
+        self._lease = None                # LeaderLease while leading
+        self._took_over = False           # this run resumed a dead leader's
 
     # -- wakeups (lmr-sched watch/notify, DESIGN §23) -----------------------
 
@@ -319,7 +358,106 @@ class Server:
         state, start fresh; REDUCE → skip the map phase and restore the
         spec recorded in the task doc; WAIT/MAP → resume the iteration in
         place, keeping WRITTEN jobs.
+
+        With ``ha`` on (DESIGN §31), this first runs the leader-lease
+        election: the winner leads through exactly the path above with
+        every mutation epoch-fenced; losers stand by on the "leader"
+        notify topic and, when the leader's lease expires mid-task,
+        take over by re-entering the resume matrix — the takeover IS a
+        resume, so all the stickiness rules above apply unchanged. A
+        standby that watches another leader finish the task returns
+        with its own (empty) stats; the results live in result storage
+        either way.
         """
+        if not self.ha:
+            return self._run(progress, strict)
+        return self._ha_loop(progress, strict)
+
+    def _ha_loop(self, progress, strict) -> TaskStats:
+        """The election ladder (DESIGN §31): acquire → lead (fenced) →
+        on expiry-takeover-by-another, abdicate back to standby. The
+        lease is released ONLY on clean completion — any exception
+        leaves it to expire, exactly as a SIGKILL would, so the hot
+        standby's takeover path is the same for both."""
+        from lua_mapreduce_tpu.faults.errors import StaleLeaderError
+        from lua_mapreduce_tpu.sched.lease import FencedJobStore, LeaderLease
+        lease = LeaderLease(self.store, ttl_s=self.lease_ttl_s)
+        self._lease = lease
+        waiter = lease.standby_waiter()
+        tracer = active_tracer()
+        seen_active = False      # a live (non-FINISHED) task was observed
+        while True:
+            # completion check BEFORE the acquire attempt: when the
+            # leader finishes and cleanly releases, the released lease
+            # is acquirable — a standby that grabbed it first would
+            # re-enter the task loop on a FINISHED doc and restart the
+            # task from scratch. Observing completion wins over
+            # electability, so a standing-by coordinator retires
+            # instead. (A server that NEVER saw the task active — a
+            # fresh --ha start against a finished doc — still runs:
+            # that is the ordinary fresh-start path.)
+            task = self.store.get_task()
+            status = task.get("status") if task is not None else None
+            if task is not None and status != TaskStatus.FINISHED.value:
+                seen_active = True
+            if seen_active and (task is None
+                                or status == TaskStatus.FINISHED.value):
+                # the leader finished (or finished + dropped) the task:
+                # nothing left to lead. finished_value stays None — a
+                # standby never saw the verdict; results are in result
+                # storage.
+                self.stats.wall_time = 0.0 if not self.stats.iterations \
+                    else self.stats.wall_time
+                return self.stats
+            if lease.try_acquire():
+                self._took_over = lease.took_over
+                if lease.took_over:
+                    COUNTERS.bump("leader_takeovers")
+                    self._log(f"lease takeover: epoch {lease.epoch} "
+                              f"as {lease.holder}")
+                if tracer is not None:
+                    kind = ("leader.takeover" if lease.took_over
+                            else "leader.acquire")
+                    with tracer.span(kind, epoch=lease.epoch,
+                                     holder=lease.holder):
+                        pass
+                plain = self.store
+                self.store = FencedJobStore(plain, lease)
+                lease.start_renewal()
+                try:
+                    stats = self._run(progress, strict)
+                except StaleLeaderError:
+                    # fenced mid-run: another coordinator leads now.
+                    # Abdicate — never retry, never release (the lease
+                    # is already theirs) — and stand by: if the new
+                    # leader dies too, this server takes back over.
+                    lease.stop_renewal(release=False)
+                    self.store = plain
+                    self._log(f"fenced at epoch {lease.epoch}: "
+                              "re-entering standby")
+                    seen_active = True
+                    continue
+                except BaseException:
+                    # crash path: stop renewing but DO NOT release —
+                    # the lease expires on its own TTL, exactly like a
+                    # SIGKILL, and the hot standby takes over
+                    lease.stop_renewal(release=False)
+                    self.store = plain
+                    raise
+                lease.stop_renewal(release=True)   # clean handback
+                self.store = plain
+                self._took_over = False
+                return stats
+            # standby: wait for the lease to move (event-driven via the
+            # "leader" topic; a lost notification degrades to the
+            # ttl/3 probe); the loop top re-checks task completion
+            COUNTERS.bump("standby_wakeups")
+            waiter.wait(lease.ttl_s / 3.0)
+
+    def _run(self, progress: Optional[Callable[[str, float], None]] = None,
+             strict: Optional[bool] = None) -> TaskStats:
+        """One coordinator tenure: the single-leader task loop (the
+        entire pre-HA ``loop()``; HA wraps it in the election above)."""
         if strict is not None:
             self.strict = strict
         t0 = time.time()
@@ -443,6 +581,7 @@ class Server:
         # sweeps fan out to every copy. r=1: both are the same object.
         self._data_store = get_storage_from(self.spec.storage)
         if task is None:
+            raw = unwrap(self._data_store)
             # fresh start: purge a previous run's flushed spans so the
             # collector never presents a stale timeline as this run's —
             # UNCONDITIONALLY, not only when this run is traced: an
@@ -452,9 +591,28 @@ class Server:
             # consume FaultPlan occurrences or pay retry backoff (the
             # flush-side rule); _trace.* removal can never touch result
             # bytes (the prefix sits outside every engine namespace).
-            raw = unwrap(self._data_store)
-            for name in raw.list(f"{TRACE_NS}.*"):
+            # EXCEPT on an HA takeover (DESIGN §31): a takeover is a
+            # RESUME of the dead leader's run even when it lands on an
+            # edge where the doc is gone — purging would erase the
+            # first leader's half of the one continuous timeline.
+            if not self._took_over:
+                for name in raw.list(f"{TRACE_NS}.*"):
+                    raw.remove(name)
+            # stale loop-state checkpoints are a CORRECTNESS purge, not
+            # an observability one: a fresh run must never restore a
+            # previous task's threaded state, so these go even on the
+            # takeover edge (a fresh doc means iteration 1 — there is
+            # no prior state to thread)
+            from lua_mapreduce_tpu.sched.lease import STATE_NS
+            for name in raw.list(f"{STATE_NS}.*"):
                 raw.remove(name)
+        else:
+            # resume (process restart or HA takeover) mid-loop-task:
+            # restore the threaded loop state the previous tenure
+            # published before its last WAIT flip, so iteration N runs
+            # against exactly the state N-1 produced (DESIGN §31 —
+            # closing the last resume hole)
+            self._restore_loop_state(iteration)
         store = reading_view(self._data_store, self.replication)
         result_store = (get_storage_from(self.spec.result_storage)
                         if self.spec.result_storage else self._data_store)
@@ -569,6 +727,14 @@ class Server:
 
             if verdict == "loop":
                 iteration += 1
+                # the threaded loop state (centroids, accumulators —
+                # whatever finalfn carries between iterations outside
+                # the store) is checkpointed BEFORE the WAIT flip: a
+                # crash between the two resumes at the flip's iteration
+                # and finds the state that feeds it already published
+                # (DESIGN §31). `_state.<N>` is named by the iteration
+                # it FEEDS.
+                self._save_loop_state(iteration)
                 self.store.drop_ns(MAP_NS)
                 self.store.drop_ns(PRE_NS)
                 self.store.drop_ns(RED_NS)
@@ -582,11 +748,74 @@ class Server:
             self._notify_jobs()      # waiting workers see FINISHED now
             if verdict is True:
                 delete_results(result_store, self.spec.result_ns)
+                self._purge_loop_state()
                 self._drop_everything()
             break
 
         self.stats.wall_time = time.time() - t0
         return self.stats
+
+    # -- loop-state checkpoint (DESIGN §31) ---------------------------------
+
+    def _save_loop_state(self, iteration: int) -> None:
+        """Publish the user program's threaded loop state as the
+        CRC-framed ``_state.<iteration>`` file (named by the iteration
+        it FEEDS), through the RAW store: like ``_trace.*``, the prefix
+        sits outside every engine namespace, the write must not consume
+        FaultPlan occurrences, and a torn write reads as corrupt (and
+        is ignored) rather than silently wrong. No-op for programs
+        without the save_state/restore_state hook pair."""
+        save, _ = self.spec.state_hooks
+        if save is None:
+            return
+        from lua_mapreduce_tpu.sched.lease import STATE_NS, frame_state
+        raw = unwrap(self._data_store)
+        name = f"{STATE_NS}.{iteration}"
+        with raw.builder() as b:
+            b.write_bytes(frame_state(save()))
+            b.build(name)
+        # older checkpoints are dead weight — EXCEPT the immediately
+        # preceding one: this save runs BEFORE the doc's iteration flip,
+        # so a crash in that window resumes at iteration-1 and must
+        # still find the checkpoint that feeds it. Keeping {N-1, N}
+        # covers both sides of the flip; everything older is swept so
+        # loop tasks don't accrete files.
+        keep = (name, f"{STATE_NS}.{iteration - 1}")
+        for old in raw.list(f"{STATE_NS}.*"):
+            if old not in keep:
+                raw.remove(old)
+
+    def _restore_loop_state(self, iteration: int) -> None:
+        """Feed ``_state.<iteration>`` back through the program's
+        restore_state hook on resume/takeover. Iteration 1 has no
+        checkpoint (nothing fed it); a missing or corrupt frame is
+        ignored — the program then resumes from its init-time state,
+        which is exactly the pre-§31 behavior."""
+        _, restore = self.spec.state_hooks
+        if restore is None:
+            return
+        from lua_mapreduce_tpu.sched.lease import STATE_NS, unframe_state
+        raw = unwrap(self._data_store)
+        name = f"{STATE_NS}.{iteration}"
+        if not raw.exists(name):
+            return
+        try:
+            data = raw.read_range(name, 0, raw.size(name))
+            state = unframe_state(data)
+        except Exception as exc:    # torn/corrupt frame: resume without
+            self._log(f"loop-state checkpoint {name} unreadable "
+                      f"({exc}); resuming from init-time state")
+            return
+        restore(state)
+        self._log(f"loop state restored from {name}")
+
+    def _purge_loop_state(self) -> None:
+        """Drop every loop-state checkpoint (task completed: the final
+        verdict supersedes any threaded state)."""
+        from lua_mapreduce_tpu.sched.lease import STATE_NS
+        raw = unwrap(self._data_store)
+        for name in raw.list(f"{STATE_NS}.*"):
+            raw.remove(name)
 
     # -- phases -------------------------------------------------------------
 
@@ -1406,5 +1635,58 @@ def utest() -> None:
         it2 = stats2.iterations[-1]
         assert it2.map.count == 3 and it2.reduce.failed == 0
         assert it2.premerge.failed == 0
+
+        # HA leg (DESIGN §31): the same task under the leader-lease
+        # election — one contender simply wins epoch 1, leads fenced,
+        # and releases on completion; a late second contender observes
+        # the FINISHED task and returns without ever leading
+        mod.result = None
+        store3 = MemJobStore()
+        spec3 = TaskSpec(taskfn="_server_utest_mod",
+                         mapfn="_server_utest_mod",
+                         partitionfn="_server_utest_mod",
+                         reducefn="_server_utest_mod",
+                         finalfn="_server_utest_mod",
+                         storage="mem:_server_utest_ha")
+        server3 = Server(store3, poll_interval=0.01, ha=True,
+                         lease_ttl_s=5.0).configure(spec3)
+        w3 = Worker(store3).configure(max_iter=400, max_sleep=0.02)
+        t3 = threading.Thread(target=w3.execute, daemon=True)
+        t3.start()
+        stats3 = server3.loop()
+        t3.join(timeout=30)
+        assert mod.result == {"n": 4}, mod.result
+        assert stats3.iterations[-1].map.count == 3
+        doc = store3.pt_get("leader")
+        assert doc is not None and doc["epoch"] == 1 and not doc["holder"]
+        standby = Server(store3, poll_interval=0.01, ha=True,
+                         lease_ttl_s=5.0)
+        spec3b = TaskSpec(taskfn="_server_utest_mod",
+                          mapfn="_server_utest_mod",
+                          partitionfn="_server_utest_mod",
+                          reducefn="_server_utest_mod",
+                          finalfn="_server_utest_mod",
+                          storage="mem:_server_utest_ha")
+        standby.configure(spec3b)
+        # task doc is FINISHED: the next ha loop() leads a FRESH run —
+        # assert instead the fenced guard surface directly: a lease
+        # fenced by a successor epoch rejects mutations permanently
+        from lua_mapreduce_tpu.faults.errors import StaleLeaderError
+        from lua_mapreduce_tpu.sched.lease import (FencedJobStore,
+                                                   LeaderLease)
+        now = [0.0]
+        zl = LeaderLease(store3, holder="z", ttl_s=1.0,
+                         clock=lambda: now[0])
+        assert zl.try_acquire() and zl.epoch == 2
+        now[0] += 5.0
+        nl = LeaderLease(store3, holder="n", ttl_s=1.0,
+                         clock=lambda: now[0])
+        assert nl.try_acquire() and nl.took_over
+        fenced = FencedJobStore(store3, zl)
+        try:
+            fenced.update_task({"status": "MAP"})
+            raise AssertionError("zombie write must be fenced")
+        except StaleLeaderError as e:
+            assert e.current_epoch == 3
     finally:
         del sys.modules["_server_utest_mod"]

@@ -30,7 +30,8 @@ from lua_mapreduce_tpu.faults.errors import (ConcurrentInsertError,
                                              InjectedFault,
                                              InjectedPermanentFault,
                                              NoTaskError,
-                                             PermanentStoreError, StoreError,
+                                             PermanentStoreError,
+                                             StaleLeaderError, StoreError,
                                              TransientStoreError,
                                              classify_exception,
                                              describe_classification,
@@ -57,7 +58,8 @@ from lua_mapreduce_tpu.faults.wrappers import (FaultyJobStore, FaultyStore,
 __all__ = [
     "StoreError", "TransientStoreError", "PermanentStoreError",
     "InjectedFault", "InjectedPermanentFault", "NoTaskError",
-    "ConcurrentInsertError", "LostShuffleDataError", "classify_exception",
+    "ConcurrentInsertError", "LostShuffleDataError", "StaleLeaderError",
+    "classify_exception",
     "is_transient_fault", "describe_classification",
     "ReplicatedStore", "reading_view", "repair", "spill_writer",
     "Coding", "CodedStore", "parse_coding", "check_redundancy",

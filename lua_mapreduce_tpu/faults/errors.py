@@ -142,6 +142,27 @@ class NativeEngineError(PermanentStoreError, RuntimeError):
     failure of a healthy engine.)"""
 
 
+class StaleLeaderError(PermanentStoreError, RuntimeError):
+    """A server-side mutation carried a fencing epoch older than the
+    current leader lease (DESIGN §31): the writer is a ZOMBIE — a
+    coordinator that lost its lease to a takeover (GC pause, partition,
+    SIGSTOP) and came back believing it still leads. Permanent by
+    classification: retrying the same write with the same stale epoch
+    deterministically fails again, so the retry layer must fail fast
+    and the holder must abdicate (re-enter standby), never back off
+    and corrupt state later. Subclasses RuntimeError so pre-taxonomy
+    callers keep catching it. ``epoch``/``current_epoch``/``holder``
+    carry the fencing evidence for the errors stream."""
+
+    def __init__(self, msg: str, *, epoch: Optional[int] = None,
+                 current_epoch: Optional[int] = None,
+                 holder: Optional[str] = None, **kw):
+        super().__init__(msg, **kw)
+        self.epoch = epoch
+        self.current_epoch = current_epoch
+        self.holder = holder
+
+
 class LostShuffleDataError(TransientStoreError):
     """Every replica of a shuffle file is unreadable (DESIGN §20).
 
@@ -288,5 +309,11 @@ def utest() -> None:
     # pre-taxonomy except-clauses keep catching the coord protocol errors
     assert issubclass(NoTaskError, RuntimeError)
     assert issubclass(ConcurrentInsertError, RuntimeError)
+    # fencing rejections are permanent (fail fast, never back off) and
+    # carry the epoch evidence the errors stream records (DESIGN §31)
+    assert issubclass(StaleLeaderError, RuntimeError)
+    sl = StaleLeaderError("fenced", epoch=2, current_epoch=3, holder="s1")
+    assert sl.transient is False and classify_exception(sl) is False
+    assert (sl.epoch, sl.current_epoch, sl.holder) == (2, 3, "s1")
     e = TransientStoreError("m", op="read_range", name="f", attempts=4)
     assert (e.op, e.name, e.attempts) == ("read_range", "f", 4)

@@ -11,9 +11,18 @@ Two coupled layers over the existing claim protocol:
 - **multi-tenancy** (:mod:`sched.tenancy`) — many concurrent tasks per
   store under per-tenant namespaces, with weighted-fair-share claim
   ordering (stride scheduling) and admission quotas, so one tenant's
-  many-tiny-jobs flood cannot starve another's barrier.
+  many-tiny-jobs flood cannot starve another's barrier;
+- **leader lease** (:mod:`sched.lease`) — epoch-fenced CAS lease on the
+  store's persistent table plus the :class:`FencedJobStore` mutation
+  guard (DESIGN §31), making the coordinator itself replaceable:
+  standbys watch the "leader" notify topic and take over mid-phase via
+  the server's resume matrix; a fenced zombie can never corrupt state.
 """
 
+from lua_mapreduce_tpu.sched.lease import (FENCED_OPS, LEASE_NAME, STATE_NS,
+                                           FencedJobStore, LeaderLease,
+                                           default_holder, frame_state,
+                                           resolve_lease_ttl, unframe_state)
 from lua_mapreduce_tpu.sched.tenancy import (AdmissionError, FairScheduler,
                                              FairWorker, Tenant, TenantView,
                                              dispatch_latencies, tenant_ns)
@@ -27,10 +36,13 @@ __all__ = [
     "dispatch_latencies", "tenant_ns",
     "Channel", "DirChannel", "LocalChannel", "NullChannel", "NullWaiter",
     "StoreChannel", "Waiter", "channel_for", "notify", "notify_enabled",
+    "LeaderLease", "FencedJobStore", "FENCED_OPS", "LEASE_NAME", "STATE_NS",
+    "default_holder", "frame_state", "resolve_lease_ttl", "unframe_state",
 ]
 
 
 def utest() -> None:
-    from lua_mapreduce_tpu.sched import tenancy, waiter
+    from lua_mapreduce_tpu.sched import lease, tenancy, waiter
     waiter.utest()
     tenancy.utest()
+    lease.utest()

@@ -323,7 +323,12 @@ class StoreChannel(Channel):
 #            workers wait on it
 #   "done" — lease retirements landed (commits); the server's barrier
 #            poll waits on it
-TOPICS = ("jobs", "done")
+#   "leader" — the leader lease moved (acquire / renew-expiry window /
+#            release / takeover); HA standby coordinators wait on it so
+#            takeover is event-driven, not polled (DESIGN §31). A lost
+#            notification degrades to the standby's TTL-bounded timeout
+#            probe, same ladder as every other topic.
+TOPICS = ("jobs", "done", "leader")
 
 WAKE_PREFIX = "_sched"          # object names: _sched.<topic>.wake
 

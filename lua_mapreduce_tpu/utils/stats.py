@@ -88,6 +88,9 @@ COUNTER_FOLD = {
     "autotune_decisions": ("autotune_decisions",),
     "autotune_vetoes": ("autotune_vetoes",),
     "autotune_scale_events": ("autotune_scale_events",),
+    "leader_takeovers": ("leader_takeovers",),
+    "fenced_writes": ("fenced_writes",),
+    "standby_wakeups": ("standby_wakeups",),
 }
 _FLOAT_COUNTERS = frozenset({"spec_wasted_s"})
 
@@ -189,6 +192,20 @@ class IterationStats:
     #                           flip lockout) suppressed
     #   autotune_scale_events — the elastic subset of decisions: fleet
     #                           grow/retire targets issued
+    # HA leader-lease accounting (DESIGN §31), same fold:
+    #   leader_takeovers — lease acquisitions that BUMPED the epoch past
+    #                      a dead/expired leader's (a mid-run takeover;
+    #                      the first election of a run is epoch 1 and
+    #                      not counted)
+    #   fenced_writes    — server-side mutations REJECTED by the fencing
+    #                      check (a zombie leader's write attempts; each
+    #                      one is also an errors-stream entry carrying
+    #                      the epoch evidence)
+    #   standby_wakeups  — standby election probes (leader-topic wakeup
+    #                      or TTL-bounded timeout) that found the lease
+    #                      still held. LocalExecutor folds all three as
+    #                      zeros by construction: no lease exists
+    #                      in-process.
     store_retries: int = 0
     store_faults: int = 0
     infra_releases: int = 0
@@ -213,6 +230,9 @@ class IterationStats:
     autotune_decisions: int = 0
     autotune_vetoes: int = 0
     autotune_scale_events: int = 0
+    leader_takeovers: int = 0
+    fenced_writes: int = 0
+    standby_wakeups: int = 0
 
     def fold_fault_counters(self, delta: Dict[str, float]
                             ) -> "IterationStats":
@@ -271,6 +291,9 @@ class IterationStats:
             "autotune_decisions": self.autotune_decisions,
             "autotune_vetoes": self.autotune_vetoes,
             "autotune_scale_events": self.autotune_scale_events,
+            "leader_takeovers": self.leader_takeovers,
+            "fenced_writes": self.fenced_writes,
+            "standby_wakeups": self.standby_wakeups,
             "cluster_time": self.cluster_time,
             "wall_time": self.wall_time,
         }

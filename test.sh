@@ -163,4 +163,32 @@ echo "hybrid smoke: stage legs compiled, split negotiated, chaos held"
 # without losing a lease
 JAX_PLATFORMS=cpu python -m pytest tests/test_autotune.py -q
 echo "autotune smoke: knobs stable, decisions evidenced, fleet elastic"
+# lmr-ha smoke gate (DESIGN §31): lease election + epoch fencing on a
+# virtual clock, the fenced mutation surface landing its evidence on
+# the errors stream, a clean --ha lifecycle releasing the lease, a hot
+# standby retiring when the leader finishes, and a mid-loop takeover
+# restoring save_state/restore_state threaded state; then the
+# leader-lease protocol gate re-pinned standalone — the exhaustive
+# 2-coordinator election/renewal/expiry/zombie sweep must pass and
+# BOTH seeded HA races (double_leader, zombie_leader_write) must be
+# re-found (also rides the full lmr-analyze sweep above; pinned here
+# so an HA regression fails under its own banner). The heavy tier
+# (--full below) SIGKILLs the leader at four phases with a hot
+# standby, fences a SIGSTOP zombie, and lands a SIGKILL inside the
+# checkpoint-save→doc-flip window.
+python -m pytest tests/test_ha.py -q -k "smoke"
+python - << 'PYEOF'
+import dataclasses
+from lua_mapreduce_tpu.analysis import protocol as proto
+base = proto.ModelConfig(n_workers=2, n_jobs=2, batch_k=2, ha=True)
+res = proto.check_protocol(base)
+assert res.ok, f"leader-lease exhaustive sweep FAILED: {res.violation.message}"
+print(f"leader-lease sweep: {res.states} states, "
+      f"{res.transitions} transitions, ok")
+for bug in proto.HA_BUGS:
+    res = proto.check_protocol(dataclasses.replace(base, bug=bug))
+    assert not res.ok, f"seeded HA bug {bug} NOT re-found"
+    print(f"seeded {bug}: re-found ({res.states} states)")
+PYEOF
+echo "ha smoke: election fenced, takeover restores state, seeded races re-found"
 python -m pytest tests/ -q --full

@@ -404,6 +404,14 @@ def test_counter_fold_shared_and_key_sets_identical(tmp_path, monkeypatch):
                 "autotune_scale_events"):
         assert key in COUNTER_FOLD
         assert key in local_keys and key in dist_keys
+    # the lmr-ha leader trio rides the same fold (DESIGN §31): a
+    # LocalExecutor run has no coordinator plane, so the keys must
+    # still surface — as zeros — or takeover evidence would vanish
+    # from any stats consumer that intersects the two schemas
+    for key in ("leader_takeovers", "fenced_writes", "standby_wakeups"):
+        assert key in COUNTER_FOLD
+        assert key in local_keys and key in dist_keys
+        assert local_stats.iterations[-1].as_dict()[key] == 0
 
 
 # --- CLI ---------------------------------------------------------------------
